@@ -1,0 +1,36 @@
+"""crane-scheduler-tpu: a TPU-native (JAX/XLA/pjit) load-aware scheduling framework.
+
+A ground-up rebuild of the capabilities of crane-scheduler
+(reference: /root/reference, xieydd/crane-scheduler @ 2025-02-15):
+
+- ``policy``     — versioned ``DynamicSchedulerPolicy`` model (YAML v1alpha1
+                   compatible) compiled into tensor constants
+                   (ref: pkg/plugins/apis/policy).
+- ``loadstore``  — columnar node-load state (``value[node, metric]``,
+                   ``timestamp[node, metric]``, ``hot_value[node]``)
+                   mirroring the node-annotation contract
+                   (ref: pkg/controller/annotator/node.go:142).
+- ``scorer``     — the Dynamic filter/score semantics
+                   (ref: pkg/plugins/dynamic/stats.go), as a scalar
+                   float64 oracle plus a batched JAX implementation that
+                   evaluates every node in one fused tensor expression.
+- ``annotator``  — metric-sync engine, binding records, hot-value
+                   (ref: pkg/controller/annotator).
+- ``metrics``    — pluggable metrics source (Prometheus-compatible client
+                   with the reference's query quirks + a fake for tests)
+                   (ref: pkg/controller/prometheus/prometheus.go).
+- ``topology``   — NUMA-aware placement (ref: pkg/plugins/noderesourcetopology).
+- ``parallel``   — device-mesh sharding of the node axis; distributed top-k.
+- ``cluster``/``sim`` — in-memory cluster model + simulator harness.
+- ``service``/``cli`` — sidecar scoring service and entrypoints.
+
+Unlike the reference's per-node scalar Go loops, predicate thresholds and
+weighted priorities are evaluated as a single vectorized expression over the
+full node-by-metric matrix, sharded over a ``jax.sharding.Mesh`` for
+multi-chip scale; gang placement is a batched water-filling equivalent of
+sequential greedy argmax.
+"""
+
+__version__ = "0.1.0"
+
+from .constants import MAX_NODE_SCORE, MIN_NODE_SCORE  # noqa: E402,F401

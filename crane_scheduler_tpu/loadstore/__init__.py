@@ -1,0 +1,16 @@
+from .codec import (
+    encode_annotation,
+    decode_annotation,
+    go_parse_float,
+    format_metric_value,
+)
+from .store import NodeLoadStore, DeviceSnapshot
+
+__all__ = [
+    "encode_annotation",
+    "decode_annotation",
+    "go_parse_float",
+    "format_metric_value",
+    "NodeLoadStore",
+    "DeviceSnapshot",
+]

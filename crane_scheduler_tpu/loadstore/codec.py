@@ -1,0 +1,85 @@
+"""Annotation wire codec: ``"<float>,<localtime>"``.
+
+The data-plane contract between the annotator and the scorer is a node
+annotation map ``metricName -> "floatValue,timestamp"``
+(written at ref: pkg/controller/annotator/node.go:123-146, parsed at
+ref: pkg/plugins/dynamic/stats.go:51-76). This module reproduces both ends:
+
+- encode: value rendered by the metrics source (5-decimal fixed for
+  Prometheus, ref: pkg/controller/prometheus/prometheus.go:124) or a bare
+  integer for hot values (ref: node.go:113-121), joined with the quirky
+  local-time timestamp.
+- decode: split on "," requiring exactly two parts; timestamp parsed
+  separately from value so staleness can be evaluated at read time with a
+  caller-supplied ``now``.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+from ..utils.timeutil import format_local_time, parse_local_time
+
+# Go 1.13+ numeric literal syntax: underscores may appear between digits
+# of any group ("1_000.5", "1e1_0"); hex floats need a mandatory p-exponent.
+_D = r"\d(?:_?\d)*"
+_H = r"[0-9a-fA-F](?:_?[0-9a-fA-F])*"
+_GO_FLOAT_RE = re.compile(
+    rf"^[+-]?(?:{_D}(?:\.(?:{_D})?)?|\.{_D})(?:[eE][+-]?{_D})?$"
+)
+_GO_HEX_RE = re.compile(rf"^[+-]?0[xX](?:{_H}(?:\.(?:{_H})?)?|\.{_H})[pP][+-]?{_D}$")
+_GO_SPECIAL_RE = re.compile(r"^[+-]?(inf(inity)?|nan)$", re.IGNORECASE)
+
+
+def go_parse_float(s: str) -> float | None:
+    """``strconv.ParseFloat(s, 64)`` equivalent; None on parse failure.
+
+    Accepts decimal/exponent forms (with Go 1.13 underscore grouping),
+    hex floats with p-exponent, and inf/infinity/nan (any case, optional
+    sign). Rejects leading/trailing whitespace and malformed underscores,
+    as Go does.
+    """
+    if not isinstance(s, str):
+        return None
+    if _GO_FLOAT_RE.match(s):
+        return float(s.replace("_", ""))
+    if _GO_HEX_RE.match(s):
+        return float.fromhex(s.replace("_", ""))
+    if _GO_SPECIAL_RE.match(s):
+        return float(s)
+    return None
+
+
+def format_metric_value(value: float) -> str:
+    """Prometheus-side value serialization: 5-decimal fixed notation
+    (ref: prometheus.go:124 ``strconv.FormatFloat(v, 'f', 5, 64)``)."""
+    if math.isnan(value):
+        return "NaN"
+    if math.isinf(value):
+        return "+Inf" if value > 0 else "-Inf"
+    return f"{value:.5f}"
+
+
+def encode_annotation(value_str: str, epoch_seconds: float | None = None) -> str:
+    """``value + "," + localTime`` (ref: node.go:142)."""
+    return f"{value_str},{format_local_time(epoch_seconds)}"
+
+
+def decode_annotation(raw: str) -> tuple[float | None, float | None]:
+    """Decode to ``(value, ts_epoch)``; either part is None if invalid.
+
+    Mirrors ``getResourceUsage``'s structural checks
+    (ref: stats.go:51-76): the string must split on "," into exactly two
+    parts; the timestamp must parse under the local-TZ layout; the value
+    must parse as a float. Semantic checks (staleness, negativity) are the
+    reader's job — this function only decodes.
+    """
+    if not isinstance(raw, str):
+        return None, None
+    parts = raw.split(",")
+    if len(parts) != 2:
+        return None, None
+    value = go_parse_float(parts[0])
+    ts = parse_local_time(parts[1])
+    return value, ts

@@ -1,0 +1,223 @@
+"""Columnar node-load state: the HBM-resident node-by-metric tensor.
+
+The reference's scoring inputs live as per-node annotation strings patched
+one (node, metric) at a time (ref: pkg/controller/annotator/node.go:123-146)
+and re-parsed per scheduling cycle (ref: pkg/plugins/dynamic/stats.go:51-76).
+Here the same state is columnar: ``value[node, metric]`` and
+``timestamp[node, metric]`` float64 matrices plus ``hot_value[node]`` /
+``hot_ts[node]`` vectors, refreshed in bulk and uploaded to device as one
+padded snapshot. Encoding:
+
+- missing / structurally-invalid annotation -> ``ts = -inf`` (never fresh,
+  so every reader takes the fail-open path, exactly like a parse error);
+- a value string that parsed to NaN stays NaN with its real timestamp
+  (Go lets NaN through the ``< 0`` check; we preserve that).
+
+Padding discipline: snapshots round the node axis up to a bucket size so
+jitted shapes stay stable as the cluster grows (no recompiles at 50k
+nodes); padded rows carry ``node_valid = False``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Mapping
+
+import numpy as np
+
+from ..policy.compile import NODE_HOT_VALUE_KEY, PolicyTensors
+from .codec import decode_annotation
+
+_NEG_INF = float("-inf")
+
+
+def _pad_bucket(n: int, bucket: int) -> int:
+    if n <= 0:
+        return bucket
+    return ((n + bucket - 1) // bucket) * bucket
+
+
+@dataclass(frozen=True)
+class DeviceSnapshot:
+    """A device-ready view of the store (numpy; callers jnp.asarray it)."""
+
+    values: np.ndarray  # [Npad, M] f64
+    ts: np.ndarray  # [Npad, M] f64 epoch seconds, -inf = missing
+    hot_value: np.ndarray  # [Npad] f64
+    hot_ts: np.ndarray  # [Npad] f64
+    node_valid: np.ndarray  # [Npad] bool
+    n_nodes: int
+    node_names: tuple[str, ...]
+
+
+class NodeLoadStore:
+    """Mutable host-side store with amortized growth and bulk refresh."""
+
+    def __init__(self, tensors: PolicyTensors, initial_capacity: int = 64):
+        self.tensors = tensors
+        m = tensors.num_metrics
+        cap = max(initial_capacity, 1)
+        self._cap = cap
+        self._n = 0
+        self._names: list[str] = []
+        self._index: dict[str, int] = {}
+        self.values = np.full((cap, m), np.nan, dtype=np.float64)
+        self.ts = np.full((cap, m), _NEG_INF, dtype=np.float64)
+        self.hot_value = np.full((cap,), np.nan, dtype=np.float64)
+        self.hot_ts = np.full((cap,), _NEG_INF, dtype=np.float64)
+
+    # -- node membership ---------------------------------------------------
+
+    def __len__(self) -> int:
+        return self._n
+
+    @property
+    def node_names(self) -> tuple[str, ...]:
+        return tuple(self._names)
+
+    def node_id(self, name: str) -> int:
+        return self._index[name]
+
+    def add_node(self, name: str) -> int:
+        if name in self._index:
+            return self._index[name]
+        if self._n == self._cap:
+            self._grow(self._cap * 2)
+        i = self._n
+        self._n += 1
+        self._names.append(name)
+        self._index[name] = i
+        self.values[i, :] = np.nan
+        self.ts[i, :] = _NEG_INF
+        self.hot_value[i] = np.nan
+        self.hot_ts[i] = _NEG_INF
+        return i
+
+    def remove_node(self, name: str) -> None:
+        """Swap-remove; row order is not part of the contract."""
+        i = self._index.pop(name, None)
+        if i is None:
+            return
+        last = self._n - 1
+        if i != last:
+            last_name = self._names[last]
+            self.values[i] = self.values[last]
+            self.ts[i] = self.ts[last]
+            self.hot_value[i] = self.hot_value[last]
+            self.hot_ts[i] = self.hot_ts[last]
+            self._names[i] = last_name
+            self._index[last_name] = i
+        self._names.pop()
+        self._n = last
+
+    def _grow(self, new_cap: int) -> None:
+        m = self.tensors.num_metrics
+        for attr, fill, shape in (
+            ("values", np.nan, (new_cap, m)),
+            ("ts", _NEG_INF, (new_cap, m)),
+            ("hot_value", np.nan, (new_cap,)),
+            ("hot_ts", _NEG_INF, (new_cap,)),
+        ):
+            old = getattr(self, attr)
+            new = np.full(shape, fill, dtype=np.float64)
+            new[: self._n] = old[: self._n]
+            setattr(self, attr, new)
+        self._cap = new_cap
+
+    # -- writes ------------------------------------------------------------
+
+    def set_metric(self, node: str, metric: str, value: float, ts: float) -> None:
+        i = self._index.get(node)
+        if i is None:
+            i = self.add_node(node)
+        col = self.tensors.metric_index.get(metric)
+        if col is None:
+            return  # metric not referenced by the policy: ignore
+        self.values[i, col] = value
+        self.ts[i, col] = ts
+
+    def set_hot_value(self, node: str, value: float, ts: float) -> None:
+        i = self._index.get(node)
+        if i is None:
+            i = self.add_node(node)
+        self.hot_value[i] = value
+        self.hot_ts[i] = ts
+
+    def ingest_annotation(self, node: str, key: str, raw: str) -> None:
+        """Decode one ``"value,timestamp"`` annotation into the store."""
+        value, ts = decode_annotation(raw)
+        if ts is None or value is None:
+            # Structurally invalid == missing: readers fail open.
+            value, ts = np.nan, _NEG_INF
+        if key == NODE_HOT_VALUE_KEY:
+            self.set_hot_value(node, value, ts)
+        else:
+            self.set_metric(node, key, value, ts)
+
+    def ingest_node_annotations(self, node: str, anno: Mapping[str, str] | None) -> None:
+        """Bulk-ingest a node's full annotation map (the parity read path).
+
+        The map is authoritative: keys absent from it are cleared, so a
+        deleted annotation doesn't linger as live metric state.
+        """
+        i = self.add_node(node)
+        self.values[i, :] = np.nan
+        self.ts[i, :] = _NEG_INF
+        self.hot_value[i] = np.nan
+        self.hot_ts[i] = _NEG_INF
+        if not anno:
+            return
+        for key, raw in anno.items():
+            if key == NODE_HOT_VALUE_KEY or key in self.tensors.metric_index:
+                self.ingest_annotation(node, key, raw)
+
+    def bulk_set_metric(
+        self,
+        metric: str,
+        node_ids: np.ndarray | Iterable[int],
+        values: np.ndarray,
+        ts: float | np.ndarray,
+    ) -> None:
+        """Whole-column refresh: the TPU-native annotator write path."""
+        col = self.tensors.metric_index.get(metric)
+        if col is None:
+            return
+        ids = np.asarray(node_ids, dtype=np.int64)
+        self.values[ids, col] = values
+        self.ts[ids, col] = ts
+
+    def bulk_set_hot_value(
+        self,
+        node_ids: np.ndarray | Iterable[int],
+        values: np.ndarray,
+        ts: float | np.ndarray,
+    ) -> None:
+        ids = np.asarray(node_ids, dtype=np.int64)
+        self.hot_value[ids] = values
+        self.hot_ts[ids] = ts
+
+    # -- snapshot ----------------------------------------------------------
+
+    def snapshot(self, bucket: int = 2048) -> DeviceSnapshot:
+        n = self._n
+        npad = _pad_bucket(n, bucket)
+        m = self.tensors.num_metrics
+        values = np.full((npad, m), np.nan, dtype=np.float64)
+        ts = np.full((npad, m), _NEG_INF, dtype=np.float64)
+        hot_value = np.zeros((npad,), dtype=np.float64)
+        hot_ts = np.full((npad,), _NEG_INF, dtype=np.float64)
+        values[:n] = self.values[:n]
+        ts[:n] = self.ts[:n]
+        hot_value[:n] = self.hot_value[:n]
+        hot_ts[:n] = self.hot_ts[:n]
+        node_valid = np.zeros((npad,), dtype=bool)
+        node_valid[:n] = True
+        return DeviceSnapshot(
+            values=values,
+            ts=ts,
+            hot_value=hot_value,
+            hot_ts=hot_ts,
+            node_valid=node_valid,
+            n_nodes=n,
+            node_names=tuple(self._names),
+        )

@@ -1,0 +1,24 @@
+from .oracle import (
+    UsageError,
+    get_resource_usage,
+    get_active_duration,
+    is_overload,
+    get_node_score,
+    get_node_hot_value,
+    filter_node,
+    score_node,
+)
+from .batched import BatchedScorer, ScoreResult
+
+__all__ = [
+    "UsageError",
+    "get_resource_usage",
+    "get_active_duration",
+    "is_overload",
+    "get_node_score",
+    "get_node_hot_value",
+    "filter_node",
+    "score_node",
+    "BatchedScorer",
+    "ScoreResult",
+]

@@ -1,0 +1,182 @@
+"""Batched TPU scorer: the whole cluster in one fused tensor expression.
+
+Replaces the reference's per-node scalar loops
+(ref: pkg/plugins/dynamic/stats.go:94-138 inside the kube-scheduler's
+per-node Filter/Score callbacks) with a single vectorized evaluation over
+the node-by-metric load matrix:
+
+    filter:  any_p [ valid(n,p) & thresh_p != 0 & usage(n,p) > thresh_p ]
+    score:   clip( trunc(Σ_k s_k / Σ_k w_k) - trunc(hot*10), 0, 100 )
+    s_k   =  valid(n,k) ? (1 - usage(n,k)) * w_k * 100 : 0
+    valid =  fresh(now < ts + window) & ¬(value < 0) & window > 0
+
+Bit-exactness rules honored (validated against ``scorer.oracle``):
+
+- priority contributions accumulate **in policy list order** via an
+  explicit chain of adds (float addition is not associative; XLA preserves
+  explicit ordering);
+- Go ``int(float64)`` truncation toward zero, with NaN/±Inf and
+  out-of-int64-range mapping to int64-min (amd64 ``CVTTSD2SI``), and int64
+  two's-complement wraparound on the hot-penalty subtraction;
+- NaN usage propagates through the score sum like Go (a node annotated
+  "NaN,<fresh ts>" truncates to int64-min and clamps to 0);
+- fail-open everywhere: staleness/missing/negative reads score 0 with the
+  weight still counted, and never mark a node overloaded.
+
+``dtype=float64`` (requires jax_enable_x64) is the parity mode;
+``dtype=float32`` is the TPU fast path (scores may differ by ±1 at exact
+truncation boundaries — filtering differs only when usage values sit
+within float32 epsilon of a threshold).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..constants import (
+    HOT_VALUE_ACTIVE_PERIOD_SECONDS,
+    MAX_NODE_SCORE,
+    MIN_NODE_SCORE,
+)
+from ..policy.compile import PolicyTensors
+
+
+@dataclass
+class ScoreResult:
+    schedulable: Any  # [N] bool — Filter verdict (True = passes)
+    scores: Any  # [N] int32 in [0, 100] — Score verdict
+
+    def __iter__(self):
+        yield self.schedulable
+        yield self.scores
+
+
+def _go_trunc_to_int(q, int_dtype):
+    """Vectorized Go int(floatExpr): trunc toward zero; NaN/Inf/overflow
+    -> integer-indefinite (min int)."""
+    info = jnp.iinfo(int_dtype)
+    limit = jnp.asarray(2.0 ** (info.bits - 1), dtype=q.dtype)
+    ok = jnp.isfinite(q) & (q > -limit) & (q < limit)
+    safe = jnp.where(ok, jnp.trunc(q), 0.0)
+    return jnp.where(ok, safe.astype(int_dtype), info.min)
+
+
+def _ordered_sum(columns):
+    """Sum a list of [N] arrays with a left-to-right addition chain."""
+    if not columns:
+        return None
+    acc = columns[0]
+    for c in columns[1:]:
+        acc = acc + c
+    return acc
+
+
+class BatchedScorer:
+    """Jitted filter+score over a load-store snapshot.
+
+    Usage::
+
+        scorer = BatchedScorer(compile_policy(policy))
+        result = scorer(snap.values, snap.ts, snap.hot_value, snap.hot_ts,
+                        snap.node_valid, now)
+    """
+
+    def __init__(self, tensors: PolicyTensors, dtype=jnp.float64):
+        self.tensors = tensors
+        self.dtype = jnp.dtype(dtype)
+        if self.dtype == jnp.dtype(jnp.float64) and not jax.config.jax_enable_x64:
+            raise ValueError(
+                "float64 parity mode requires jax_enable_x64 "
+                "(set JAX_ENABLE_X64=1 or jax.config.update)"
+            )
+        self.int_dtype = jnp.int64 if self.dtype == jnp.dtype(jnp.float64) else jnp.int32
+        t = tensors
+        f = lambda a: jnp.asarray(a, dtype=self.dtype)
+        self._pred_idx = jnp.asarray(t.pred_idx, dtype=jnp.int32)
+        self._pred_threshold = f(t.pred_threshold)
+        self._pred_active = f(t.pred_active)
+        self._prio_idx = jnp.asarray(t.prio_idx, dtype=jnp.int32)
+        self._prio_weight = f(t.prio_weight)
+        self._prio_active = f(t.prio_active)
+        self._weight_sum = float(t.weight_sum)
+        self._jit = jax.jit(self._score_impl)
+
+    def __call__(self, values, ts, hot_value, hot_ts, node_valid, now) -> ScoreResult:
+        if self.dtype != jnp.dtype(jnp.float64):
+            # Rebase timestamps around `now` before the downcast: epoch
+            # seconds (~1.7e9) have ~2-minute granularity in float32, which
+            # would corrupt staleness windows. (ts - now) is exact in
+            # float64 (Sterbenz) and small enough to survive float32.
+            ts = np.asarray(ts, dtype=np.float64) - float(now)
+            hot_ts = np.asarray(hot_ts, dtype=np.float64) - float(now)
+            now = 0.0
+        out = self._jit(
+            jnp.asarray(values, dtype=self.dtype),
+            jnp.asarray(ts, dtype=self.dtype),
+            jnp.asarray(hot_value, dtype=self.dtype),
+            jnp.asarray(hot_ts, dtype=self.dtype),
+            jnp.asarray(node_valid, dtype=jnp.bool_),
+            jnp.asarray(now, dtype=self.dtype),
+        )
+        return ScoreResult(*out)
+
+    # The pure function (also used by the sharded path via shard_map).
+    def _score_impl(self, values, ts, hot_value, hot_ts, node_valid, now):
+        schedulable = self.filter_mask(values, ts, now) & node_valid
+        scores = self.score_values(values, ts, hot_value, hot_ts, now)
+        scores = jnp.where(node_valid, scores, 0)
+        return schedulable, scores
+
+    def filter_mask(self, values, ts, now):
+        """True = node passes every predicate (ref: plugins.go:39-69)."""
+        n = values.shape[0]
+        if len(self.tensors.pred_idx) == 0:
+            return jnp.ones((n,), dtype=jnp.bool_)
+        usage = values[:, self._pred_idx]  # [N, P]
+        tstamp = ts[:, self._pred_idx]
+        fresh = now < tstamp + self._pred_active  # -inf ts is never fresh
+        valid = fresh & ~(usage < 0) & (self._pred_active > 0)
+        over = valid & (self._pred_threshold != 0) & (usage > self._pred_threshold)
+        return ~jnp.any(over, axis=1)
+
+    def score_values(self, values, ts, hot_value, hot_ts, now):
+        """[0,100] int scores (ref: plugins.go:73-98, stats.go:114-138)."""
+        n = values.shape[0]
+        izero = jnp.zeros((n,), dtype=self.int_dtype)
+        if len(self.tensors.prio_idx) == 0:
+            base = izero  # ref: stats.go:116-120 — no priorities => score 0
+        else:
+            usage = values[:, self._prio_idx]  # [N, K]
+            tstamp = ts[:, self._prio_idx]
+            fresh = now < tstamp + self._prio_active
+            valid = fresh & ~(usage < 0) & (self._prio_active > 0)
+            contrib = (1.0 - usage) * self._prio_weight * float(MAX_NODE_SCORE)
+            per_entry = jnp.where(valid, contrib, jnp.asarray(0.0, self.dtype))
+            # In-order accumulation: Go adds entry scores left to right.
+            score_sum = _ordered_sum([per_entry[:, k] for k in range(per_entry.shape[1])])
+            if self._weight_sum == 0.0:
+                quotient = jnp.where(
+                    score_sum == 0.0,
+                    jnp.asarray(jnp.nan, self.dtype),
+                    jnp.sign(score_sum) * jnp.asarray(jnp.inf, self.dtype),
+                )
+            else:
+                quotient = score_sum / jnp.asarray(self._weight_sum, self.dtype)
+            base = _go_trunc_to_int(quotient, self.int_dtype)
+
+        hot_fresh = now < hot_ts + jnp.asarray(
+            HOT_VALUE_ACTIVE_PERIOD_SECONDS, self.dtype
+        )
+        hot_ok = hot_fresh & ~(hot_value < 0)
+        hv = jnp.where(hot_ok, hot_value, jnp.asarray(0.0, self.dtype))
+        penalty = _go_trunc_to_int(hv * 10.0, self.int_dtype)
+        # int64 subtraction wraps two's-complement, matching Go.
+        score = base - penalty
+        score = jnp.clip(score, MIN_NODE_SCORE, MAX_NODE_SCORE)
+        return score.astype(jnp.int32)

@@ -1,0 +1,64 @@
+"""Timestamp codec reproducing the reference's timezone quirk.
+
+The reference renders annotation timestamps with Go layout
+``2006-01-02T15:04:05Z`` **in local time** (env ``TZ``, default
+``Asia/Shanghai``): the trailing ``Z`` is a literal character, not a UTC
+marker (ref: pkg/utils/utils.go:10-45). The reader parses with
+``time.ParseInLocation`` using the same location
+(ref: pkg/plugins/dynamic/stats.go:36), so values round-trip — but only if
+writer and reader agree on the zone. We reproduce this exactly: wire strings
+look like UTC but are local, and we store epoch seconds internally.
+"""
+
+from __future__ import annotations
+
+import os
+import time as _time
+from datetime import datetime, timezone
+from zoneinfo import ZoneInfo
+
+# Go layout "2006-01-02T15:04:05Z" with literal Z, rendered in local TZ.
+TIME_FORMAT = "%Y-%m-%dT%H:%M:%SZ"
+DEFAULT_TIMEZONE = "Asia/Shanghai"  # ref: pkg/utils/utils.go:12
+# Timestamps shorter than this are rejected outright
+# (ref: pkg/plugins/dynamic/stats.go:19-20,31-34).
+MIN_TIMESTAMP_STR_LENGTH = 5
+
+
+def get_location() -> ZoneInfo:
+    """Resolve the annotation timezone from env ``TZ`` (ref: utils.go:36-45)."""
+    zone = os.environ.get("TZ") or DEFAULT_TIMEZONE
+    try:
+        return ZoneInfo(zone)
+    except Exception:
+        return ZoneInfo(DEFAULT_TIMEZONE)
+
+
+def now_epoch() -> float:
+    return _time.time()
+
+
+def format_local_time(epoch_seconds: float | None = None) -> str:
+    """Epoch seconds -> quirky local-time-with-literal-Z wire string."""
+    if epoch_seconds is None:
+        epoch_seconds = _time.time()
+    dt = datetime.fromtimestamp(epoch_seconds, tz=timezone.utc).astimezone(get_location())
+    return dt.strftime(TIME_FORMAT)
+
+
+def parse_local_time(s: str) -> float | None:
+    """Wire string -> epoch seconds, or None if invalid.
+
+    Mirrors ``inActivePeriod``'s validity checks: too-short strings and
+    layout mismatches are rejected (ref: stats.go:30-41). The string is
+    interpreted in the configured location, matching
+    ``time.ParseInLocation``.
+    """
+    if not isinstance(s, str) or len(s) < MIN_TIMESTAMP_STR_LENGTH:
+        return None
+    try:
+        naive = datetime.strptime(s, TIME_FORMAT)
+    except ValueError:
+        return None
+    local = naive.replace(tzinfo=get_location())
+    return local.timestamp()

@@ -1,0 +1,25 @@
+"""Test configuration: CPU backend with 8 virtual devices and x64 enabled.
+
+Multi-chip hardware is not available in CI; sharding tests run on a virtual
+8-device CPU mesh (the driver separately dry-runs the multi-chip path via
+``__graft_entry__.dryrun_multichip``). x64 is required for the float64
+bit-parity mode of the batched scorer.
+
+Note: jax may already be imported by interpreter-startup hooks, so env vars
+are too late here — use jax.config.update, which works as long as backends
+have not been initialized yet.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+try:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:  # backend already initialized (e.g. single-process reuse)
+    pass

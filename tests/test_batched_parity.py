@@ -1,0 +1,209 @@
+"""Property tests: the batched JAX scorer matches the scalar oracle
+bit-for-bit in float64 mode, across randomized annotation pathologies."""
+
+import random
+
+import numpy as np
+import pytest
+
+from crane_scheduler_tpu.loadstore import NodeLoadStore
+from crane_scheduler_tpu.policy import DEFAULT_POLICY, compile_policy
+from crane_scheduler_tpu.policy.types import (
+    PolicySpec,
+    PredicatePolicy,
+    PriorityPolicy,
+    SyncPolicy,
+)
+from crane_scheduler_tpu.scorer import BatchedScorer, oracle
+from crane_scheduler_tpu.utils import format_local_time
+
+NOW = 1753776000.0
+TENSORS = compile_policy(DEFAULT_POLICY)
+
+
+def random_annotation(rng: random.Random, now: float) -> str | None:
+    """Draw one annotation value across the whole pathology space."""
+    roll = rng.random()
+    if roll < 0.15:
+        return None  # missing
+    age = rng.choice([0, 1, 100, 479, 480, 481, 1000, 11100, 11101])
+    ts = format_local_time(now - age)
+    if roll < 0.20:
+        return f"bogus,{ts}"  # unparseable value
+    if roll < 0.25:
+        return "0.5"  # no comma
+    if roll < 0.30:
+        return f"0.5,{ts},extra"  # too many parts
+    if roll < 0.35:
+        return f"0.5,not-a-time"  # bad timestamp
+    if roll < 0.40:
+        return f"{-rng.random():.5f},{ts}"  # negative
+    if roll < 0.43:
+        return f"NaN,{ts}"  # NaN
+    value = rng.choice(
+        [0.0, 0.1, 0.3, 0.5, 0.649, 0.65, 0.651, 0.75, 0.8, 0.99, 1.0, 1.5]
+    )
+    return f"{value:.5f},{ts}"
+
+
+def random_hot(rng: random.Random, now: float) -> str | None:
+    roll = rng.random()
+    if roll < 0.4:
+        return None
+    age = rng.choice([0, 100, 299, 300, 301])
+    ts = format_local_time(now - age)
+    if roll < 0.5:
+        return f"bad,{ts}"
+    value = rng.choice(["0", "1", "2", "3", "10", "0.19", "12.7"])
+    return f"{value},{ts}"
+
+
+def build_cluster(rng: random.Random, n_nodes: int, metric_names):
+    nodes = {}
+    for i in range(n_nodes):
+        anno = {}
+        for m in metric_names:
+            raw = random_annotation(rng, NOW)
+            if raw is not None:
+                anno[m] = raw
+        hot = random_hot(rng, NOW)
+        if hot is not None:
+            anno["node_hot_value"] = hot
+        nodes[f"node-{i}"] = anno
+    return nodes
+
+
+def run_parity_case(policy, tensors, nodes, now=NOW):
+    store = NodeLoadStore(tensors)
+    for name, anno in nodes.items():
+        store.ingest_node_annotations(name, anno)
+    snap = store.snapshot(bucket=64)
+    scorer = BatchedScorer(tensors)
+    result = scorer(
+        snap.values, snap.ts, snap.hot_value, snap.hot_ts, snap.node_valid, now
+    )
+    schedulable = np.asarray(result.schedulable)
+    scores = np.asarray(result.scores)
+    for name in nodes:
+        i = store.node_id(name)
+        anno = nodes[name]
+        want_ok, _ = oracle.filter_node(anno, policy.spec, now)
+        want_score = oracle.score_node(anno, policy.spec, now)
+        assert schedulable[i] == want_ok, (name, anno)
+        assert scores[i] == want_score, (name, anno, scores[i], want_score)
+    # padded rows are unschedulable with score 0
+    n = snap.n_nodes
+    assert not schedulable[n:].any()
+    assert (scores[n:] == 0).all()
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_parity_default_policy_random_clusters(seed):
+    rng = random.Random(seed)
+    nodes = build_cluster(rng, 100, TENSORS.metric_names)
+    run_parity_case(DEFAULT_POLICY, TENSORS, nodes)
+
+
+def test_parity_pathological_policies():
+    from crane_scheduler_tpu.policy.types import DynamicSchedulerPolicy
+
+    cases = [
+        # no priorities at all
+        PolicySpec(sync_period=(SyncPolicy("a", 60.0),),
+                   predicate=(PredicatePolicy("a", 0.5),)),
+        # zero threshold + orphan predicate
+        PolicySpec(
+            sync_period=(SyncPolicy("a", 60.0),),
+            predicate=(PredicatePolicy("a", 0.0), PredicatePolicy("orphan", 0.9)),
+            priority=(PriorityPolicy("a", 1.0),),
+        ),
+        # zero weight sum
+        PolicySpec(
+            sync_period=(SyncPolicy("a", 60.0),),
+            priority=(PriorityPolicy("a", 0.0),),
+        ),
+        # duplicate sync entries, zero-period first
+        PolicySpec(
+            sync_period=(SyncPolicy("a", 0.0), SyncPolicy("a", 60.0)),
+            predicate=(PredicatePolicy("a", 0.5),),
+            priority=(PriorityPolicy("a", 2.0), PriorityPolicy("a", 1.0)),
+        ),
+        # empty policy
+        PolicySpec(),
+    ]
+    rng = random.Random(42)
+    for spec in cases:
+        policy = DynamicSchedulerPolicy(spec=spec)
+        tensors = compile_policy(policy)
+        names = tensors.metric_names or ("a",)
+        store_names = tensors.metric_names
+        nodes = {}
+        for i in range(50):
+            anno = {}
+            for m in set(store_names) | {"a", "orphan"}:
+                raw = random_annotation(rng, NOW)
+                if raw is not None:
+                    anno[m] = raw
+            hot = random_hot(rng, NOW)
+            if hot is not None:
+                anno["node_hot_value"] = hot
+            nodes[f"n{i}"] = anno
+        run_parity_case(policy, tensors, nodes)
+
+
+def test_parity_quirk_vectors():
+    """The named quirk cases from test_oracle, through the tensor path."""
+    def entry(v, age=0.0):
+        if isinstance(v, float):
+            v = f"{v:.5f}"
+        return f"{v},{format_local_time(NOW - age)}"
+
+    nodes = {
+        "underloaded": {
+            "cpu_usage_avg_5m": entry(0.3),
+            "cpu_usage_max_avg_1h": entry(0.3),
+            "cpu_usage_max_avg_1d": entry(0.3),
+            "mem_usage_avg_5m": entry(0.4),
+            "mem_usage_max_avg_1h": entry(0.4),
+            "mem_usage_max_avg_1d": entry(0.4),
+        },
+        "overloaded": {"cpu_usage_avg_5m": entry(0.66)},
+        "at-threshold": {"cpu_usage_avg_5m": entry(0.65)},
+        "stale-overload": {"cpu_usage_avg_5m": entry(0.99, age=481)},
+        "fresh-overload": {"cpu_usage_avg_5m": entry(0.99, age=479)},
+        "boundary-overload": {"cpu_usage_avg_5m": entry(0.99, age=480)},
+        "nan": {"cpu_usage_avg_5m": entry("NaN")},
+        "negative": {"cpu_usage_avg_5m": entry(-0.5)},
+        "hot": {
+            "cpu_usage_avg_5m": entry(0.3),
+            "cpu_usage_max_avg_1h": entry(0.3),
+            "cpu_usage_max_avg_1d": entry(0.3),
+            "mem_usage_avg_5m": entry(0.4),
+            "mem_usage_max_avg_1h": entry(0.4),
+            "mem_usage_max_avg_1d": entry(0.4),
+            "node_hot_value": entry("3"),
+        },
+        "empty": {},
+    }
+    run_parity_case(DEFAULT_POLICY, TENSORS, nodes)
+
+
+def test_float32_mode_close_to_oracle():
+    """The fast path is allowed ±1 at truncation boundaries, no more."""
+    import jax.numpy as jnp
+
+    rng = random.Random(7)
+    nodes = build_cluster(rng, 200, TENSORS.metric_names)
+    store = NodeLoadStore(TENSORS)
+    for name, anno in nodes.items():
+        store.ingest_node_annotations(name, anno)
+    snap = store.snapshot(bucket=64)
+    scorer32 = BatchedScorer(TENSORS, dtype=jnp.float32)
+    result = scorer32(
+        snap.values, snap.ts, snap.hot_value, snap.hot_ts, snap.node_valid, NOW
+    )
+    scores = np.asarray(result.scores)
+    for name in nodes:
+        i = store.node_id(name)
+        want = oracle.score_node(nodes[name], DEFAULT_POLICY.spec, NOW)
+        assert abs(int(scores[i]) - want) <= 1, (name, nodes[name])

@@ -1,0 +1,65 @@
+import math
+
+from crane_scheduler_tpu.loadstore import (
+    decode_annotation,
+    encode_annotation,
+    format_metric_value,
+    go_parse_float,
+)
+from crane_scheduler_tpu.utils import format_local_time, parse_local_time
+
+
+def test_roundtrip():
+    raw = encode_annotation(format_metric_value(0.65), 1753776000.0)
+    value, ts = decode_annotation(raw)
+    assert value == 0.65
+    assert ts == 1753776000.0
+
+
+def test_local_time_quirk():
+    # The wire format looks like UTC ("...Z") but is rendered in the local
+    # zone (default Asia/Shanghai, UTC+8) — ref: pkg/utils/utils.go:10-45.
+    s = format_local_time(0.0)  # epoch == 1970-01-01T00:00:00 UTC
+    assert s == "1970-01-01T08:00:00Z"
+    assert parse_local_time(s) == 0.0
+
+
+def test_decode_structural_errors():
+    assert decode_annotation("no-comma") == (None, None)
+    assert decode_annotation("a,b,c") == (None, None)
+    v, ts = decode_annotation("notafloat,2025-07-29T16:00:00Z")
+    assert v is None and ts is not None
+    v, ts = decode_annotation("0.5,xx")
+    assert v == 0.5 and ts is None
+
+
+def test_short_timestamp_rejected():
+    # ref: stats.go:19-20,31-34 — < 5 chars is illegal.
+    assert parse_local_time("abc") is None
+    assert parse_local_time("") is None
+
+
+def test_go_parse_float():
+    assert go_parse_float("0.65000") == 0.65
+    assert go_parse_float("1e3") == 1000.0
+    assert go_parse_float("+0.5") == 0.5
+    assert go_parse_float("-0.5") == -0.5
+    assert math.isnan(go_parse_float("NaN"))
+    assert go_parse_float("+Inf") == math.inf
+    # Go 1.13+ literal syntax: underscores between digits, hex floats.
+    assert go_parse_float("1_000") == 1000.0
+    assert go_parse_float("1_000.5") == 1000.5
+    assert go_parse_float("0x1p-2") == 0.25
+    assert go_parse_float("_1000") is None
+    assert go_parse_float("1000_") is None
+    assert go_parse_float("1__0") is None
+    assert go_parse_float("0x1") is None  # hex needs a p exponent
+    assert go_parse_float(" 1.0") is None
+    assert go_parse_float("") is None
+
+
+def test_format_metric_value_five_decimals():
+    # ref: prometheus.go:124 — FormatFloat(v, 'f', 5, 64).
+    assert format_metric_value(0.123456789) == "0.12346"
+    assert format_metric_value(0.0) == "0.00000"
+    assert format_metric_value(float("nan")) == "NaN"

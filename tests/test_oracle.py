@@ -1,0 +1,263 @@
+"""Table tests encoding every scoring quirk of the reference Dynamic plugin
+(ref: pkg/plugins/dynamic/stats.go, plugins.go). These are the golden
+semantics the batched TPU scorer must match bit-for-bit."""
+
+import math
+
+import pytest
+
+from crane_scheduler_tpu.policy import DEFAULT_POLICY
+from crane_scheduler_tpu.policy.types import (
+    DynamicSchedulerPolicy,
+    PolicySpec,
+    PredicatePolicy,
+    PriorityPolicy,
+    SyncPolicy,
+)
+from crane_scheduler_tpu.scorer import oracle
+from crane_scheduler_tpu.utils import format_local_time
+
+NOW = 1753776000.0  # fixed wall clock for determinism
+SPEC = DEFAULT_POLICY.spec
+
+
+def anno_entry(value, age_seconds=0.0, now=NOW):
+    """Build a "value,timestamp" annotation aged `age_seconds` before now."""
+    if isinstance(value, float):
+        value = f"{value:.5f}"
+    return f"{value},{format_local_time(now - age_seconds)}"
+
+
+def fresh_annotations(cpu=0.3, mem=0.4, now=NOW):
+    a = {}
+    for name in (
+        "cpu_usage_avg_5m",
+        "cpu_usage_max_avg_1h",
+        "cpu_usage_max_avg_1d",
+    ):
+        a[name] = anno_entry(cpu, now=now)
+    for name in (
+        "mem_usage_avg_5m",
+        "mem_usage_max_avg_1h",
+        "mem_usage_max_avg_1d",
+    ):
+        a[name] = anno_entry(mem, now=now)
+    return a
+
+
+# --- Filter -----------------------------------------------------------------
+
+
+def test_filter_underloaded_node_passes():
+    ok, _ = oracle.filter_node(fresh_annotations(0.3, 0.4), SPEC, NOW)
+    assert ok
+
+
+def test_filter_overloaded_node_rejected():
+    a = fresh_annotations(0.3, 0.4)
+    a["cpu_usage_avg_5m"] = anno_entry(0.66)  # > 0.65 threshold
+    ok, reason = oracle.filter_node(a, SPEC, NOW)
+    assert not ok
+    assert "cpu_usage_avg_5m" in reason
+
+
+def test_filter_exactly_at_threshold_passes():
+    a = fresh_annotations(0.3, 0.4)
+    a["cpu_usage_avg_5m"] = anno_entry(0.65)  # strict > comparison
+    ok, _ = oracle.filter_node(a, SPEC, NOW)
+    assert ok
+
+
+def test_filter_fail_open_on_missing_annotation():
+    # ref: stats.go:96-99 — unreadable usage is NOT overloaded.
+    ok, _ = oracle.filter_node({}, SPEC, NOW)
+    assert ok
+    ok, _ = oracle.filter_node(None, SPEC, NOW)
+    assert ok
+
+
+def test_filter_fail_open_on_stale_annotation():
+    # active window for cpu_usage_avg_5m is 3m + 5m = 480s.
+    a = {"cpu_usage_avg_5m": anno_entry(0.99, age_seconds=481)}
+    ok, _ = oracle.filter_node(a, SPEC, NOW)
+    assert ok
+    # one second inside the window: strict now < ts + window.
+    a = {"cpu_usage_avg_5m": anno_entry(0.99, age_seconds=479)}
+    ok, _ = oracle.filter_node(a, SPEC, NOW)
+    assert not ok
+
+
+def test_filter_staleness_boundary_is_strict():
+    # now == ts + window  =>  NOT in active period (Go now.Before).
+    a = {"cpu_usage_avg_5m": anno_entry(0.99, age_seconds=480)}
+    ok, _ = oracle.filter_node(a, SPEC, NOW)
+    assert ok
+
+
+def test_filter_fail_open_on_corrupt_value():
+    a = {"cpu_usage_avg_5m": anno_entry("bogus")}
+    ok, _ = oracle.filter_node(a, SPEC, NOW)
+    assert ok
+    a = {"cpu_usage_avg_5m": "0.99"}  # no comma
+    ok, _ = oracle.filter_node(a, SPEC, NOW)
+    assert ok
+
+
+def test_filter_negative_value_fails_open():
+    a = {"cpu_usage_avg_5m": anno_entry(-0.5)}
+    ok, _ = oracle.filter_node(a, SPEC, NOW)
+    assert ok
+
+
+def test_filter_nan_value_fails_open():
+    # NaN passes the < 0 check, then NaN > threshold is false.
+    a = {"cpu_usage_avg_5m": anno_entry("NaN")}
+    ok, _ = oracle.filter_node(a, SPEC, NOW)
+    assert ok
+
+
+def test_filter_zero_threshold_disables_entry():
+    # ref: stats.go:102-105.
+    spec = PolicySpec(
+        sync_period=(SyncPolicy("m", 60.0),),
+        predicate=(PredicatePolicy("m", 0.0),),
+    )
+    a = {"m": anno_entry(0.99)}
+    ok, _ = oracle.filter_node(a, spec, NOW)
+    assert ok
+
+
+def test_filter_predicate_without_sync_entry_skipped():
+    # ref: plugins.go:57-61 — no active duration => continue.
+    spec = PolicySpec(predicate=(PredicatePolicy("m", 0.5),))
+    a = {"m": anno_entry(0.99)}
+    ok, _ = oracle.filter_node(a, spec, NOW)
+    assert ok
+
+
+def test_filter_daemonset_pod_always_passes():
+    a = fresh_annotations(0.99, 0.99)
+    ok, _ = oracle.filter_node(a, SPEC, NOW, is_daemonset_pod=True)
+    assert ok
+
+
+# --- Score ------------------------------------------------------------------
+
+
+def test_score_basic():
+    # cpu=0.3 mem=0.4: Σ(1-u)w100 = (0.7*0.2 + 0.7*0.3 + 0.7*0.5
+    #                                + 0.6*0.2 + 0.6*0.3 + 0.6*0.5)*100
+    # = (0.7 + 0.6) * 100 = 130; / 2.0 = 65.
+    a = fresh_annotations(0.3, 0.4)
+    assert oracle.score_node(a, SPEC, NOW) == 65
+
+
+def test_score_empty_priority_is_zero():
+    spec = PolicySpec(sync_period=SPEC.sync_period)
+    assert oracle.score_node(fresh_annotations(), spec, NOW) == 0
+
+
+def test_score_weight_counted_on_error():
+    # ref: stats.go:122-137 — a failed read contributes 0 to the numerator
+    # while its weight still lands in the denominator.
+    spec = PolicySpec(
+        sync_period=(SyncPolicy("a", 60.0), SyncPolicy("b", 60.0)),
+        priority=(PriorityPolicy("a", 1.0), PriorityPolicy("b", 1.0)),
+    )
+    a = {"a": anno_entry(0.0)}  # b missing
+    # score = (1-0)*1*100 + 0 = 100; weight = 2 -> int(50) = 50.
+    assert oracle.score_node(a, spec, NOW) == 50
+
+
+def test_score_priority_without_sync_counts_weight():
+    spec = PolicySpec(
+        sync_period=(SyncPolicy("a", 60.0),),
+        priority=(PriorityPolicy("a", 1.0), PriorityPolicy("orphan", 1.0)),
+    )
+    a = {"a": anno_entry(0.0), "orphan": anno_entry(0.0)}
+    assert oracle.score_node(a, spec, NOW) == 50
+
+
+def test_score_int_truncation_toward_zero():
+    spec = PolicySpec(
+        sync_period=(SyncPolicy("a", 60.0),),
+        priority=(PriorityPolicy("a", 1.0),),
+    )
+    a = {"a": anno_entry(0.345)}  # (1-0.345)*100 = 65.5 -> int 65
+    assert oracle.score_node(a, spec, NOW) == 65
+    # usage > 1 makes the quotient negative: -0.5*100 = -50, int(-50.0)
+    a = {"a": anno_entry(1.005)}  # (1-1.005)*100 = -0.5 -> int(-0.5) = 0
+    assert oracle.score_node(a, spec, NOW) == 0
+
+
+def test_score_clamped_to_range():
+    spec = PolicySpec(
+        sync_period=(SyncPolicy("a", 60.0),),
+        priority=(PriorityPolicy("a", 1.0),),
+    )
+    a = {"a": anno_entry(5.0)}  # (1-5)*100 = -400 -> clamp 0
+    assert oracle.score_node(a, spec, NOW) == 0
+    a = {"a": anno_entry(-1.0)}  # negative -> read error -> 0/1 = 0
+    assert oracle.score_node(a, spec, NOW) == 0
+
+
+def test_score_hot_value_penalty():
+    a = fresh_annotations(0.3, 0.4)  # base 65
+    a["node_hot_value"] = anno_entry("3")  # hot 3 -> penalty 30
+    assert oracle.score_node(a, SPEC, NOW) == 35
+
+
+def test_score_hot_value_truncation():
+    a = fresh_annotations(0.3, 0.4)  # base 65
+    a["node_hot_value"] = anno_entry("0.19")  # 1.9 -> int -> 1
+    assert oracle.score_node(a, SPEC, NOW) == 64
+
+
+def test_score_hot_value_fixed_5m_window():
+    # ref: stats.go:23-24,152-166 — hot value validity is a fixed 5m,
+    # independent of syncPolicy.
+    a = fresh_annotations(0.3, 0.4)
+    a["node_hot_value"] = anno_entry("3", age_seconds=301)
+    assert oracle.score_node(a, SPEC, NOW) == 65
+    a["node_hot_value"] = anno_entry("3", age_seconds=299)
+    assert oracle.score_node(a, SPEC, NOW) == 35
+
+
+def test_score_all_stale_scores_zero():
+    a = fresh_annotations(0.3, 0.4, now=NOW - 11101)  # > 3h+5m old
+    assert oracle.score_node(a, SPEC, NOW) == 0
+
+
+def test_score_nan_usage_propagates_to_zero():
+    # NaN usage survives the < 0 check; NaN poisons the sum; Go
+    # int64(NaN) is int64-min; clamp -> 0.
+    a = fresh_annotations(0.3, 0.4)
+    a["cpu_usage_avg_5m"] = anno_entry("NaN")
+    assert oracle.score_node(a, SPEC, NOW) == 0
+
+
+def test_score_zero_weight_sum():
+    spec = PolicySpec(
+        sync_period=(SyncPolicy("a", 60.0),),
+        priority=(PriorityPolicy("a", 0.0),),
+    )
+    a = {"a": anno_entry(0.3)}
+    # 0/0 = NaN -> int64-min -> clamp 0.
+    assert oracle.score_node(a, spec, NOW) == 0
+
+
+def test_score_zero_weight_sum_with_hot_value_wraps():
+    # int64-min - penalty wraps two's-complement to a huge positive,
+    # which then clamps to 100. Absurd but bit-exact with Go on amd64.
+    spec = PolicySpec(
+        sync_period=(SyncPolicy("a", 60.0),),
+        priority=(PriorityPolicy("a", 0.0),),
+    )
+    a = {"a": anno_entry(0.3), "node_hot_value": anno_entry("1")}
+    assert oracle.score_node(a, spec, NOW) == 100
+
+
+def test_get_active_duration_zero_period_skipped():
+    sync = (SyncPolicy("m", 0.0), SyncPolicy("m", 60.0))
+    assert oracle.get_active_duration(sync, "m") == 360.0
+    assert oracle.get_active_duration((), "m") == 0.0

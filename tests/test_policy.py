@@ -1,0 +1,131 @@
+import numpy as np
+import pytest
+
+from crane_scheduler_tpu.policy import (
+    DEFAULT_POLICY,
+    PolicyDecodeError,
+    compile_policy,
+    load_policy,
+)
+
+# The canonical default policy document
+# (ref: deploy/manifests/dynamic/policy.yaml).
+DEFAULT_YAML = """
+apiVersion: scheduler.policy.crane.io/v1alpha1
+kind: DynamicSchedulerPolicy
+spec:
+  syncPolicy:
+    - name: cpu_usage_avg_5m
+      period: 3m
+    - name: cpu_usage_max_avg_1h
+      period: 15m
+    - name: cpu_usage_max_avg_1d
+      period: 3h
+    - name: mem_usage_avg_5m
+      period: 3m
+    - name: mem_usage_max_avg_1h
+      period: 15m
+    - name: mem_usage_max_avg_1d
+      period: 3h
+  predicate:
+    - name: cpu_usage_avg_5m
+      maxLimitPecent: 0.65
+    - name: cpu_usage_max_avg_1h
+      maxLimitPecent: 0.75
+    - name: mem_usage_avg_5m
+      maxLimitPecent: 0.65
+    - name: mem_usage_max_avg_1h
+      maxLimitPecent: 0.75
+  priority:
+    - name: cpu_usage_avg_5m
+      weight: 0.2
+    - name: cpu_usage_max_avg_1h
+      weight: 0.3
+    - name: cpu_usage_max_avg_1d
+      weight: 0.5
+    - name: mem_usage_avg_5m
+      weight: 0.2
+    - name: mem_usage_max_avg_1h
+      weight: 0.3
+    - name: mem_usage_max_avg_1d
+      weight: 0.5
+  hotValue:
+    - timeRange: 5m
+      count: 5
+    - timeRange: 1m
+      count: 2
+"""
+
+
+def test_default_yaml_decodes_to_default_policy():
+    assert load_policy(DEFAULT_YAML) == DEFAULT_POLICY
+
+
+def test_wrong_gvk_rejected():
+    with pytest.raises(PolicyDecodeError):
+        load_policy("apiVersion: v1\nkind: DynamicSchedulerPolicy\nspec: {}\n")
+    with pytest.raises(PolicyDecodeError):
+        load_policy(
+            "apiVersion: scheduler.policy.crane.io/v1alpha1\nkind: Other\nspec: {}\n"
+        )
+
+
+def test_strict_decode_rejects_unknown_fields():
+    bad = DEFAULT_YAML.replace("maxLimitPecent: 0.65", "maxLimitPercent: 0.65", 1)
+    with pytest.raises(PolicyDecodeError):
+        load_policy(bad)
+
+
+def test_bad_duration_rejected():
+    bad = DEFAULT_YAML.replace("period: 3m", "period: threeminutes", 1)
+    with pytest.raises(PolicyDecodeError):
+        load_policy(bad)
+
+
+def test_compile_default_policy():
+    t = compile_policy(DEFAULT_POLICY)
+    assert t.num_metrics == 6
+    assert t.metric_names[0] == "cpu_usage_avg_5m"
+    np.testing.assert_allclose(
+        t.active_seconds,
+        [180 + 300, 900 + 300, 10800 + 300, 180 + 300, 900 + 300, 10800 + 300],
+    )
+    assert list(t.pred_idx) == [0, 1, 3, 4]
+    np.testing.assert_allclose(t.pred_threshold, [0.65, 0.75, 0.65, 0.75])
+    assert list(t.prio_idx) == [0, 1, 2, 3, 4, 5]
+    assert t.weight_sum == pytest.approx(0.2 + 0.3 + 0.5 + 0.2 + 0.3 + 0.5)
+    np.testing.assert_allclose(t.hv_range_seconds, [300.0, 60.0])
+    assert list(t.hv_count) == [5, 2]
+
+
+def test_compile_zero_period_sync_entry_skipped():
+    # ref: stats.go:140-150 — a zero-period entry does not satisfy the
+    # active-duration scan; a later nonzero entry with the same name does.
+    yaml_doc = """
+apiVersion: scheduler.policy.crane.io/v1alpha1
+kind: DynamicSchedulerPolicy
+spec:
+  syncPolicy:
+    - name: m
+      period: 0s
+    - name: m
+      period: 1m
+  predicate:
+    - name: m
+      maxLimitPecent: 0.5
+"""
+    t = compile_policy(load_policy(yaml_doc))
+    assert t.active_seconds[t.metric_index["m"]] == 60 + 300
+
+
+def test_compile_predicate_without_sync_is_disabled():
+    yaml_doc = """
+apiVersion: scheduler.policy.crane.io/v1alpha1
+kind: DynamicSchedulerPolicy
+spec:
+  predicate:
+    - name: orphan
+      maxLimitPecent: 0.5
+"""
+    t = compile_policy(load_policy(yaml_doc))
+    assert t.pred_active[0] == 0.0

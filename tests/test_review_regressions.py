@@ -1,0 +1,94 @@
+"""Regression tests for code-review findings on the core scoring path."""
+
+import numpy as np
+import pytest
+
+from crane_scheduler_tpu.loadstore import NodeLoadStore
+from crane_scheduler_tpu.policy import compile_policy
+from crane_scheduler_tpu.policy.types import (
+    DynamicSchedulerPolicy,
+    PolicySpec,
+    PredicatePolicy,
+    PriorityPolicy,
+    SyncPolicy,
+)
+from crane_scheduler_tpu.scorer import BatchedScorer, oracle
+from crane_scheduler_tpu.utils import format_local_time, parse_go_duration
+from crane_scheduler_tpu.utils.duration import DurationError
+
+NOW = 1753776000.0
+
+
+def entry(v, age=0.0):
+    return f"{v},{format_local_time(NOW - age)}"
+
+
+def test_finite_overflow_truncates_to_int64_min_parity():
+    # A huge usage drives the quotient past int64 range; Go's CVTTSD2SI
+    # yields int64-min, which clamps to 0 (and wraps to 100 with a hot
+    # penalty). Oracle and batched path must agree.
+    spec = PolicySpec(
+        sync_period=(SyncPolicy("a", 60.0),),
+        priority=(PriorityPolicy("a", 1.0),),
+    )
+    policy = DynamicSchedulerPolicy(spec=spec)
+    tensors = compile_policy(policy)
+    for hot, want in ((None, 0), ("1", 100)):
+        anno = {"a": entry("1e18")}
+        if hot is not None:
+            anno["node_hot_value"] = entry(hot)
+        assert oracle.score_node(anno, spec, NOW) == want
+        store = NodeLoadStore(tensors)
+        store.ingest_node_annotations("n", anno)
+        snap = store.snapshot(bucket=8)
+        res = BatchedScorer(tensors)(
+            snap.values, snap.ts, snap.hot_value, snap.hot_ts, snap.node_valid, NOW
+        )
+        assert int(res.scores[store.node_id("n")]) == want
+
+
+def test_reingest_clears_removed_annotations():
+    spec = PolicySpec(
+        sync_period=(SyncPolicy("a", 60.0),),
+        predicate=(PredicatePolicy("a", 0.5),),
+        priority=(PriorityPolicy("a", 1.0),),
+    )
+    tensors = compile_policy(DynamicSchedulerPolicy(spec=spec))
+    store = NodeLoadStore(tensors)
+    store.ingest_node_annotations("n", {"a": entry("0.99000"), "node_hot_value": entry("3")})
+    store.ingest_node_annotations("n", {})  # annotation deleted upstream
+    snap = store.snapshot(bucket=8)
+    res = BatchedScorer(tensors)(
+        snap.values, snap.ts, snap.hot_value, snap.hot_ts, snap.node_valid, NOW
+    )
+    i = store.node_id("n")
+    assert bool(res.schedulable[i])  # fail-open, not stale 0.99
+    assert int(res.scores[i]) == 0
+
+
+def test_negative_period_claims_active_duration():
+    # First nonzero period wins even if the resulting window is <= 0;
+    # a later entry must NOT overwrite it (ref: stats.go:140-150).
+    spec = PolicySpec(
+        sync_period=(SyncPolicy("a", -300.0), SyncPolicy("a", 600.0)),
+        predicate=(PredicatePolicy("a", 0.5),),
+    )
+    assert oracle.get_active_duration(spec.sync_period, "a") == 0.0
+    tensors = compile_policy(DynamicSchedulerPolicy(spec=spec))
+    assert tensors.active_seconds[tensors.metric_index["a"]] == 0.0
+    # Overloaded fresh node passes because the predicate is disabled.
+    anno = {"a": entry("0.99000")}
+    ok, _ = oracle.filter_node(anno, spec, NOW)
+    assert ok
+    store = NodeLoadStore(tensors)
+    store.ingest_node_annotations("n", anno)
+    snap = store.snapshot(bucket=8)
+    res = BatchedScorer(tensors)(
+        snap.values, snap.ts, snap.hot_value, snap.hot_ts, snap.node_valid, NOW
+    )
+    assert bool(res.schedulable[store.node_id("n")])
+
+
+def test_multi_dot_duration_is_duration_error():
+    with pytest.raises(DurationError):
+        parse_go_duration("1.2.3h")
