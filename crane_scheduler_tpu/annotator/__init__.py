@@ -1,0 +1,14 @@
+from .bindings import Binding, BindingRecords
+from .events import translate_event_to_binding, EventIngestor
+from .workqueue import RateLimitedQueue
+from .controller import NodeAnnotator, AnnotatorConfig
+
+__all__ = [
+    "Binding",
+    "BindingRecords",
+    "translate_event_to_binding",
+    "EventIngestor",
+    "RateLimitedQueue",
+    "NodeAnnotator",
+    "AnnotatorConfig",
+]

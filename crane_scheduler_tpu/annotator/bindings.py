@@ -1,0 +1,89 @@
+"""Bounded binding-records heap feeding the hot-value counters.
+
+Reproduces ``BindingRecords`` (ref: pkg/controller/annotator/binding.go):
+a size-capped min-heap ordered by timestamp; inserting into a full heap
+evicts the oldest record; ``get_last_node_binding_count`` is a linear scan
+counting bindings on a node strictly newer than ``now - time_range``; GC
+pops expired records (older than the max hot-value window).
+
+A C++ backend (``native/``) can replace the pure-Python heap for large
+clusters; both satisfy the same interface and the same tests.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Binding:
+    node: str
+    namespace: str
+    pod_name: str
+    timestamp: int  # unix seconds (ref: binding.go:18)
+
+
+class BindingRecords:
+    """ref: binding.go:50-123."""
+
+    def __init__(self, size: int, gc_time_range_seconds: float):
+        self._size = int(size)
+        self._gc_time_range = gc_time_range_seconds
+        self._heap: list[tuple[int, int, Binding]] = []
+        self._seq = 0
+        self._lock = threading.RLock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._heap)
+
+    def add_binding(self, binding: Binding) -> None:
+        """Push; evict the oldest first when full (ref: binding.go:69-78)."""
+        with self._lock:
+            if len(self._heap) == self._size:
+                heapq.heappop(self._heap)
+            self._seq += 1
+            heapq.heappush(self._heap, (binding.timestamp, self._seq, binding))
+
+    def get_last_node_binding_count(
+        self, node: str, time_range_seconds: float, now: float | None = None
+    ) -> int:
+        """Count bindings on ``node`` strictly newer than the window start
+        (ref: binding.go:81-97 — ``binding.Timestamp > timeline``)."""
+        if now is None:
+            now = time.time()
+        timeline = int(now) - int(time_range_seconds)
+        with self._lock:
+            return sum(
+                1
+                for _, _, b in self._heap
+                if b.timestamp > timeline and b.node == node
+            )
+
+    def bindings_gc(self, now: float | None = None) -> None:
+        """Pop expired records; stop at the first live one
+        (ref: binding.go:100-123)."""
+        if now is None:
+            now = time.time()
+        with self._lock:
+            if self._gc_time_range == 0:
+                return
+            timeline = int(now) - int(self._gc_time_range)
+            while self._heap:
+                ts, seq, binding = heapq.heappop(self._heap)
+                if binding.timestamp > timeline:
+                    heapq.heappush(self._heap, (ts, seq, binding))
+                    return
+
+
+def max_hot_value_time_range(hot_value_policies) -> float:
+    """GC window = the largest hot-value timeRange
+    (ref: pkg/controller/annotator/utils.go:25-39)."""
+    max_range = 0.0
+    for p in hot_value_policies or ():
+        if p.time_range_seconds > max_range:
+            max_range = p.time_range_seconds
+    return max_range

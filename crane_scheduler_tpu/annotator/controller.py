@@ -1,0 +1,217 @@
+"""Node annotator: the metric-sync engine.
+
+Reproduces the reference controller (ref: pkg/controller/annotator): per
+sync-policy tickers fan out ``node/metric`` work items; workers query the
+metrics source (node IP first, node name fallback), patch the node
+annotation ``metric -> "value,localtime"``, and re-patch ``node_hot_value``
+with every item; failures re-queue with 10s→360s exponential backoff.
+
+Two operating modes:
+
+- **threaded** (``start``/``stop``): live tickers + worker threads, the
+  production shape (worker count = ``concurrent_syncs``,
+  ref: controller.go:61-85);
+- **synchronous** (``sync_all_once``): one deterministic full pass with an
+  injected ``now``, used by tests and the simulator.
+
+The TPU-native twist: annotations remain the durable contract (the cluster
+is the source of truth, SURVEY §5), but scorer reads go through the bulk
+``refresh_store`` path that re-ingests all annotations into the columnar
+``NodeLoadStore`` in one sweep instead of per-node string parsing in the
+scheduling hot path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+from ..cluster.state import ClusterState, Node
+from ..constants import (
+    DEFAULT_BINDING_HEAP_SIZE,
+    DEFAULT_CONCURRENT_SYNCS,
+    NODE_HOT_VALUE_KEY,
+)
+from ..loadstore.codec import encode_annotation
+from ..loadstore.store import NodeLoadStore
+from ..metrics.source import MetricsQueryError, MetricsSource
+from ..policy.types import DynamicSchedulerPolicy
+from .bindings import BindingRecords, max_hot_value_time_range
+from .events import EventIngestor
+from .workqueue import RateLimitedQueue
+
+
+@dataclass
+class AnnotatorConfig:
+    """ref: pkg/controller/annotator/config/types.go:4-14."""
+
+    binding_heap_size: int = DEFAULT_BINDING_HEAP_SIZE
+    concurrent_syncs: int = DEFAULT_CONCURRENT_SYNCS
+
+
+def _split_meta_key(key: str) -> tuple[str, str]:
+    """ref: pkg/controller/annotator/utils.go:11-19."""
+    parts = key.split("/")
+    if len(parts) != 2:
+        raise ValueError(f"unexpected key format: {key!r}")
+    return parts[0], parts[1]
+
+
+def _meta_key(node_name: str, metric_name: str) -> str:
+    return f"{node_name}/{metric_name}"
+
+
+class NodeAnnotator:
+    def __init__(
+        self,
+        cluster: ClusterState,
+        metrics: MetricsSource,
+        policy: DynamicSchedulerPolicy,
+        config: AnnotatorConfig | None = None,
+    ):
+        self.cluster = cluster
+        self.metrics = metrics
+        self.policy = policy
+        self.config = config or AnnotatorConfig()
+        self.binding_records = BindingRecords(
+            self.config.binding_heap_size,
+            max_hot_value_time_range(policy.spec.hot_value),
+        )
+        self.event_ingestor = EventIngestor(cluster, self.binding_records)
+        self.queue = RateLimitedQueue()
+        self.synced = 0
+        self.sync_errors = 0
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # -- core sync logic ---------------------------------------------------
+
+    def sync_node(self, key: str, now: float | None = None) -> bool:
+        """Process one ``node/metric`` item; True = success ("forget")
+        (ref: node.go:72-99)."""
+        if now is None:
+            now = time.time()
+        try:
+            node_name, metric_name = _split_meta_key(key)
+        except ValueError:
+            return True  # invalid key: drop, don't retry
+        node = self.cluster.get_node(node_name)
+        if node is None:
+            return True  # node gone: drop
+        try:
+            self.annotate_node_load(node, metric_name, now)
+            self.annotate_node_hot_value(node, now)
+        except MetricsQueryError:
+            self.sync_errors += 1
+            return False
+        self.synced += 1
+        return True
+
+    def annotate_node_load(self, node: Node, metric_name: str, now: float) -> None:
+        """Query by IP, fall back to name, patch annotation
+        (ref: node.go:101-111)."""
+        value = None
+        try:
+            value = self.metrics.query_by_node_ip(metric_name, node.internal_ip())
+        except MetricsQueryError:
+            value = None
+        if not value:
+            value = self.metrics.query_by_node_name(metric_name, node.name)
+        if not value:
+            raise MetricsQueryError(f"failed to get data {metric_name} for {node.name}")
+        self.cluster.patch_node_annotation(
+            node.name, metric_name, encode_annotation(value, now)
+        )
+
+    def annotate_node_hot_value(self, node: Node, now: float) -> None:
+        """hotValue = Σ_p count(node, window_p) // count_p — integer
+        division per policy entry (ref: node.go:113-121)."""
+        value = 0
+        for p in self.policy.spec.hot_value:
+            value += (
+                self.binding_records.get_last_node_binding_count(
+                    node.name, p.time_range_seconds, now
+                )
+                // p.count
+            )
+        self.cluster.patch_node_annotation(
+            node.name, NODE_HOT_VALUE_KEY, encode_annotation(str(value), now)
+        )
+
+    def enqueue_metric(self, metric_name: str) -> None:
+        """One tick: fan out a work item per node
+        (ref: node.go:148-161)."""
+        for node_name in self.cluster.node_names():
+            self.queue.add(_meta_key(node_name, metric_name))
+
+    def sync_all_once(self, now: float | None = None) -> None:
+        """Deterministic full pass over nodes × syncPolicy (test/sim path)."""
+        if now is None:
+            now = time.time()
+        for sp in self.policy.spec.sync_period:
+            for node_name in self.cluster.node_names():
+                self.sync_node(_meta_key(node_name, sp.name), now)
+
+    # -- TPU-native bulk refresh ------------------------------------------
+
+    def refresh_store(self, store: NodeLoadStore) -> None:
+        """Bulk re-ingest every node's annotations into the columnar store
+        (cold-start = full re-read; the store is a cache, never the source
+        of truth — SURVEY §5)."""
+        seen = set()
+        for node in self.cluster.list_nodes():
+            store.ingest_node_annotations(node.name, node.annotations)
+            seen.add(node.name)
+        for name in set(store.node_names) - seen:
+            store.remove_node(name)
+
+    # -- threaded mode -----------------------------------------------------
+
+    def start(self) -> None:
+        """Start workers, tickers, event ingestion, and heap GC
+        (ref: controller.go:61-85)."""
+        self._stop.clear()
+        self.event_ingestor.start()
+        for _ in range(self.config.concurrent_syncs):
+            t = threading.Thread(target=self._worker, daemon=True)
+            t.start()
+            self._threads.append(t)
+        for sp in self.policy.spec.sync_period:
+            self.enqueue_metric(sp.name)  # immediate first sync
+            t = threading.Thread(target=self._ticker, args=(sp,), daemon=True)
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(target=self._gc_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.shutdown()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads.clear()
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            item = self.queue.get(timeout=0.5)
+            if item is None:
+                continue
+            try:
+                forget = self.sync_node(item)
+            finally:
+                self.queue.done(item)
+            if forget:
+                self.queue.forget(item)
+            else:
+                self.queue.add_rate_limited(item)
+
+    def _ticker(self, sync_policy) -> None:
+        period = max(sync_policy.period_seconds, 0.01)
+        while not self._stop.wait(timeout=period):
+            self.enqueue_metric(sync_policy.name)
+
+    def _gc_loop(self) -> None:
+        while not self._stop.wait(timeout=60.0):
+            self.binding_records.bindings_gc()

@@ -1,0 +1,21 @@
+from .state import (
+    Container,
+    ResourceRequirements,
+    Node,
+    NodeAddress,
+    Pod,
+    Event,
+    OwnerReference,
+    ClusterState,
+)
+
+__all__ = [
+    "Container",
+    "ResourceRequirements",
+    "Node",
+    "NodeAddress",
+    "Pod",
+    "Event",
+    "OwnerReference",
+    "ClusterState",
+]

@@ -1,0 +1,222 @@
+"""In-memory cluster state: the framework's kube-apiserver stand-in.
+
+The reference coordinates its two processes exclusively through the
+Kubernetes API — the controller JSON-patches node annotations
+(ref: pkg/controller/annotator/node.go:123-146) and watches ``Scheduled``
+events (ref: cmd/controller/app/options/factory.go:25-33); the scheduler
+plugin reads nodes from its informer snapshot. ``ClusterState`` models
+exactly that surface: nodes with annotations and addresses, pods with owner
+references and containers, a bounded event log with subscriber callbacks,
+and thread-safe patch/bind operations that emit the same
+"Successfully assigned <ns/pod> to <node>" events the reference parses.
+
+In a real deployment this object is replaced by a k8s client hitting a live
+apiserver; everything above it (annotator, scorer, framework) only sees
+this interface.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field, replace
+from typing import Callable, Iterable, Mapping
+
+
+@dataclass(frozen=True)
+class NodeAddress:
+    type: str  # "InternalIP", "Hostname", ...
+    address: str
+
+
+@dataclass(frozen=True)
+class Node:
+    name: str
+    annotations: Mapping[str, str] = field(default_factory=dict)
+    labels: Mapping[str, str] = field(default_factory=dict)
+    addresses: tuple[NodeAddress, ...] = ()
+
+    def internal_ip(self) -> str:
+        """ref: node.go:179-187 — InternalIP, falling back to the name."""
+        for addr in self.addresses:
+            if addr.type == "InternalIP":
+                return addr.address
+        return self.name
+
+
+@dataclass(frozen=True)
+class OwnerReference:
+    kind: str
+    name: str = ""
+
+
+@dataclass(frozen=True)
+class ResourceRequirements:
+    requests: Mapping[str, float] = field(default_factory=dict)
+    limits: Mapping[str, float] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class Container:
+    name: str
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+
+
+@dataclass(frozen=True)
+class Pod:
+    name: str
+    namespace: str = "default"
+    annotations: Mapping[str, str] = field(default_factory=dict)
+    owner_references: tuple[OwnerReference, ...] = ()
+    containers: tuple[Container, ...] = ()
+    node_name: str = ""
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def is_daemonset_pod(self) -> bool:
+        """ref: pkg/utils/utils.go:17-24."""
+        return any(ref.kind == "DaemonSet" for ref in self.owner_references)
+
+
+@dataclass(frozen=True)
+class Event:
+    namespace: str
+    name: str
+    type: str  # "Normal" | "Warning"
+    reason: str
+    message: str
+    count: int = 1
+    event_time: float = 0.0  # used when count == 0 (ref: event.go:131-137)
+    last_timestamp: float = 0.0
+    resource_version: int = 0
+
+
+EventHandler = Callable[[Event], None]
+
+
+class ClusterState:
+    """Thread-safe cluster model with event subscription."""
+
+    def __init__(self, max_events: int = 4096):
+        self._lock = threading.RLock()
+        self._nodes: dict[str, Node] = {}
+        self._pods: dict[str, Pod] = {}
+        self._events: deque[Event] = deque(maxlen=max_events)
+        self._event_index: dict[str, Event] = {}
+        self._event_handlers: list[EventHandler] = []
+        self._rv = itertools.count(1)
+
+    # -- nodes -------------------------------------------------------------
+
+    def add_node(self, node: Node) -> None:
+        with self._lock:
+            self._nodes[node.name] = node
+
+    def delete_node(self, name: str) -> None:
+        with self._lock:
+            self._nodes.pop(name, None)
+
+    def get_node(self, name: str) -> Node | None:
+        with self._lock:
+            return self._nodes.get(name)
+
+    def list_nodes(self) -> list[Node]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    def node_names(self) -> list[str]:
+        with self._lock:
+            return list(self._nodes)
+
+    def patch_node_annotation(self, name: str, key: str, value: str) -> bool:
+        """The controller's write primitive (ref: node.go:123-146)."""
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                return False
+            anno = dict(node.annotations)
+            anno[key] = value
+            self._nodes[name] = replace(node, annotations=anno)
+            return True
+
+    # -- pods --------------------------------------------------------------
+
+    def add_pod(self, pod: Pod) -> None:
+        with self._lock:
+            self._pods[pod.key()] = pod
+
+    def delete_pod(self, key: str) -> None:
+        with self._lock:
+            self._pods.pop(key, None)
+
+    def get_pod(self, key: str) -> Pod | None:
+        with self._lock:
+            return self._pods.get(key)
+
+    def list_pods(self, node_name: str | None = None) -> list[Pod]:
+        with self._lock:
+            pods = list(self._pods.values())
+        if node_name is not None:
+            pods = [p for p in pods if p.node_name == node_name]
+        return pods
+
+    def patch_pod_annotation(self, key: str, anno_key: str, value: str) -> bool:
+        """PreBind's write primitive (ref: noderesourcetopology/binder.go:19-65)."""
+        with self._lock:
+            pod = self._pods.get(key)
+            if pod is None:
+                return False
+            anno = dict(pod.annotations)
+            anno[anno_key] = value
+            self._pods[key] = replace(pod, annotations=anno)
+            return True
+
+    def bind_pod(self, pod_key: str, node_name: str, now: float | None = None) -> bool:
+        """Bind + emit the ``Scheduled`` event the annotator listens for
+        (message contract ref: event.go:118-137)."""
+        if now is None:
+            now = time.time()
+        with self._lock:
+            pod = self._pods.get(pod_key)
+            if pod is None:
+                return False
+            self._pods[pod_key] = replace(pod, node_name=node_name)
+        self.emit_event(
+            Event(
+                namespace=pod.namespace,
+                name=f"{pod.name}.scheduled",
+                type="Normal",
+                reason="Scheduled",
+                message=f"Successfully assigned {pod.namespace}/{pod.name} to {node_name}",
+                count=1,
+                last_timestamp=now,
+            )
+        )
+        return True
+
+    # -- events ------------------------------------------------------------
+
+    def emit_event(self, event: Event) -> None:
+        with self._lock:
+            event = replace(event, resource_version=next(self._rv))
+            self._events.append(event)
+            self._event_index[f"{event.namespace}/{event.name}"] = event
+            handlers = list(self._event_handlers)
+        for handler in handlers:
+            handler(event)
+
+    def get_event(self, key: str) -> Event | None:
+        with self._lock:
+            return self._event_index.get(key)
+
+    def list_events(self) -> list[Event]:
+        with self._lock:
+            return list(self._events)
+
+    def subscribe_events(self, handler: EventHandler) -> None:
+        """Informer-style subscription (new events only, like a watch)."""
+        with self._lock:
+            self._event_handlers.append(handler)

@@ -1,0 +1,5 @@
+from .source import MetricsSource
+from .fake import FakeMetricsSource
+from .prometheus import PrometheusClient
+
+__all__ = ["MetricsSource", "FakeMetricsSource", "PrometheusClient"]

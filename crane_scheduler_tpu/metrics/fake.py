@@ -1,0 +1,67 @@
+"""Fake metrics source for tests and the simulator."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ..loadstore.codec import format_metric_value
+from .source import MetricsQueryError
+
+
+class FakeMetricsSource:
+    """Dict-backed metrics with per-key failure injection.
+
+    Values may be floats or zero-arg callables (synthetic streams).
+    Keys are (metric_name, node_ip) and/or (metric_name, node_name);
+    the annotator tries IP first and falls back to the name, like the
+    reference (ref: pkg/controller/annotator/node.go:101-111).
+    """
+
+    def __init__(self):
+        self._by_ip: dict[tuple[str, str], float | Callable[[], float]] = {}
+        self._by_name: dict[tuple[str, str], float | Callable[[], float]] = {}
+        self._fail_ip: set[tuple[str, str]] = set()
+        self._fail_name: set[tuple[str, str]] = set()
+        self.ip_queries = 0
+        self.name_queries = 0
+
+    def set(self, metric: str, node: str, value, by: str = "both") -> None:
+        if by in ("ip", "both"):
+            self._by_ip[(metric, node)] = value
+        if by in ("name", "both"):
+            self._by_name[(metric, node)] = value
+
+    def fail(self, metric: str, node: str, by: str = "both") -> None:
+        if by in ("ip", "both"):
+            self._fail_ip.add((metric, node))
+        if by in ("name", "both"):
+            self._fail_name.add((metric, node))
+
+    def clear_failures(self) -> None:
+        self._fail_ip.clear()
+        self._fail_name.clear()
+
+    @staticmethod
+    def _render(value) -> str:
+        if callable(value):
+            value = value()
+        # Mirror the Prometheus client's clamping + 5-decimal rendering
+        # (ref: prometheus.go:120-125).
+        value = float(value)
+        if value != value or value < 0:  # NaN or negative
+            value = 0.0
+        return format_metric_value(value)
+
+    def query_by_node_ip(self, metric_name: str, ip: str) -> str:
+        self.ip_queries += 1
+        key = (metric_name, ip)
+        if key in self._fail_ip or key not in self._by_ip:
+            raise MetricsQueryError(f"no data for {metric_name}{{instance={ip}}}")
+        return self._render(self._by_ip[key])
+
+    def query_by_node_name(self, metric_name: str, name: str) -> str:
+        self.name_queries += 1
+        key = (metric_name, name)
+        if key in self._fail_name or key not in self._by_name:
+            raise MetricsQueryError(f"no data for {metric_name}{{instance={name}}}")
+        return self._render(self._by_name[key])

@@ -1,0 +1,99 @@
+"""Prometheus HTTP API client with the reference's query quirks.
+
+Reproduces ``promClient`` (ref: pkg/controller/prometheus/prometheus.go):
+
+- instant vector queries with a 10s timeout (``prometheus.go:17``);
+- query templates ``metric{instance=~"IP"} /100`` with a fallback to
+  ``metric{instance=~"IP:.+"} /100`` (``:50-67``) — usage values are
+  fractions in [0,1] because of the ``/100``;
+- the same two-step by node name has only the exact-match form (``:69-80``);
+- an ``offset``-variant exists for parity but, like the reference's, has no
+  callers (``:82-98``);
+- result handling (``:100-128``): vector-typed results only; warnings are
+  errors; negative/NaN samples clamp to 0; the *last* vector element wins;
+  the value re-serialized with 5-decimal fixed formatting.
+
+Uses only the stdlib (urllib) so the framework has no HTTP dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from ..loadstore.codec import format_metric_value
+from .source import MetricsQueryError
+
+DEFAULT_QUERY_TIMEOUT_SECONDS = 10.0  # ref: prometheus.go:17
+
+
+class PrometheusClient:
+    def __init__(self, address: str, timeout: float = DEFAULT_QUERY_TIMEOUT_SECONDS):
+        self.address = address.rstrip("/")
+        self.timeout = timeout
+
+    # -- public interface (ref: prometheus.go:21-28) -----------------------
+
+    def query_by_node_ip(self, metric_name: str, ip: str) -> str:
+        result = self._try_query(f'{metric_name}{{instance=~"{ip}"}} /100')
+        if result:
+            return result
+        result = self._try_query(f'{metric_name}{{instance=~"{ip}:.+"}} /100')
+        if result:
+            return result
+        raise MetricsQueryError(f"no data for {metric_name}{{instance=~{ip}}}")
+
+    def query_by_node_name(self, metric_name: str, name: str) -> str:
+        result = self._try_query(f'{metric_name}{{instance=~"{name}"}} /100')
+        if result:
+            return result
+        raise MetricsQueryError(f"no data for {metric_name}{{instance=~{name}}}")
+
+    def query_by_node_ip_with_offset(self, metric_name: str, ip: str, offset: str) -> str:
+        result = self._try_query(f'{metric_name}{{instance=~"{ip}"}} offset {offset} /100')
+        if result:
+            return result
+        result = self._try_query(
+            f'{metric_name}{{instance=~"{ip}:.+"}} offset {offset} /100'
+        )
+        if result:
+            return result
+        raise MetricsQueryError(f"no data for {metric_name} offset {offset}")
+
+    # -- internals ---------------------------------------------------------
+
+    def _try_query(self, promql: str) -> str:
+        try:
+            return self._query(promql)
+        except MetricsQueryError:
+            return ""
+
+    def _query(self, promql: str) -> str:
+        url = f"{self.address}/api/v1/query?" + urllib.parse.urlencode({"query": promql})
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout) as resp:
+                payload = json.load(resp)
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            raise MetricsQueryError(f"query failed: {e}") from e
+
+        if payload.get("status") != "success":
+            raise MetricsQueryError(f"query error: {payload.get('error')}")
+        if payload.get("warnings"):
+            raise MetricsQueryError(f"unexpected warnings: {payload['warnings']}")
+        data = payload.get("data", {})
+        if data.get("resultType") != "vector":
+            raise MetricsQueryError(f"illegal result type: {data.get('resultType')}")
+
+        metric_value = ""
+        for elem in data.get("result", []):
+            try:
+                value = float(elem["value"][1])
+            except (KeyError, IndexError, TypeError, ValueError):
+                continue
+            if value < 0 or math.isnan(value):
+                value = 0.0
+            metric_value = format_metric_value(value)  # last element wins
+        return metric_value

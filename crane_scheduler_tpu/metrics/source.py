@@ -1,0 +1,28 @@
+"""Pluggable metrics source interface.
+
+Mirrors the reference's ``PromClient`` interface
+(ref: pkg/controller/prometheus/prometheus.go:21-28): queries return the
+metric value as a *string* (the wire value that lands verbatim in the
+annotation, 5-decimal formatted), or None/raise on failure. The annotator
+only depends on this protocol; Prometheus is one implementation, the fake
+is another, and a bulk-capable source can serve whole columns at once.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+
+class MetricsQueryError(Exception):
+    pass
+
+
+@runtime_checkable
+class MetricsSource(Protocol):
+    def query_by_node_ip(self, metric_name: str, ip: str) -> str:
+        """Value string for (metric, node-ip); raises MetricsQueryError."""
+        ...
+
+    def query_by_node_name(self, metric_name: str, name: str) -> str:
+        """Value string for (metric, node-name); raises MetricsQueryError."""
+        ...

@@ -1,0 +1,328 @@
+"""Annotator subsystem tests: binding heap, event codec, hot value,
+sync engine, and the feedback loop into the scorer."""
+
+import time
+
+import pytest
+
+from crane_scheduler_tpu.annotator import (
+    Binding,
+    BindingRecords,
+    EventIngestor,
+    NodeAnnotator,
+    AnnotatorConfig,
+    RateLimitedQueue,
+)
+from crane_scheduler_tpu.annotator.bindings import max_hot_value_time_range
+from crane_scheduler_tpu.annotator.events import (
+    EventTranslationError,
+    translate_event_to_binding,
+)
+from crane_scheduler_tpu.cluster import ClusterState, Event, Node, NodeAddress
+from crane_scheduler_tpu.metrics import FakeMetricsSource
+from crane_scheduler_tpu.policy import DEFAULT_POLICY
+from crane_scheduler_tpu.scorer import oracle
+
+NOW = 1753776000.0
+
+
+# --- BindingRecords (ref: binding.go) --------------------------------------
+
+
+def test_binding_count_window():
+    br = BindingRecords(10, 300.0)
+    br.add_binding(Binding("n1", "default", "p1", int(NOW) - 100))
+    br.add_binding(Binding("n1", "default", "p2", int(NOW) - 400))
+    br.add_binding(Binding("n2", "default", "p3", int(NOW) - 10))
+    assert br.get_last_node_binding_count("n1", 300.0, NOW) == 1
+    assert br.get_last_node_binding_count("n1", 500.0, NOW) == 2
+    assert br.get_last_node_binding_count("n2", 300.0, NOW) == 1
+    # strict > comparison on the boundary
+    br.add_binding(Binding("n3", "default", "p4", int(NOW) - 300))
+    assert br.get_last_node_binding_count("n3", 300.0, NOW) == 0
+
+
+def test_binding_heap_evicts_oldest_when_full():
+    br = BindingRecords(3, 300.0)
+    for i, ts in enumerate([100, 50, 200, 150]):
+        br.add_binding(Binding("n", "ns", f"p{i}", int(NOW) + ts))
+    assert len(br) == 3
+    # the oldest (+50) was evicted; remaining: 100, 150, 200
+    assert br.get_last_node_binding_count("n", 10**6, NOW + 1000) == 3
+
+
+def test_bindings_gc_pops_only_expired():
+    br = BindingRecords(10, 300.0)
+    br.add_binding(Binding("n", "ns", "old", int(NOW) - 400))
+    br.add_binding(Binding("n", "ns", "new", int(NOW) - 100))
+    br.bindings_gc(NOW)
+    assert len(br) == 1
+    assert br.get_last_node_binding_count("n", 300.0, NOW) == 1
+
+
+def test_bindings_gc_zero_range_noop():
+    br = BindingRecords(10, 0.0)
+    br.add_binding(Binding("n", "ns", "old", int(NOW) - 4000))
+    br.bindings_gc(NOW)
+    assert len(br) == 1
+
+
+def test_max_hot_value_time_range():
+    assert max_hot_value_time_range(DEFAULT_POLICY.spec.hot_value) == 300.0
+    assert max_hot_value_time_range(()) == 0.0
+
+
+# --- Event codec (ref: event.go:118-145) -----------------------------------
+
+
+def test_translate_event():
+    e = Event(
+        namespace="default",
+        name="x",
+        type="Normal",
+        reason="Scheduled",
+        message="Successfully assigned default/nginx-abc to node-7",
+        count=1,
+        last_timestamp=NOW,
+    )
+    b = translate_event_to_binding(e)
+    assert b == Binding("node-7", "default", "nginx-abc", int(NOW))
+
+
+def test_translate_event_zero_count_uses_event_time():
+    e = Event(
+        namespace="d",
+        name="x",
+        type="Normal",
+        reason="Scheduled",
+        message="Successfully assigned d/p to n",
+        count=0,
+        event_time=123.0,
+        last_timestamp=456.0,
+    )
+    assert translate_event_to_binding(e).timestamp == 123
+
+
+@pytest.mark.parametrize(
+    "message",
+    [
+        "Something else entirely",
+        "Successfully assigned malformedkey to node",  # no ns/name
+        "Successfully assigned a/b/c to node",  # too many parts
+        "Successfully assigned",  # truncated
+    ],
+)
+def test_translate_event_rejects(message):
+    e = Event("d", "x", "Normal", "Scheduled", message)
+    with pytest.raises(EventTranslationError):
+        translate_event_to_binding(e)
+
+
+def test_event_ingestor_filters_and_records():
+    cluster = ClusterState()
+    br = BindingRecords(10, 300.0)
+    ing = EventIngestor(cluster, br)
+    ing.start()
+    cluster.emit_event(
+        Event("d", "e1", "Normal", "Scheduled",
+              "Successfully assigned d/p1 to n1", 1, 0.0, NOW)
+    )
+    cluster.emit_event(Event("d", "e2", "Warning", "Scheduled", "x"))
+    cluster.emit_event(Event("d", "e3", "Normal", "FailedScheduling", "x"))
+    assert ing.translated == 1
+    assert br.get_last_node_binding_count("n1", 300.0, NOW) == 1
+
+
+def test_bind_pod_emits_parseable_event():
+    from crane_scheduler_tpu.cluster import Pod
+
+    cluster = ClusterState()
+    br = BindingRecords(10, 300.0)
+    ing = EventIngestor(cluster, br)
+    ing.start()
+    cluster.add_pod(Pod(name="web-1", namespace="prod"))
+    assert cluster.bind_pod("prod/web-1", "node-3", NOW)
+    assert br.get_last_node_binding_count("node-3", 60.0, NOW) == 1
+    assert cluster.get_pod("prod/web-1").node_name == "node-3"
+
+
+# --- Work queue -------------------------------------------------------------
+
+
+def test_workqueue_dedup_and_backoff():
+    clock = [0.0]
+    q = RateLimitedQueue(clock=lambda: clock[0])
+    q.add("a")
+    q.add("a")  # dedup
+    assert len(q) == 1
+    item = q.get(timeout=0)
+    assert item == "a"
+    q.done("a")
+    # fail twice: delays 10, then 20
+    q.add_rate_limited("a")
+    assert q.get(timeout=0) is None  # not ready yet
+    clock[0] = 10.1
+    assert q.get(timeout=0) == "a"
+    q.done("a")
+    q.add_rate_limited("a")
+    clock[0] = 20.0
+    assert q.get(timeout=0) is None
+    clock[0] = 30.2
+    assert q.get(timeout=0) == "a"
+    q.done("a")
+    q.forget("a")
+    q.add_rate_limited("a")
+    clock[0] = 40.5  # back to base delay after forget
+    assert q.get(timeout=0) == "a"
+
+
+def test_workqueue_backoff_caps_at_max():
+    clock = [0.0]
+    q = RateLimitedQueue(clock=lambda: clock[0])
+    for i in range(10):
+        q.add_rate_limited("x")
+        clock[0] += 400
+        got = q.get(timeout=0)
+        assert got == "x", i  # delay never exceeds 360s
+        q.done("x")
+
+
+def test_workqueue_readd_while_processing():
+    q = RateLimitedQueue(clock=lambda: 0.0)
+    q.add("a")
+    assert q.get(timeout=0) == "a"
+    q.add("a")  # while processing -> dirty
+    assert q.get(timeout=0) is None
+    q.done("a")  # re-queues the dirty item
+    assert q.get(timeout=0) == "a"
+
+
+# --- Sync engine ------------------------------------------------------------
+
+
+def make_cluster(n=3):
+    cluster = ClusterState()
+    for i in range(n):
+        cluster.add_node(
+            Node(
+                name=f"node-{i}",
+                addresses=(NodeAddress("InternalIP", f"10.0.0.{i}"),),
+            )
+        )
+    return cluster
+
+
+def test_sync_writes_annotations_and_hot_value():
+    cluster = make_cluster(2)
+    fake = FakeMetricsSource()
+    for i in range(2):
+        fake.set("cpu_usage_avg_5m", f"10.0.0.{i}", 0.3 + i * 0.1, by="ip")
+    ann = NodeAnnotator(cluster, fake, DEFAULT_POLICY)
+    assert ann.sync_node("node-0/cpu_usage_avg_5m", NOW)
+    assert ann.sync_node("node-1/cpu_usage_avg_5m", NOW)
+    n0 = cluster.get_node("node-0")
+    assert n0.annotations["cpu_usage_avg_5m"].startswith("0.30000,")
+    assert n0.annotations["node_hot_value"].startswith("0,")
+    # the scorer can read what the annotator wrote (closing the contract)
+    usage = oracle.get_resource_usage(dict(n0.annotations), "cpu_usage_avg_5m", 480, NOW)
+    assert usage == 0.3
+
+
+def test_sync_falls_back_to_node_name():
+    cluster = make_cluster(1)
+    fake = FakeMetricsSource()
+    fake.set("cpu_usage_avg_5m", "node-0", 0.5, by="name")  # only by name
+    ann = NodeAnnotator(cluster, fake, DEFAULT_POLICY)
+    assert ann.sync_node("node-0/cpu_usage_avg_5m", NOW)
+    assert cluster.get_node("node-0").annotations["cpu_usage_avg_5m"].startswith("0.50000,")
+    assert fake.ip_queries == 1 and fake.name_queries == 1
+
+
+def test_sync_failure_requeues():
+    cluster = make_cluster(1)
+    fake = FakeMetricsSource()  # no data at all
+    ann = NodeAnnotator(cluster, fake, DEFAULT_POLICY)
+    assert not ann.sync_node("node-0/cpu_usage_avg_5m", NOW)
+    assert ann.sync_errors == 1
+    # unknown node or malformed key: dropped, not retried
+    assert ann.sync_node("ghost/cpu_usage_avg_5m", NOW)
+    assert ann.sync_node("garbage", NOW)
+
+
+def test_hot_value_formula_integer_division():
+    # hotValue = Σ_p bindings(window_p) // count_p with default policy
+    # (5m/5 + 1m/2): 7 bindings in last minute -> 7//5 + 7//2 = 1 + 3 = 4.
+    cluster = make_cluster(1)
+    fake = FakeMetricsSource()
+    fake.set("cpu_usage_avg_5m", "10.0.0.0", 0.1, by="ip")
+    ann = NodeAnnotator(cluster, fake, DEFAULT_POLICY)
+    for i in range(7):
+        ann.binding_records.add_binding(Binding("node-0", "d", f"p{i}", int(NOW) - 5))
+    ann.sync_node("node-0/cpu_usage_avg_5m", NOW)
+    hot = cluster.get_node("node-0").annotations["node_hot_value"]
+    assert hot.startswith("4,")
+    # and the oracle applies it as a -40 penalty
+    assert oracle.get_node_hot_value(dict(cluster.get_node("node-0").annotations), NOW) == 4.0
+
+
+def test_sync_all_once_and_refresh_store():
+    from crane_scheduler_tpu.loadstore import NodeLoadStore
+    from crane_scheduler_tpu.policy import compile_policy
+    from crane_scheduler_tpu.scorer import BatchedScorer
+
+    cluster = make_cluster(3)
+    fake = FakeMetricsSource()
+    for i in range(3):
+        for m in ("cpu_usage_avg_5m", "cpu_usage_max_avg_1h", "cpu_usage_max_avg_1d",
+                  "mem_usage_avg_5m", "mem_usage_max_avg_1h", "mem_usage_max_avg_1d"):
+            fake.set(m, f"10.0.0.{i}", 0.2 + 0.2 * i, by="ip")
+    ann = NodeAnnotator(cluster, fake, DEFAULT_POLICY)
+    ann.sync_all_once(NOW)
+    tensors = compile_policy(DEFAULT_POLICY)
+    store = NodeLoadStore(tensors)
+    ann.refresh_store(store)
+    snap = store.snapshot(bucket=8)
+    res = BatchedScorer(tensors)(
+        snap.values, snap.ts, snap.hot_value, snap.hot_ts, snap.node_valid, NOW
+    )
+    # node-0 usage 0.2 -> 80; node-1 0.4 -> 60; node-2 0.6 -> 40
+    got = {n: int(res.scores[store.node_id(n)]) for n in store.node_names}
+    assert got == {"node-0": 80, "node-1": 60, "node-2": 40}
+    assert all(bool(res.schedulable[store.node_id(n)]) for n in store.node_names)
+    # deleted node disappears from the store on next refresh
+    cluster.delete_node("node-2")
+    ann.refresh_store(store)
+    assert "node-2" not in store.node_names
+
+
+def test_threaded_annotator_end_to_end():
+    cluster = make_cluster(2)
+    fake = FakeMetricsSource()
+    for i in range(2):
+        fake.set("cpu_usage_avg_5m", f"10.0.0.{i}", 0.3, by="ip")
+        fake.set("mem_usage_avg_5m", f"10.0.0.{i}", 0.3, by="ip")
+    from crane_scheduler_tpu.policy.types import (
+        DynamicSchedulerPolicy, PolicySpec, SyncPolicy, HotValuePolicy,
+    )
+    policy = DynamicSchedulerPolicy(spec=PolicySpec(
+        sync_period=(SyncPolicy("cpu_usage_avg_5m", 0.05),
+                     SyncPolicy("mem_usage_avg_5m", 0.05)),
+        hot_value=(HotValuePolicy(300.0, 5),),
+    ))
+    ann = NodeAnnotator(cluster, fake, policy, AnnotatorConfig(concurrent_syncs=2))
+    ann.start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            n0, n1 = cluster.get_node("node-0"), cluster.get_node("node-1")
+            if all(
+                m in n.annotations
+                for n in (n0, n1)
+                for m in ("cpu_usage_avg_5m", "mem_usage_avg_5m", "node_hot_value")
+            ):
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError("annotations not written in time")
+    finally:
+        ann.stop()

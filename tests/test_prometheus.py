@@ -1,0 +1,116 @@
+"""Prometheus client tests against a local stub HTTP server, verifying the
+reference's query quirks (ref: pkg/controller/prometheus/prometheus.go)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+from crane_scheduler_tpu.metrics import PrometheusClient
+from crane_scheduler_tpu.metrics.source import MetricsQueryError
+
+
+class StubProm(BaseHTTPRequestHandler):
+    responses = {}  # promql -> payload dict
+    queries = []
+
+    def do_GET(self):
+        url = urlparse(self.path)
+        q = parse_qs(url.query).get("query", [""])[0]
+        type(self).queries.append(q)
+        payload = type(self).responses.get(q)
+        if payload is None:
+            payload = {"status": "success", "data": {"resultType": "vector", "result": []}}
+        body = json.dumps(payload).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def stub():
+    StubProm.responses = {}
+    StubProm.queries = []
+    server = HTTPServer(("127.0.0.1", 0), StubProm)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield server
+    server.shutdown()
+
+
+def vector(*values):
+    return {
+        "status": "success",
+        "data": {
+            "resultType": "vector",
+            "result": [{"metric": {}, "value": [0, str(v)]} for v in values],
+        },
+    }
+
+
+def test_query_by_ip_direct_hit(stub):
+    client = PrometheusClient(f"http://127.0.0.1:{stub.server_port}")
+    StubProm.responses['cpu_usage_avg_5m{instance=~"10.0.0.1"} /100'] = vector(0.42)
+    assert client.query_by_node_ip("cpu_usage_avg_5m", "10.0.0.1") == "0.42000"
+
+
+def test_query_by_ip_falls_back_to_port_pattern(stub):
+    client = PrometheusClient(f"http://127.0.0.1:{stub.server_port}")
+    StubProm.responses['cpu_usage_avg_5m{instance=~"10.0.0.1:.+"} /100'] = vector(0.5)
+    assert client.query_by_node_ip("cpu_usage_avg_5m", "10.0.0.1") == "0.50000"
+    assert StubProm.queries == [
+        'cpu_usage_avg_5m{instance=~"10.0.0.1"} /100',
+        'cpu_usage_avg_5m{instance=~"10.0.0.1:.+"} /100',
+    ]
+
+
+def test_query_no_data_raises(stub):
+    client = PrometheusClient(f"http://127.0.0.1:{stub.server_port}")
+    with pytest.raises(MetricsQueryError):
+        client.query_by_node_ip("cpu_usage_avg_5m", "10.0.0.9")
+
+
+def test_last_element_wins_and_clamping(stub):
+    # ref: prometheus.go:118-125 — negative/NaN clamp to 0; LAST wins.
+    client = PrometheusClient(f"http://127.0.0.1:{stub.server_port}")
+    StubProm.responses['m{instance=~"ip"} /100'] = vector(0.7, -3.0)
+    assert client.query_by_node_ip("m", "ip") == "0.00000"
+    StubProm.responses['m{instance=~"ip"} /100'] = vector(0.1, 0.9)
+    assert client.query_by_node_ip("m", "ip") == "0.90000"
+    StubProm.responses['m{instance=~"ip"} /100'] = vector("NaN")
+    assert client.query_by_node_ip("m", "ip") == "0.00000"
+
+
+def test_non_vector_result_rejected(stub):
+    client = PrometheusClient(f"http://127.0.0.1:{stub.server_port}")
+    StubProm.responses['m{instance=~"ip"} /100'] = {
+        "status": "success",
+        "data": {"resultType": "matrix", "result": []},
+    }
+    with pytest.raises(MetricsQueryError):
+        client.query_by_node_ip("m", "ip")
+
+
+def test_warnings_are_errors(stub):
+    client = PrometheusClient(f"http://127.0.0.1:{stub.server_port}")
+    StubProm.responses['m{instance=~"ip"} /100'] = {
+        "status": "success",
+        "warnings": ["w"],
+        "data": {"resultType": "vector", "result": [{"metric": {}, "value": [0, "1"]}]},
+    }
+    with pytest.raises(MetricsQueryError):
+        client.query_by_node_ip("m", "ip")
+
+
+def test_query_by_name_no_port_fallback(stub):
+    client = PrometheusClient(f"http://127.0.0.1:{stub.server_port}")
+    with pytest.raises(MetricsQueryError):
+        client.query_by_node_name("m", "node-1")
+    assert StubProm.queries == ['m{instance=~"node-1"} /100']
