@@ -1,0 +1,9 @@
+from .mesh import make_node_mesh, node_sharding, replicated_sharding
+from .sharded import ShardedScheduleStep
+
+__all__ = [
+    "make_node_mesh",
+    "node_sharding",
+    "replicated_sharding",
+    "ShardedScheduleStep",
+]

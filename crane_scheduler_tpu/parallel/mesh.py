@@ -1,0 +1,41 @@
+"""Device-mesh helpers: the node axis is the scaling axis.
+
+The reference scales by sharding node/metric work items across Go worker
+pools (ref: pkg/controller/annotator/node.go:148-177); here the analogous
+axis — the cluster's node dimension — shards across TPU devices on a 1-D
+``jax.sharding.Mesh``. Scoring is elementwise over nodes (no cross-node
+dependencies), and gang water-filling needs only small cross-shard
+reductions/scans ([102]-level totals and one prefix sum), which XLA lowers
+to psum/all-gather over ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+NODE_AXIS = "nodes"
+
+
+def make_node_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D mesh over the node axis using the first ``n_devices`` devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} available"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (NODE_AXIS,))
+
+
+def node_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Shard dim 0 (the node axis); later dims (metrics) replicated."""
+    spec = PartitionSpec(NODE_AXIS, *([None] * (ndim - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,193 @@
+"""Gang (burst) scheduling: batched top-k with hot-value feedback.
+
+The reference scheduler places one pod per cycle: Filter, Score, pick the
+best node (ref: k8s scheduleOne; the Dynamic score is pod-independent).
+Within one annotator sync window the node scores don't change, so a naive
+burst of P pods piles onto the argmax node — the hotspot problem the
+``node_hot_value`` penalty exists to mitigate at sync granularity
+(ref: pkg/plugins/dynamic/plugins.go:89-91, pkg/controller/annotator/
+node.go:113-121). For gang scheduling we apply the reference's own
+correction *inside the batch*:
+
+    After a node receives c in-batch pods, its effective score is
+        eff_n(c) = clamp(S_n - 10 * h(c), 0, 100)
+        h(c)     = Σ_p  floor(c / count_p)          (hotValue policy)
+    i.e. the hot-value formula applied to the batch-local bindings
+    (all in-batch bindings fall inside every hotValue window).
+
+**Sequential semantics (the oracle)**: pods are placed one at a time on
+the current max-``eff`` schedulable node, ties broken by lowest node
+index (the reference randomizes among ties; we fix determinism), skipping
+nodes at capacity.
+
+**Batched equivalent (water-filling)**: because every node shares the
+same penalty staircase h, the sequential greedy is exactly "take the P
+most valuable tokens", where node n's t-th token has value
+``max(S_n - 10·h(t), 0)`` and equal-valued tokens order by node index.
+Scores are integers in [0,100], so allocation reduces to 101 discrete
+levels: count each node's tokens per level, find the waterline level
+where cumulative capacity crosses P, and split the waterline level by
+prefix-sum in node-index order. Everything is O(101·N) tensor work — no
+sequential loop over pods — and shards over the node axis.
+
+Entries with ``count <= 0`` are skipped in h (the reference would panic
+on integer division by zero; a policy that does this is invalid).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..constants import MAX_NODE_SCORE
+from ..utils.score import normalize_score
+
+
+def _idtype():
+    """Widest available integer dtype (int64 under x64, else int32)."""
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+# "unbounded tokens at this level" — kept int32-safe so the no-x64 path
+# (where jnp.int64 silently narrows) can't overflow-wrap to negative.
+_INF_TOKENS = np.int64(1) << 30
+
+
+def hot_penalty_steps(hv_counts: Sequence[int]) -> np.ndarray:
+    """g[x] = min assignments c such that h(c) > x, for x = 0..10.
+
+    h(c) = Σ_p floor(c / count_p). g bounds how many pods a node can take
+    before its score drops by more than 10*x. When no (valid) hotValue
+    entries exist, h == 0 and every g[x] is unbounded.
+    """
+    counts = [int(c) for c in hv_counts if int(c) > 0]
+    g = np.full((11,), _INF_TOKENS, dtype=np.int64)
+    if not counts:
+        return g
+    # h increases by >= 1 at least every min(counts) steps, so h(c) > 10
+    # within c <= 11 * min(counts).
+    limit = 11 * min(counts) + 1
+    h = np.zeros((limit,), dtype=np.int64)
+    for c in range(limit):
+        h[c] = sum(c // k for k in counts)
+    for x in range(11):
+        above = np.nonzero(h > x)[0]
+        if len(above):
+            g[x] = above[0]
+    return g
+
+
+@dataclass
+class GangResult:
+    counts: Any  # [N] int32 — pods assigned per node
+    unassigned: Any  # scalar int — pods that found no capacity
+    waterline: Any  # scalar int — the score level where allocation stopped
+
+
+def gang_assign_oracle(
+    scores: Sequence[int],
+    schedulable: Sequence[bool],
+    num_pods: int,
+    hv_counts: Sequence[int],
+    capacity: Sequence[int] | None = None,
+) -> GangResult:
+    """Sequential greedy reference implementation (slow; parity oracle)."""
+    n = len(scores)
+    counts = [int(c) for c in hv_counts if int(c) > 0]
+    cap = [num_pods] * n if capacity is None else [int(c) for c in capacity]
+    assigned = [0] * n
+
+    def h(c: int) -> int:
+        return sum(c // k for k in counts)
+
+    unassigned = 0
+    for _ in range(num_pods):
+        best, best_eff = -1, -1
+        for i in range(n):
+            if not schedulable[i] or assigned[i] >= cap[i]:
+                continue
+            eff = normalize_score(int(scores[i]) - 10 * h(assigned[i]), MAX_NODE_SCORE, 0)
+            if eff > best_eff:
+                best, best_eff = i, eff
+        if best < 0:
+            unassigned += 1
+            continue
+        assigned[best] += 1
+    waterline = 0 if unassigned == 0 else -1
+    return GangResult(np.array(assigned, np.int32), unassigned, waterline)
+
+
+class GangScheduler:
+    """Jitted water-filling gang assignment.
+
+    Static over (policy hotValue table); jitted per (N,) shape with
+    ``num_pods`` and per-node capacity as traced inputs.
+    """
+
+    def __init__(self, hv_counts: Sequence[int]):
+        self._g = jnp.asarray(hot_penalty_steps(hv_counts))  # [11] int64
+        self._jit = jax.jit(self._assign_impl)
+
+    def __call__(self, scores, schedulable, num_pods, capacity=None) -> GangResult:
+        scores = jnp.asarray(scores, dtype=jnp.int32)
+        n = scores.shape[0]
+        if capacity is None:
+            capacity = jnp.full((n,), jnp.asarray(num_pods, _idtype()))
+        out = self._jit(
+            scores,
+            jnp.asarray(schedulable, dtype=jnp.bool_),
+            jnp.asarray(num_pods, dtype=_idtype()),
+            jnp.asarray(capacity, dtype=_idtype()),
+        )
+        return GangResult(*out)
+
+    def tokens_at_or_above(self, scores, k_cap, level):
+        """A_n(L): node n's tokens with value >= L (1 <= L <= 101).
+
+        value(t) >= L  <=>  S_n - 10 h(t) >= L  <=>  h(t) <= (S_n - L)//10
+        <=>  t < g[(S_n - L)//10].
+        """
+        s = scores.astype(_idtype())
+        x = jnp.clip((s - level) // 10, 0, 10)
+        unlocked = jnp.where(s >= level, self._g[x], 0)
+        return jnp.minimum(k_cap, unlocked)
+
+    def _assign_impl(self, scores, schedulable, num_pods, capacity):
+        n = scores.shape[0]
+        k_cap = jnp.where(schedulable, jnp.maximum(capacity, 0), 0)  # [N] i64
+        # No node ever needs more than num_pods tokens; clipping also keeps
+        # the level-total reductions far from integer overflow.
+        k_cap = jnp.minimum(k_cap, jnp.maximum(num_pods, 0))
+
+        # A[L, n] for L = 0..101; A[0] = all tokens (value >= 0), A[101] = 0.
+        levels = jnp.arange(102, dtype=_idtype())  # [102]
+        a_pos = jax.vmap(lambda lv: self.tokens_at_or_above(scores, k_cap, lv))(
+            levels
+        )  # [102, N] (level 0 row computed but replaced below)
+        a = a_pos.at[0].set(k_cap)
+
+        totals = a.sum(axis=1)  # [102] T(L), nonincreasing in L
+        meets = totals >= num_pods  # True for L <= L*
+        l_star = jnp.max(jnp.where(meets, levels, -1))  # -1 => capacity short
+
+        def full_capacity(_):
+            counts = k_cap
+            unassigned = num_pods - totals[0]
+            return counts, unassigned, jnp.asarray(-1, _idtype())
+
+        def waterline(l_star):
+            upper = jnp.take(a, l_star + 1, axis=0)  # tokens strictly above
+            exact = jnp.take(a, l_star, axis=0) - upper  # tokens at L*
+            remainder = num_pods - jnp.take(totals, l_star + 1)
+            prefix = jnp.cumsum(exact) - exact  # exclusive, node-index order
+            take = jnp.clip(remainder - prefix, 0, exact)
+            counts = upper + take
+            return counts, jnp.asarray(0, _idtype()), l_star
+
+        counts, unassigned, lvl = jax.lax.cond(
+            l_star < 0, full_capacity, waterline, l_star
+        )
+        return counts.astype(jnp.int32), unassigned, lvl

@@ -1,0 +1,109 @@
+"""Gang scheduler: water-filling batched assignment must exactly match the
+sequential greedy oracle (argmax with in-batch hot-value penalty)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from crane_scheduler_tpu.scorer.topk import (
+    GangScheduler,
+    gang_assign_oracle,
+    hot_penalty_steps,
+)
+
+DEFAULT_HV = [5, 2]  # default policy hotValue counts
+
+
+def test_hot_penalty_steps_default_policy():
+    g = hot_penalty_steps(DEFAULT_HV)
+    # h(c) = c//5 + c//2: h(0..1)=0, h(2)=1, h(4)=2, h(5)=3, h(6)=4 ...
+    assert g[0] == 2  # first c with h > 0
+    assert g[1] == 4
+    assert g[2] == 5
+    assert g[3] == 6
+
+
+def test_hot_penalty_steps_empty_unbounded():
+    g = hot_penalty_steps([])
+    assert (g > 10**9).all()
+    g = hot_penalty_steps([0])  # zero-count entries are invalid, skipped
+    assert (g > 10**9).all()
+
+
+def run_both(scores, schedulable, p, hv=DEFAULT_HV, capacity=None):
+    want = gang_assign_oracle(scores, schedulable, p, hv, capacity)
+    got = GangScheduler(hv)(scores, schedulable, p, capacity)
+    np.testing.assert_array_equal(
+        np.asarray(got.counts), want.counts,
+        err_msg=f"scores={scores} p={p} cap={capacity}",
+    )
+    assert int(got.unassigned) == want.unassigned
+    return got
+
+
+def test_simple_spread():
+    # Two equal nodes: penalty steps force alternation in blocks.
+    got = run_both([80, 80], [True, True], 6)
+    assert np.asarray(got.counts).sum() == 6
+
+
+def test_prefers_higher_score_until_penalty_equalizes():
+    got = run_both([90, 50], [True, True], 4)
+    # node 0 at 90 absorbs pods until its eff approaches 50.
+    assert np.asarray(got.counts)[0] >= 3
+
+
+def test_unschedulable_nodes_get_nothing():
+    got = run_both([90, 80, 70], [True, False, True], 5)
+    assert np.asarray(got.counts)[1] == 0
+
+
+def test_capacity_limits_and_unassigned():
+    got = run_both([90, 80], [True, True], 10, capacity=[3, 2])
+    assert np.asarray(got.counts).tolist() == [3, 2]
+    assert int(got.unassigned) == 5
+
+
+def test_all_unschedulable():
+    got = run_both([90, 80], [False, False], 4)
+    assert int(got.unassigned) == 4
+
+
+def test_no_hot_value_all_on_best_node():
+    # Without hotValue entries the penalty never kicks in: everything
+    # lands on the argmax (the reference's unmitigated behavior).
+    got = run_both([90, 80], [True, True], 50, hv=[])
+    assert np.asarray(got.counts).tolist() == [50, 0]
+
+
+def test_tie_break_lowest_index_first():
+    got = run_both([80, 80, 80], [True, True, True], 2, hv=[1])
+    # h(1) = 1 with count=1, so one token per node at level 80;
+    # pods go to nodes 0 and 1, not 2.
+    assert np.asarray(got.counts).tolist() == [1, 1, 0]
+
+
+def test_zero_scores_still_assign():
+    got = run_both([0, 0], [True, True], 3)
+    assert int(got.unassigned) == 0
+    assert np.asarray(got.counts).sum() == 3
+
+
+def test_zero_pods():
+    got = run_both([50, 60], [True, True], 0)
+    assert np.asarray(got.counts).sum() == 0
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_parity(seed):
+    rng = random.Random(seed)
+    n = rng.randint(1, 40)
+    scores = [rng.randint(0, 100) for _ in range(n)]
+    schedulable = [rng.random() > 0.2 for _ in range(n)]
+    p = rng.randint(0, 120)
+    hv = rng.choice([DEFAULT_HV, [1], [3, 7], [2, 2], []])
+    capacity = None
+    if rng.random() < 0.5:
+        capacity = [rng.randint(0, 20) for _ in range(n)]
+    run_both(scores, schedulable, p, hv, capacity)
