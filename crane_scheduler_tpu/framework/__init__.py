@@ -1,0 +1,19 @@
+from .types import (
+    Code,
+    Status,
+    CycleState,
+    NodeInfo,
+    Resource,
+    resource_from_requests,
+    pod_effective_request,
+)
+
+__all__ = [
+    "Code",
+    "Status",
+    "CycleState",
+    "NodeInfo",
+    "Resource",
+    "resource_from_requests",
+    "pod_effective_request",
+]
