@@ -1,0 +1,128 @@
+"""crane-annotator: the node-annotator controller entrypoint.
+
+Flags mirror the reference controller
+(ref: cmd/controller/app/options/options.go:61-76): policy file,
+Prometheus address, binding heap size, concurrent syncs, health port, and
+leader election (file-lock based). Without a kube API, nodes come from a
+JSON file (``--nodes-file``: [{"name": ..., "ip": ...}]) or a demo sim
+cluster (``--demo-nodes N`` with synthetic metrics).
+
+Usage:
+  python -m crane_scheduler_tpu.cli.annotator_main \
+      --policy-config-path policy.yaml --prometheus-address http://prom:9090 \
+      --nodes-file nodes.json [--leader-elect --lock-file /tmp/crane.lock]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import threading
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="crane-annotator")
+    parser.add_argument("--policy-config-path", default=None)
+    parser.add_argument("--prometheus-address", default="")
+    parser.add_argument("--binding-heap-size", type=int, default=1024)
+    parser.add_argument("--concurrent-syncs", type=int, default=1)
+    parser.add_argument("--health-port", type=int, default=8090)
+    parser.add_argument("--nodes-file", default=None)
+    parser.add_argument("--demo-nodes", type=int, default=0)
+    parser.add_argument("--leader-elect", action="store_true")
+    parser.add_argument("--lock-file", default="/tmp/crane-annotator.lock")
+    parser.add_argument("--run-seconds", type=float, default=0.0,
+                        help="exit after N seconds (0 = run forever)")
+    args = parser.parse_args(argv)
+
+    from ..annotator import AnnotatorConfig, NodeAnnotator
+    from ..cluster import ClusterState, Node, NodeAddress
+    from ..policy import DEFAULT_POLICY, load_policy_from_file
+    from ..service.http import HealthServer
+    from ..service.leader import LeaderElector
+
+    policy = (
+        load_policy_from_file(args.policy_config_path)
+        if args.policy_config_path
+        else DEFAULT_POLICY
+    )
+
+    cluster = ClusterState()
+    if args.nodes_file:
+        with open(args.nodes_file) as f:
+            for doc in json.load(f):
+                cluster.add_node(
+                    Node(
+                        name=doc["name"],
+                        addresses=(NodeAddress("InternalIP", doc.get("ip", doc["name"])),),
+                    )
+                )
+    elif args.demo_nodes:
+        for i in range(args.demo_nodes):
+            cluster.add_node(
+                Node(name=f"node-{i}", addresses=(NodeAddress("InternalIP", f"10.0.0.{i}"),))
+            )
+
+    if args.prometheus_address:
+        from ..metrics import PrometheusClient
+
+        metrics = PrometheusClient(args.prometheus_address)
+    else:
+        from ..metrics import FakeMetricsSource
+
+        metrics = FakeMetricsSource()
+        for node in cluster.list_nodes():
+            for sp in policy.spec.sync_period:
+                metrics.set(sp.name, node.internal_ip(), 0.25, by="ip")
+
+    annotator = NodeAnnotator(
+        cluster,
+        metrics,
+        policy,
+        AnnotatorConfig(
+            binding_heap_size=args.binding_heap_size,
+            concurrent_syncs=args.concurrent_syncs,
+        ),
+    )
+
+    health = HealthServer(port=args.health_port)
+    health.start()
+    print(f"healthz on :{health.port}", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+
+    def run_annotator(stop_event):
+        annotator.start()
+        stop_event.wait()
+        annotator.stop()
+
+    if args.leader_elect:
+        elector = LeaderElector(
+            args.lock_file,
+            identity=f"crane-annotator-{os.getpid()}",
+            on_started_leading=run_annotator,
+        )
+        thread = threading.Thread(target=elector.run, daemon=True)
+        thread.start()
+        print(f"leader election on {args.lock_file}", flush=True)
+    else:
+        threading.Thread(target=run_annotator, args=(stop,), daemon=True).start()
+
+    stop.wait(timeout=args.run_seconds or None)
+    stop.set()
+    health.stop()
+    print(
+        json.dumps(
+            {"synced": annotator.synced, "sync_errors": annotator.sync_errors}
+        ),
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

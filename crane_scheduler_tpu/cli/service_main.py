@@ -1,0 +1,70 @@
+"""crane-scorer: the TPU scoring sidecar entrypoint.
+
+Serves the scoring API (POST /v1/score, POST /v1/refresh, GET /metrics,
+GET /healthz) over the current cluster state. The demo mode builds a
+simulated cluster with one annotator pass so the service has data.
+
+Usage:
+  python -m crane_scheduler_tpu.cli.service_main --port 8080 --demo-nodes 100
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="crane-scorer")
+    parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument("--policy-config-path", default=None)
+    parser.add_argument("--demo-nodes", type=int, default=0)
+    parser.add_argument("--f32", action="store_true")
+    parser.add_argument("--run-seconds", type=float, default=0.0)
+    args = parser.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    if not args.f32:
+        jax.config.update("jax_enable_x64", True)
+
+    from ..policy import DEFAULT_POLICY, load_policy_from_file
+    from ..service import ScoringHTTPServer, ScoringService
+
+    policy = (
+        load_policy_from_file(args.policy_config_path)
+        if args.policy_config_path
+        else DEFAULT_POLICY
+    )
+
+    if args.demo_nodes:
+        from ..sim import SimConfig, Simulator
+
+        sim = Simulator(SimConfig(n_nodes=args.demo_nodes), policy=policy)
+        sim.sync_metrics()
+        cluster = sim.cluster
+    else:
+        from ..cluster import ClusterState
+
+        cluster = ClusterState()
+
+    service = ScoringService(
+        cluster, policy, dtype=jnp.float32 if args.f32 else jnp.float64
+    )
+    service.refresh()
+    server = ScoringHTTPServer(service, port=args.port)
+    server.start()
+    print(f"scoring service on :{server.port}", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait(timeout=args.run_seconds or None)
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

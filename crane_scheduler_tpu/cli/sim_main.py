@@ -1,0 +1,104 @@
+"""crane-sim: end-to-end simulated cluster scheduling.
+
+Runs the full loop (synthetic metrics -> annotator -> scorer -> binding
+feedback) in one of three scorer modes and reports placement + latency
+stats as JSON. The reference's equivalent "e2e" is manually applying
+examples/cpu_stress.yaml and watching for the Scheduled event
+(ref: README.md:155-197); this is that check, automated and at scale.
+
+Usage:
+  python -m crane_scheduler_tpu.cli.sim_main --nodes 100 --pods 200 \
+      --mode batch [--policy-file policy.yaml] [--sync-every 50]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time as _time
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="crane-sim")
+    parser.add_argument("--nodes", type=int, default=16)
+    parser.add_argument("--pods", type=int, default=64)
+    parser.add_argument("--mode", choices=["plugin", "batch", "sharded"], default="batch")
+    parser.add_argument("--policy-file", default=None)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--sync-every", type=int, default=0,
+                        help="re-run the annotator every K pods (plugin mode)")
+    parser.add_argument("--devices", type=int, default=0,
+                        help="sharded mode: mesh size (0 = all)")
+    parser.add_argument("--f32", action="store_true", help="float32 fast path")
+    args = parser.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    if not args.f32:
+        jax.config.update("jax_enable_x64", True)
+
+    from ..policy import DEFAULT_POLICY, load_policy_from_file
+    from ..sim import SimConfig, Simulator
+
+    policy = (
+        load_policy_from_file(args.policy_file) if args.policy_file else DEFAULT_POLICY
+    )
+    sim = Simulator(SimConfig(n_nodes=args.nodes, seed=args.seed), policy=policy)
+    sim.sync_metrics()
+
+    dtype = jnp.float32 if args.f32 else jnp.float64
+    latencies = []
+
+    if args.mode == "plugin":
+        sched = sim.build_scheduler()
+        for i in range(args.pods):
+            pod = sim.make_pod()
+            t0 = _time.perf_counter()
+            result = sched.schedule_one(pod)
+            latencies.append(_time.perf_counter() - t0)
+            sim.record(result.node)
+            sim.clock.advance(1.0)
+            if args.sync_every and (i + 1) % args.sync_every == 0:
+                sim.sync_metrics()
+    else:
+        mesh = None
+        if args.mode == "sharded":
+            from ..parallel import make_node_mesh
+
+            mesh = make_node_mesh(args.devices or None)
+        sched = sim.build_batch_scheduler(dtype=dtype, mesh=mesh)
+        pods = [sim.make_pod() for _ in range(args.pods)]
+        t0 = _time.perf_counter()
+        result = sched.schedule_batch(pods)
+        latencies.append(_time.perf_counter() - t0)
+        for pod in pods:
+            sim.record(result.assignments.get(pod.key()))
+
+    lat = np.array(latencies) if latencies else np.array([0.0])
+    top = sorted(sim.stats.placements.items(), key=lambda kv: -kv[1])[:5]
+    print(
+        json.dumps(
+            {
+                "mode": args.mode,
+                "nodes": args.nodes,
+                "pods": args.pods,
+                "scheduled": sim.stats.scheduled,
+                "unschedulable": sim.stats.unschedulable,
+                "distinct_nodes_used": len(sim.stats.placements),
+                "top_nodes": dict(top),
+                "latency_ms": {
+                    "mean": float(lat.mean() * 1e3),
+                    "p50": float(np.percentile(lat, 50) * 1e3),
+                    "p99": float(np.percentile(lat, 99) * 1e3),
+                },
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,258 @@
+"""The scheduling loop: scheduleOne-equivalent plus a TPU batch mode.
+
+``Scheduler`` drives the plugin extension points in the reference order
+(ref: k8s scheduleOne, SURVEY §3.4/3.5):
+
+    PreFilter -> Filter (all candidate nodes) -> Score (feasible nodes,
+    weighted sum across score plugins) -> select host -> Reserve ->
+    PreBind -> bind (emits the Scheduled event that feeds hot values).
+
+Host selection takes the max weighted score; the reference picks randomly
+among tied winners — we take the lowest node index for determinism (the
+property the parity suite checks is score equality, which is preserved).
+
+``BatchScheduler`` is the TPU-native mode: one bulk store refresh, one
+fused filter+score over the node-by-metric matrix, and water-filling gang
+assignment for the whole pending batch, then binding through the same
+cluster API (so hot-value feedback still flows through events). Its
+per-node verdicts are bit-identical to ``Scheduler`` with the Dynamic
+plugin — that is the framework's core acceptance criterion.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from ..cluster.state import ClusterState, Pod
+from ..framework.types import Code, CycleState, NodeInfo, Status
+from ..loadstore.store import NodeLoadStore
+from ..parallel.mesh import make_node_mesh
+from ..policy.compile import compile_policy
+from ..policy.types import DynamicSchedulerPolicy
+from ..scorer.batched import BatchedScorer
+from ..scorer.topk import GangScheduler
+
+
+@dataclass
+class ScheduleResult:
+    pod_key: str
+    node: str | None
+    feasible: int
+    reason: str = ""
+    scores: dict = field(default_factory=dict)
+
+
+@dataclass
+class _WeightedPlugin:
+    plugin: object
+    weight: int = 1
+
+
+class Scheduler:
+    """Plugin-driven single-pod scheduler (the reference-shaped path)."""
+
+    def __init__(self, cluster: ClusterState, clock=time.time):
+        self.cluster = cluster
+        self._clock = clock
+        self._plugins: list[_WeightedPlugin] = []
+
+    def register(self, plugin, weight: int = 1) -> None:
+        """Order matters like the scheduler-config plugin list
+        (deploy/manifests: Dynamic weight 3, NRT weight 2)."""
+        self._plugins.append(_WeightedPlugin(plugin, weight))
+
+    def snapshot(self) -> list[NodeInfo]:
+        pods_by_node: dict[str, list[Pod]] = {}
+        for pod in self.cluster.list_pods():
+            if pod.node_name:
+                pods_by_node.setdefault(pod.node_name, []).append(pod)
+        return [
+            NodeInfo(node=node, pods=pods_by_node.get(node.name, []))
+            for node in self.cluster.list_nodes()
+        ]
+
+    def schedule_one(self, pod: Pod) -> ScheduleResult:
+        state = CycleState()
+        nodes = self.snapshot()
+
+        # PreFilter
+        for wp in self._plugins:
+            pre = getattr(wp.plugin, "pre_filter", None)
+            if pre is not None:
+                status = pre(state, pod)
+                if not status.ok():
+                    return ScheduleResult(pod.key(), None, 0, status.reason)
+
+        # Filter
+        feasible: list[NodeInfo] = []
+        last_reason = ""
+        for node_info in nodes:
+            verdict = Status.success()
+            for wp in self._plugins:
+                flt = getattr(wp.plugin, "filter", None)
+                if flt is None:
+                    continue
+                status = flt(state, pod, node_info)
+                if not status.ok():
+                    verdict = status
+                    break
+            if verdict.ok():
+                feasible.append(node_info)
+            else:
+                last_reason = verdict.reason
+        if not feasible:
+            return ScheduleResult(pod.key(), None, 0, last_reason or "no feasible nodes")
+
+        # Score: weighted sum over score plugins
+        totals: dict[str, int] = {}
+        for node_info in feasible:
+            total = 0
+            for wp in self._plugins:
+                scr = getattr(wp.plugin, "score", None)
+                if scr is None:
+                    continue
+                try:
+                    value, status = scr(state, pod, node_info)
+                except TypeError:
+                    value, status = scr(state, pod, node_info.node.name)
+                if not status.ok():
+                    value = 0
+                total += value * wp.weight
+            totals[node_info.node.name] = total
+
+        # select host: max score, first (snapshot order) among ties
+        best = max(feasible, key=lambda ni: totals[ni.node.name])
+        best_name = best.node.name
+
+        # Reserve
+        for wp in self._plugins:
+            rsv = getattr(wp.plugin, "reserve", None)
+            if rsv is not None:
+                status = rsv(state, pod, best_name)
+                if not status.ok():
+                    self._unreserve(state, pod, best_name)
+                    return ScheduleResult(pod.key(), None, len(feasible), status.reason)
+
+        # PreBind
+        for wp in self._plugins:
+            pb = getattr(wp.plugin, "pre_bind", None)
+            if pb is not None:
+                status = pb(state, pod, best_name)
+                if not status.ok():
+                    self._unreserve(state, pod, best_name)
+                    return ScheduleResult(pod.key(), None, len(feasible), status.reason)
+
+        self.cluster.bind_pod(pod.key(), best_name, self._clock())
+        return ScheduleResult(pod.key(), best_name, len(feasible), scores=totals)
+
+    def _unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        for wp in self._plugins:
+            un = getattr(wp.plugin, "unreserve", None)
+            if un is not None:
+                un(state, pod, node_name)
+
+
+@dataclass
+class BatchResult:
+    assignments: dict  # pod_key -> node name
+    unassigned: list  # pod keys with no capacity
+    scores: dict  # node name -> int score
+    schedulable: dict  # node name -> bool
+
+
+class BatchScheduler:
+    """TPU-native burst mode: bulk refresh -> fused score -> gang assign.
+
+    The Dynamic score is pod-independent, so a burst of non-DaemonSet pods
+    shares one score vector; placement spreads via the in-batch hot-value
+    penalty (see scorer.topk). DaemonSet pods bypass Filter per the
+    reference and are scheduled individually by the caller.
+    """
+
+    def __init__(
+        self,
+        cluster: ClusterState,
+        policy: DynamicSchedulerPolicy,
+        dtype=None,
+        mesh=None,
+        clock=time.time,
+        snapshot_bucket: int = 2048,
+    ):
+        import jax.numpy as jnp
+
+        self.cluster = cluster
+        self.policy = policy
+        self.tensors = compile_policy(policy)
+        self.store = NodeLoadStore(self.tensors)
+        self._clock = clock
+        self._bucket = snapshot_bucket
+        dtype = dtype or jnp.float64
+        if mesh is None:
+            self.scorer = BatchedScorer(self.tensors, dtype=dtype)
+            self.gang = GangScheduler(self.tensors.hv_count)
+            self._sharded = None
+        else:
+            from ..parallel.sharded import ShardedScheduleStep
+
+            self._sharded = ShardedScheduleStep(self.tensors, mesh, dtype=dtype)
+            self.scorer = self._sharded.scorer
+            self.gang = self._sharded.gang
+
+    def refresh(self) -> None:
+        """Bulk re-ingest node annotations (the store is a cache)."""
+        seen = set()
+        for node in self.cluster.list_nodes():
+            self.store.ingest_node_annotations(node.name, node.annotations)
+            seen.add(node.name)
+        for name in set(self.store.node_names) - seen:
+            self.store.remove_node(name)
+
+    def schedule_batch(self, pods: list[Pod], bind: bool = True) -> BatchResult:
+        import numpy as np
+
+        now = self._clock()
+        self.refresh()
+        snap = self.store.snapshot(bucket=self._bucket)
+        n = snap.n_nodes
+
+        if self._sharded is not None:
+            prepared = self._sharded.prepare(snap, now)
+            res = self._sharded(prepared, len(pods))
+            schedulable = np.asarray(res.schedulable)[:n]
+            scores = np.asarray(res.scores)[:n]
+            counts = np.asarray(res.counts)[:n]
+            unassigned_count = int(res.unassigned)
+        else:
+            sres = self.scorer(
+                snap.values, snap.ts, snap.hot_value, snap.hot_ts, snap.node_valid, now
+            )
+            schedulable = np.asarray(sres.schedulable)[:n]
+            scores = np.asarray(sres.scores)[:n]
+            gres = self.gang(scores, schedulable, len(pods))
+            counts = np.asarray(gres.counts)[:n]
+            unassigned_count = int(gres.unassigned)
+
+        # expand per-node counts into the sequential pod order (pods are
+        # interchangeable within a batch; see scorer.topk docstring)
+        names = snap.node_names
+        assignments: dict[str, str] = {}
+        unassigned: list[str] = []
+        order: list[str] = []
+        for node_idx in np.argsort(-scores, kind="stable"):
+            order.extend([names[node_idx]] * int(counts[node_idx]))
+        for pod, node_name in zip(pods, order):
+            assignments[pod.key()] = node_name
+        for pod in pods[len(order):]:
+            unassigned.append(pod.key())
+
+        if bind:
+            for pod_key, node_name in assignments.items():
+                self.cluster.bind_pod(pod_key, node_name, now)
+
+        return BatchResult(
+            assignments=assignments,
+            unassigned=unassigned,
+            scores={names[i]: int(scores[i]) for i in range(n)},
+            schedulable={names[i]: bool(schedulable[i]) for i in range(n)},
+        )

@@ -1,0 +1,3 @@
+from .dynamic import DynamicPlugin
+
+__all__ = ["DynamicPlugin"]

@@ -1,0 +1,57 @@
+"""The Dynamic plugin: load-aware Filter + Score.
+
+ref: pkg/plugins/dynamic/plugins.go — the in-process scalar path, reading
+node annotations from the informer snapshot through the parity oracle.
+This is the safe fallback scorer; the TPU-batched path
+(``service.ScoringService`` / ``framework.BatchScheduler``) computes the
+identical function over the whole cluster at once and is validated
+bit-for-bit against this plugin.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..cluster.state import Pod
+from ..framework.types import CycleState, NodeInfo, Status
+from ..policy.types import DynamicSchedulerPolicy
+from ..policy.v1alpha1 import load_policy_from_file
+from ..scorer import oracle
+
+PLUGIN_NAME = "Dynamic"
+
+
+class DynamicPlugin:
+    def __init__(self, policy: DynamicSchedulerPolicy, clock=time.time):
+        self.policy = policy
+        self._clock = clock
+
+    @classmethod
+    def from_policy_file(cls, path: str) -> "DynamicPlugin":
+        """ref: plugins.go:105-120 (DynamicArgs.PolicyConfigPath)."""
+        return cls(load_policy_from_file(path))
+
+    @property
+    def name(self) -> str:
+        return PLUGIN_NAME
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        """ref: plugins.go:39-69."""
+        if pod.is_daemonset_pod():
+            return Status.success()
+        if node_info.node is None:
+            return Status.error("node not found")
+        anno = dict(node_info.node.annotations or {})
+        ok, metric = oracle.filter_node(anno, self.policy.spec, self._clock())
+        if not ok:
+            return Status.unschedulable(
+                f"Load[{metric}] of node[{node_info.node.name}] is too high"
+            )
+        return Status.success()
+
+    def score(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> tuple[int, Status]:
+        """ref: plugins.go:73-98."""
+        if node_info.node is None:
+            return 0, Status.error("node not found")
+        anno = dict(node_info.node.annotations or {})
+        return oracle.score_node(anno, self.policy.spec, self._clock()), Status.success()

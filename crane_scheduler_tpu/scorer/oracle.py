@@ -151,7 +151,7 @@ def filter_node(
     now: float,
     is_daemonset_pod: bool = False,
 ) -> tuple[bool, str]:
-    """Dynamic Filter: returns (schedulable, reason)
+    """Dynamic Filter: returns (schedulable, failing_metric_name)
     (ref: plugins.go:39-69)."""
     if is_daemonset_pod:
         return True, ""
@@ -162,7 +162,7 @@ def filter_node(
         if active_duration == 0:
             continue  # ref: plugins.go:57-61
         if is_overload(anno, predicate, active_duration, now):
-            return False, f"Load[{predicate.name}] of node is too high"
+            return False, predicate.name
     return True, ""
 
 

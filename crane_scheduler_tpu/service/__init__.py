@@ -1,0 +1,5 @@
+from .scoring import ScoringService
+from .leader import LeaderElector
+from .http import ScoringHTTPServer, HealthServer
+
+__all__ = ["ScoringService", "LeaderElector", "ScoringHTTPServer", "HealthServer"]

@@ -1,0 +1,126 @@
+"""HTTP surfaces: health endpoint + the sidecar scoring API.
+
+- ``HealthServer``: ``/healthz`` on the controller health port (default
+  8090, ref: cmd/controller/app/server.go:78-84, options.go:54).
+- ``ScoringHTTPServer``: the sidecar boundary — ``POST /v1/score``
+  evaluates the current store (optionally refreshing first) and returns
+  per-node verdicts; ``GET /metrics`` exports the counters the reference
+  never had; ``GET /healthz`` for probes.
+
+Stdlib-only (http.server with a thread pool via ThreadingHTTPServer).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .scoring import ScoringService
+
+
+class _Handler(BaseHTTPRequestHandler):
+    service: ScoringService = None  # set by server factory
+
+    def _send(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._send(200, {"status": "ok"})
+        elif self.path == "/metrics":
+            self._send(200, self.service.metrics())
+        else:
+            self._send(404, {"error": "not found"})
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length else b"{}"
+        try:
+            req = json.loads(raw or b"{}")
+        except ValueError:
+            self._send(400, {"error": "invalid JSON"})
+            return
+        if self.path == "/v1/score":
+            if req.get("refresh", True):
+                self.service.refresh()
+            verdicts = self.service.score_batch(now=req.get("now"))
+            self._send(
+                200,
+                {
+                    "backend": verdicts.backend,
+                    "stalenessSeconds": verdicts.staleness_seconds,
+                    "schedulable": verdicts.schedulable,
+                    "scores": verdicts.scores,
+                },
+            )
+        elif self.path == "/v1/refresh":
+            self.service.refresh()
+            self._send(200, {"status": "ok", "nodes": len(self.service.store)})
+        else:
+            self._send(404, {"error": "not found"})
+
+    def log_message(self, *args):
+        pass
+
+
+class ScoringHTTPServer:
+    def __init__(self, service: ScoringService, host: str = "127.0.0.1", port: int = 0):
+        handler = type("BoundHandler", (_Handler,), {"service": service})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_port
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+
+class HealthServer:
+    """Bare /healthz, matching the controller's probe surface."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8090):
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path == "/healthz":
+                    body = b"ok"
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+
+            def log_message(self, *args):
+                pass
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_port
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=2.0)

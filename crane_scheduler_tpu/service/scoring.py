@@ -1,0 +1,156 @@
+"""The scoring sidecar: batch scores in, verdicts out, fail-open always.
+
+The north-star deployment keeps the scheduler-framework plugin boundary
+and ships per-node load vectors to a TPU process (BASELINE.md). This
+service is that boundary: it owns the device-resident load store and the
+jitted scorer, and exposes ``score_batch``. Its contract mirrors the
+reference's most load-bearing invariant — **fail-open** (SURVEY §5):
+
+- if the TPU path raises, fall back to the scalar oracle per node and
+  return identical verdicts (the two are parity-tested);
+- staleness is data, not liveness: a dead annotator degrades scores to 0
+  within the policy windows without blocking scheduling;
+- counters expose scorer latency/staleness/fallbacks — the observability
+  the reference lacks (it exports no metrics endpoint at all).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..cluster.state import ClusterState
+from ..policy.compile import compile_policy
+from ..policy.types import DynamicSchedulerPolicy
+from ..loadstore.store import NodeLoadStore
+from ..scorer import oracle
+from ..scorer.batched import BatchedScorer
+
+
+@dataclass
+class ServiceStats:
+    refreshes: int = 0
+    score_calls: int = 0
+    fallbacks: int = 0
+    last_refresh_at: float = 0.0
+    last_score_seconds: float = 0.0
+    score_seconds_total: float = 0.0
+    latencies: list = field(default_factory=list)  # rolling window
+
+
+@dataclass
+class BatchVerdicts:
+    schedulable: dict  # node -> bool
+    scores: dict  # node -> int
+    backend: str  # "tpu" | "oracle-fallback"
+    staleness_seconds: float
+
+
+class ScoringService:
+    def __init__(
+        self,
+        cluster: ClusterState,
+        policy: DynamicSchedulerPolicy,
+        dtype=None,
+        clock=time.time,
+        snapshot_bucket: int = 2048,
+    ):
+        import jax.numpy as jnp
+
+        self.cluster = cluster
+        self.policy = policy
+        self.tensors = compile_policy(policy)
+        self.store = NodeLoadStore(self.tensors)
+        self.scorer = BatchedScorer(self.tensors, dtype=dtype or jnp.float64)
+        self.stats = ServiceStats()
+        self._bucket = snapshot_bucket
+        self._clock = clock
+        self._lock = threading.RLock()
+
+    def refresh(self) -> None:
+        """Bulk re-read of node annotations into the columnar store."""
+        with self._lock:
+            seen = set()
+            for node in self.cluster.list_nodes():
+                self.store.ingest_node_annotations(node.name, node.annotations)
+                seen.add(node.name)
+            for name in set(self.store.node_names) - seen:
+                self.store.remove_node(name)
+            self.stats.refreshes += 1
+            self.stats.last_refresh_at = self._clock()
+
+    def score_batch(self, now: float | None = None) -> BatchVerdicts:
+        """Score every node; never raises (fail-open to the oracle)."""
+        if now is None:
+            now = self._clock()
+        start = time.perf_counter()
+        with self._lock:
+            self.stats.score_calls += 1
+            staleness = (
+                now - self.stats.last_refresh_at if self.stats.last_refresh_at else -1.0
+            )
+            try:
+                verdicts = self._score_tpu(now)
+            except Exception:
+                self.stats.fallbacks += 1
+                verdicts = self._score_oracle(now)
+            elapsed = time.perf_counter() - start
+            self.stats.last_score_seconds = elapsed
+            self.stats.score_seconds_total += elapsed
+            self.stats.latencies.append(elapsed)
+            if len(self.stats.latencies) > 1024:
+                del self.stats.latencies[:512]
+        verdicts.staleness_seconds = staleness
+        return verdicts
+
+    def _score_tpu(self, now: float) -> BatchVerdicts:
+        import numpy as np
+
+        snap = self.store.snapshot(bucket=self._bucket)
+        res = self.scorer(
+            snap.values, snap.ts, snap.hot_value, snap.hot_ts, snap.node_valid, now
+        )
+        schedulable = np.asarray(res.schedulable)
+        scores = np.asarray(res.scores)
+        n = snap.n_nodes
+        return BatchVerdicts(
+            schedulable={snap.node_names[i]: bool(schedulable[i]) for i in range(n)},
+            scores={snap.node_names[i]: int(scores[i]) for i in range(n)},
+            backend="tpu",
+            staleness_seconds=0.0,
+        )
+
+    def _score_oracle(self, now: float) -> BatchVerdicts:
+        """The in-process scalar path (ref semantics, always available)."""
+        schedulable: dict[str, bool] = {}
+        scores: dict[str, int] = {}
+        for node in self.cluster.list_nodes():
+            anno = dict(node.annotations or {})
+            ok, _ = oracle.filter_node(anno, self.policy.spec, now)
+            schedulable[node.name] = ok
+            scores[node.name] = oracle.score_node(anno, self.policy.spec, now)
+        return BatchVerdicts(
+            schedulable=schedulable,
+            scores=scores,
+            backend="oracle-fallback",
+            staleness_seconds=0.0,
+        )
+
+    def metrics(self) -> dict:
+        """Exported counters (SURVEY §5: the reference has none)."""
+        import numpy as np
+
+        with self._lock:
+            lat = sorted(self.stats.latencies)
+            p99 = lat[int(0.99 * (len(lat) - 1))] if lat else 0.0
+            return {
+                "refreshes": self.stats.refreshes,
+                "score_calls": self.stats.score_calls,
+                "fallbacks": self.stats.fallbacks,
+                "last_refresh_at": self.stats.last_refresh_at,
+                "last_score_seconds": self.stats.last_score_seconds,
+                "score_seconds_total": self.stats.score_seconds_total,
+                "score_p99_seconds": float(p99),
+                "nodes": len(self.store),
+            }

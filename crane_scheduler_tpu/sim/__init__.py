@@ -1,0 +1,3 @@
+from .simulator import SimClock, Simulator, SimConfig
+
+__all__ = ["SimClock", "Simulator", "SimConfig"]

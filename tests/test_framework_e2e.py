@@ -1,0 +1,267 @@
+"""End-to-end framework tests: plugin scheduler vs TPU batch scheduler,
+scoring service with fail-open fallback, HTTP sidecar, leader election,
+and the closed metric/hot-value feedback loop (BASELINE configs #1-#3)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from crane_scheduler_tpu.cluster import Pod
+from crane_scheduler_tpu.plugins import DynamicPlugin
+from crane_scheduler_tpu.policy import DEFAULT_POLICY
+from crane_scheduler_tpu.scorer import oracle
+from crane_scheduler_tpu.sim import SimClock, SimConfig, Simulator
+
+
+def make_sim(n_nodes=3, seed=0):
+    sim = Simulator(SimConfig(n_nodes=n_nodes, seed=seed))
+    sim.sync_metrics()
+    return sim
+
+
+# --- BASELINE config #1: single cpu-stress pod, 3-node sim cluster ---------
+
+
+def test_single_pod_lands_on_least_loaded_node():
+    sim = make_sim(3)
+    sched = sim.build_scheduler()
+    pod = sim.make_pod(cpu_milli=1000)
+    result = sched.schedule_one(pod)
+    assert result.node is not None
+    # the chosen node has the max oracle score
+    now = sim.clock.now()
+    best = max(
+        sim.cluster.list_nodes(),
+        key=lambda n: oracle.score_node(dict(n.annotations), DEFAULT_POLICY.spec, now),
+    )
+    assert result.node == best.name
+    # binding emitted a Scheduled event that feeds hot values
+    assert sim.annotator.binding_records.get_last_node_binding_count(
+        result.node, 300.0, now
+    ) == 1
+
+
+def test_plugin_and_batch_scores_identical():
+    sim = make_sim(12, seed=3)
+    sched = sim.build_scheduler()
+    batch = sim.build_batch_scheduler()
+    pod = sim.make_pod()
+    plugin_result = sched.schedule_one(pod)
+    bres = batch.schedule_batch([], bind=False)
+    # plugin total = oracle score * weight 3
+    for node_name, total in plugin_result.scores.items():
+        assert total == bres.scores[node_name] * 3
+
+
+def test_batch_schedule_binds_and_spreads():
+    sim = make_sim(8, seed=1)
+    batch = sim.build_batch_scheduler()
+    pods = [sim.make_pod() for _ in range(40)]
+    result = batch.schedule_batch(pods)
+    assert len(result.assignments) == 40
+    assert not result.unassigned
+    # in-batch hot penalty spreads the burst across several nodes
+    used = {n for n in result.assignments.values()}
+    assert len(used) >= 3
+    # bindings actually landed in the cluster
+    bound = [p for p in sim.cluster.list_pods() if p.node_name]
+    assert len(bound) == 40
+
+
+def test_batch_matches_sequential_greedy_oracle():
+    from crane_scheduler_tpu.scorer.topk import gang_assign_oracle
+    from crane_scheduler_tpu.policy import compile_policy
+
+    sim = make_sim(10, seed=5)
+    batch = sim.build_batch_scheduler()
+    bres = batch.schedule_batch([], bind=False)
+    tensors = compile_policy(DEFAULT_POLICY)
+    names = sorted(bres.scores)  # store order != sorted, use store order:
+    names = list(batch.store.node_names)
+    scores = [bres.scores[n] for n in names]
+    schedulable = [bres.schedulable[n] for n in names]
+    want = gang_assign_oracle(scores, schedulable, 25, list(tensors.hv_count))
+    pods = [sim.make_pod() for _ in range(25)]
+    result = batch.schedule_batch(pods, bind=False)
+    got_counts = {}
+    for node in result.assignments.values():
+        got_counts[node] = got_counts.get(node, 0) + 1
+    for i, name in enumerate(names):
+        assert got_counts.get(name, 0) == int(want.counts[i]), name
+
+
+def test_feedback_loop_hot_value_penalizes_hot_node():
+    sim = make_sim(4, seed=2)
+    sched = sim.build_scheduler()
+    # schedule a burst one-by-one with a metric sync after each bind
+    first = sched.schedule_one(sim.make_pod()).node
+    for _ in range(6):
+        sched.schedule_one(sim.make_pod())
+        sim.clock.advance(1.0)
+    sim.sync_metrics()  # hot values now reflect recent bindings
+    hot_anno = sim.cluster.get_node(first).annotations["node_hot_value"]
+    hot = int(hot_anno.split(",")[0])
+    assert hot >= 1  # the popular node became "hot"
+    score_now = oracle.score_node(
+        dict(sim.cluster.get_node(first).annotations),
+        DEFAULT_POLICY.spec,
+        sim.clock.now(),
+    )
+    # and its score dropped by at least the hot penalty
+    assert score_now <= 100 - 10 * hot
+
+
+# --- scoring service / sidecar ---------------------------------------------
+
+
+def test_scoring_service_matches_oracle_and_metrics():
+    from crane_scheduler_tpu.service import ScoringService
+
+    sim = make_sim(6, seed=4)
+    svc = ScoringService(sim.cluster, DEFAULT_POLICY)
+    svc.refresh()
+    verdicts = svc.score_batch(now=sim.clock.now())
+    assert verdicts.backend == "tpu"
+    for node in sim.cluster.list_nodes():
+        anno = dict(node.annotations)
+        assert verdicts.scores[node.name] == oracle.score_node(
+            anno, DEFAULT_POLICY.spec, sim.clock.now()
+        )
+    m = svc.metrics()
+    assert m["score_calls"] == 1 and m["fallbacks"] == 0 and m["nodes"] == 6
+
+
+def test_scoring_service_fail_open_fallback():
+    from crane_scheduler_tpu.service import ScoringService
+
+    sim = make_sim(4, seed=6)
+    svc = ScoringService(sim.cluster, DEFAULT_POLICY)
+    svc.refresh()
+
+    def boom(*a, **k):
+        raise RuntimeError("TPU unavailable")
+
+    svc.scorer = type("Broken", (), {"__call__": boom})()
+    verdicts = svc.score_batch(now=sim.clock.now())
+    assert verdicts.backend == "oracle-fallback"
+    # identical verdicts from the fallback path
+    for node in sim.cluster.list_nodes():
+        assert verdicts.scores[node.name] == oracle.score_node(
+            dict(node.annotations), DEFAULT_POLICY.spec, sim.clock.now()
+        )
+    assert svc.metrics()["fallbacks"] == 1
+
+
+def test_scoring_http_server():
+    from crane_scheduler_tpu.service import ScoringHTTPServer, ScoringService
+
+    sim = make_sim(3, seed=7)
+    svc = ScoringService(sim.cluster, DEFAULT_POLICY)
+    server = ScoringHTTPServer(svc, port=0)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as r:
+            assert json.load(r)["status"] == "ok"
+        req = urllib.request.Request(
+            f"{base}/v1/score",
+            data=json.dumps({"now": sim.clock.now()}).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            payload = json.load(r)
+        assert payload["backend"] == "tpu"
+        assert len(payload["scores"]) == 3
+        for node in sim.cluster.list_nodes():
+            assert payload["scores"][node.name] == oracle.score_node(
+                dict(node.annotations), DEFAULT_POLICY.spec, sim.clock.now()
+            )
+        with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
+            assert json.load(r)["score_calls"] == 1
+    finally:
+        server.stop()
+
+
+def test_leader_election_single_winner(tmp_path):
+    from crane_scheduler_tpu.service import LeaderElector
+
+    lock = str(tmp_path / "leader.lock")
+    winners = []
+    stops = []
+
+    def make_callback(name):
+        def cb(stop_event):
+            winners.append(name)
+            stop_event.wait()
+
+        return cb
+
+    electors = [
+        LeaderElector(lock, identity=f"cand-{i}", on_started_leading=make_callback(i),
+                      retry_period=0.05)
+        for i in range(3)
+    ]
+    threads = [threading.Thread(target=e.run, daemon=True) for e in electors]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    assert len(winners) == 1  # exactly one leader
+    leader = winners[0]
+    # leader releases; someone else takes over
+    electors[leader].stop()
+    time.sleep(0.5)
+    assert len(winners) == 2
+    for e in electors:
+        e.stop()
+
+
+# --- combined Dynamic + NUMA scheduling ------------------------------------
+
+
+def test_combined_plugins_schedule():
+    from crane_scheduler_tpu.cluster import Container, ResourceRequirements
+    from crane_scheduler_tpu.topology import TopologyMatch
+    from crane_scheduler_tpu.topology.types import (
+        CPU_MANAGER_POLICY_STATIC,
+        TOPOLOGY_MANAGER_POLICY_SINGLE_NUMA_POD,
+        CraneManagerPolicy,
+        InMemoryNRTLister,
+        NodeResourceTopology,
+        Zone,
+        ZoneResourceInfo,
+    )
+
+    sim = make_sim(3, seed=8)
+    lister = InMemoryNRTLister()
+    for node in sim.cluster.list_nodes():
+        lister.upsert(
+            NodeResourceTopology(
+                name=node.name,
+                crane_manager_policy=CraneManagerPolicy(
+                    CPU_MANAGER_POLICY_STATIC,
+                    TOPOLOGY_MANAGER_POLICY_SINGLE_NUMA_POD,
+                ),
+                zones=(
+                    Zone("numa-0", resources=ZoneResourceInfo(allocatable={"cpu": "4", "memory": "8Gi"})),
+                    Zone("numa-1", resources=ZoneResourceInfo(allocatable={"cpu": "4", "memory": "8Gi"})),
+                ),
+            )
+        )
+    sched = sim.build_scheduler()
+    sched.register(
+        TopologyMatch(lister, cluster=sim.cluster), weight=2
+    )  # ref manifests: Dynamic weight 3, NRT weight 2
+    pod = sim.make_pod(cpu_milli=2000)  # guaranteed 2 cores
+    result = sched.schedule_one(pod)
+    assert result.node is not None
+    bound = sim.cluster.get_pod(pod.key())
+    from crane_scheduler_tpu.topology.helper import get_pod_numa_node_result
+
+    zones = get_pod_numa_node_result(bound)
+    assert len(zones) == 1  # single-NUMA placement recorded on the pod
