@@ -48,6 +48,9 @@ class AnnotatorConfig:
 
     binding_heap_size: int = DEFAULT_BINDING_HEAP_SIZE
     concurrent_syncs: int = DEFAULT_CONCURRENT_SYNCS
+    # Prefer the C++ binding heap (one-pass batch counts) when the native
+    # library builds; the Python heap is the always-available fallback.
+    use_native_bindings: bool = True
 
 
 def _split_meta_key(key: str) -> tuple[str, str]:
@@ -74,10 +77,22 @@ class NodeAnnotator:
         self.metrics = metrics
         self.policy = policy
         self.config = config or AnnotatorConfig()
-        self.binding_records = BindingRecords(
-            self.config.binding_heap_size,
-            max_hot_value_time_range(policy.spec.hot_value),
-        )
+        self.binding_records = None
+        if self.config.use_native_bindings:
+            try:
+                from ..native.bindings import NativeBindingRecords
+
+                self.binding_records = NativeBindingRecords(
+                    self.config.binding_heap_size,
+                    max_hot_value_time_range(policy.spec.hot_value),
+                )
+            except Exception:
+                self.binding_records = None
+        if self.binding_records is None:
+            self.binding_records = BindingRecords(
+                self.config.binding_heap_size,
+                max_hot_value_time_range(policy.spec.hot_value),
+            )
         self.event_ingestor = EventIngestor(cluster, self.binding_records)
         self.queue = RateLimitedQueue()
         self.synced = 0
@@ -159,10 +174,9 @@ class NodeAnnotator:
         """Bulk re-ingest every node's annotations into the columnar store
         (cold-start = full re-read; the store is a cache, never the source
         of truth — SURVEY §5)."""
-        seen = set()
-        for node in self.cluster.list_nodes():
-            store.ingest_node_annotations(node.name, node.annotations)
-            seen.add(node.name)
+        nodes = self.cluster.list_nodes()
+        store.bulk_ingest((n.name, n.annotations) for n in nodes)
+        seen = {n.name for n in nodes}
         for name in set(store.node_names) - seen:
             store.remove_node(name)
 

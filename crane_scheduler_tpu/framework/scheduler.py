@@ -201,10 +201,9 @@ class BatchScheduler:
 
     def refresh(self) -> None:
         """Bulk re-ingest node annotations (the store is a cache)."""
-        seen = set()
-        for node in self.cluster.list_nodes():
-            self.store.ingest_node_annotations(node.name, node.annotations)
-            seen.add(node.name)
+        nodes = self.cluster.list_nodes()
+        self.store.bulk_ingest((n.name, n.annotations) for n in nodes)
+        seen = {n.name for n in nodes}
         for name in set(self.store.node_names) - seen:
             self.store.remove_node(name)
 

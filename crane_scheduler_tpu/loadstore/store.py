@@ -196,6 +196,45 @@ class NodeLoadStore:
         self.hot_value[ids] = values
         self.hot_ts[ids] = ts
 
+    def bulk_ingest(self, items) -> None:
+        """Ingest many (node_name, annotation_map) pairs with one native
+        parse call (falls back to the Python codec transparently).
+
+        Semantics identical to calling ``ingest_node_annotations`` per
+        node: each map is authoritative for its node.
+        """
+        from ..native.codec import bulk_parse_annotations
+
+        raws: list[str | None] = []
+        slots: list[tuple[int, int]] = []  # (row, col); col -1 == hot value
+        for name, anno in items:
+            i = self.add_node(name)
+            self.values[i, :] = np.nan
+            self.ts[i, :] = _NEG_INF
+            self.hot_value[i] = np.nan
+            self.hot_ts[i] = _NEG_INF
+            if not anno:
+                continue
+            for key, raw in anno.items():
+                if key == NODE_HOT_VALUE_KEY:
+                    raws.append(raw)
+                    slots.append((i, -1))
+                else:
+                    col = self.tensors.metric_index.get(key)
+                    if col is not None:
+                        raws.append(raw)
+                        slots.append((i, col))
+        if not raws:
+            return
+        values, ts = bulk_parse_annotations(raws)
+        for k, (row, col) in enumerate(slots):
+            if col < 0:
+                self.hot_value[row] = values[k]
+                self.hot_ts[row] = ts[k]
+            else:
+                self.values[row, col] = values[k]
+                self.ts[row, col] = ts[k]
+
     # -- snapshot ----------------------------------------------------------
 
     def snapshot(self, bucket: int = 2048) -> DeviceSnapshot:

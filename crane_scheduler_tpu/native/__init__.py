@@ -1,0 +1,10 @@
+from .lib import load_native, native_available
+from .bindings import NativeBindingRecords
+from .codec import bulk_parse_annotations
+
+__all__ = [
+    "load_native",
+    "native_available",
+    "NativeBindingRecords",
+    "bulk_parse_annotations",
+]

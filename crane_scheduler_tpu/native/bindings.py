@@ -1,0 +1,97 @@
+"""Native-backed binding records (drop-in for annotator.BindingRecords).
+
+Same semantics as the Python heap (ref: binding.go), plus a batch API:
+``counts_batch`` computes every node's windowed binding count for all
+hot-value windows in ONE pass over the heap — the Go original rescans the
+heap per (node, window), i.e. O(|nodes| * |windows| * |heap|) per sync
+cycle vs O(|heap| * |windows|) here.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+import time
+
+import numpy as np
+
+from ..annotator.bindings import Binding
+from .lib import load_native
+
+
+class NativeBindingRecords:
+    def __init__(self, size: int, gc_time_range_seconds: float):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("libcrane_native unavailable")
+        self._lib = lib
+        self._handle = lib.crane_bindings_new(int(size), int(gc_time_range_seconds))
+        self._lock = threading.RLock()
+        self._node_ids: dict[str, int] = {}
+        self._names: list[str] = []
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.crane_bindings_free(handle)
+            self._handle = None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return int(self._lib.crane_bindings_len(self._handle))
+
+    def _intern(self, node: str) -> int:
+        node_id = self._node_ids.get(node)
+        if node_id is None:
+            node_id = len(self._names)
+            self._node_ids[node] = node_id
+            self._names.append(node)
+        return node_id
+
+    def add_binding(self, binding: Binding) -> None:
+        with self._lock:
+            self._lib.crane_bindings_add(
+                self._handle, self._intern(binding.node), int(binding.timestamp)
+            )
+
+    def get_last_node_binding_count(
+        self, node: str, time_range_seconds: float, now: float | None = None
+    ) -> int:
+        if now is None:
+            now = time.time()
+        with self._lock:
+            node_id = self._node_ids.get(node)
+            if node_id is None:
+                return 0
+            return int(
+                self._lib.crane_bindings_count(
+                    self._handle, node_id, int(time_range_seconds), int(now)
+                )
+            )
+
+    def counts_batch(
+        self, windows_seconds, now: float | None = None
+    ) -> tuple[list[str], np.ndarray]:
+        """(node_names, counts[window, node]) for all interned nodes."""
+        if now is None:
+            now = time.time()
+        with self._lock:
+            n = len(self._names)
+            w = np.asarray(windows_seconds, dtype=np.int64)
+            out = np.zeros((len(w), max(n, 1)), dtype=np.int64)
+            if n:
+                self._lib.crane_bindings_counts_batch(
+                    self._handle,
+                    n,
+                    w.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    len(w),
+                    int(now),
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                )
+            return list(self._names), out[:, :n]
+
+    def bindings_gc(self, now: float | None = None) -> None:
+        if now is None:
+            now = time.time()
+        with self._lock:
+            self._lib.crane_bindings_gc(self._handle, int(now))

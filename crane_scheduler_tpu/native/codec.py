@@ -1,0 +1,68 @@
+"""Bulk annotation parsing through the native codec.
+
+Parses a batch of ``"value,timestamp"`` wire strings into (values, ts)
+float64 arrays in one C call. Only valid for fixed-offset timezones (the
+default Asia/Shanghai is UTC+8 with no DST); zones with DST fall back to
+the Python codec automatically.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from datetime import datetime, timedelta
+
+import numpy as np
+
+from ..loadstore.codec import decode_annotation
+from ..utils.timeutil import get_location
+from .lib import load_native
+
+_NEG_INF = float("-inf")
+
+
+def _fixed_utc_offset_seconds() -> int | None:
+    """The zone's UTC offset if it is DST-free (sampled across a year)."""
+    loc = get_location()
+    offsets = set()
+    for month in (1, 4, 7, 10):
+        dt = datetime(2025, month, 15, tzinfo=loc)
+        offsets.add(dt.utcoffset() or timedelta(0))
+    if len(offsets) != 1:
+        return None
+    return int(offsets.pop().total_seconds())
+
+
+def bulk_parse_annotations(raw_strings) -> tuple[np.ndarray, np.ndarray]:
+    """[(str|None)] -> (values[n], ts[n]) float64; missing/invalid entries
+    get ts=-inf (fail-open), matching decode_annotation semantics."""
+    n = len(raw_strings)
+    values = np.full((n,), np.nan, dtype=np.float64)
+    ts = np.full((n,), _NEG_INF, dtype=np.float64)
+    lib = load_native()
+    offset = _fixed_utc_offset_seconds()
+    if lib is None or offset is None:
+        for i, raw in enumerate(raw_strings):
+            if raw is None:
+                continue
+            v, t = decode_annotation(raw)
+            if v is None or t is None:
+                continue
+            values[i], ts[i] = v, t
+        return values, ts
+
+    encoded = [(s or "").encode("utf-8", "replace") for s in raw_strings]
+    offsets = np.zeros((n + 1,), dtype=np.int64)
+    for i, b in enumerate(encoded):
+        offsets[i + 1] = offsets[i] + len(b)
+    buffer = b"".join(encoded)
+    lib.crane_parse_annotations(
+        buffer,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        offset,
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ts.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    # mirror decode_annotation: value NaN with valid ts is allowed ("NaN"),
+    # but unparseable value strings already got ts=-inf from the C side.
+    return values, ts

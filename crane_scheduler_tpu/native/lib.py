@@ -1,0 +1,74 @@
+"""ctypes loader for libcrane_native with build-on-demand.
+
+The native library is optional: every consumer has a pure-Python
+fallback. ``load_native()`` finds a prebuilt ``libcrane_native.so`` next
+to ``native/crane_native.cpp`` or builds it with make/g++ once; failures
+return None and the Python paths take over.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libcrane_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_attempted = False
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i64, i32 = ctypes.c_int64, ctypes.c_int32
+    p_i64 = ctypes.POINTER(ctypes.c_int64)
+    p_f64 = ctypes.POINTER(ctypes.c_double)
+    lib.crane_bindings_new.argtypes = [i64, i64]
+    lib.crane_bindings_new.restype = ctypes.c_void_p
+    lib.crane_bindings_free.argtypes = [ctypes.c_void_p]
+    lib.crane_bindings_len.argtypes = [ctypes.c_void_p]
+    lib.crane_bindings_len.restype = i64
+    lib.crane_bindings_add.argtypes = [ctypes.c_void_p, i32, i64]
+    lib.crane_bindings_count.argtypes = [ctypes.c_void_p, i32, i64, i64]
+    lib.crane_bindings_count.restype = i64
+    lib.crane_bindings_counts_batch.argtypes = [
+        ctypes.c_void_p, i64, p_i64, i64, i64, p_i64,
+    ]
+    lib.crane_bindings_gc.argtypes = [ctypes.c_void_p, i64]
+    lib.crane_parse_annotations.argtypes = [
+        ctypes.c_char_p, p_i64, i64, i64, p_f64, p_f64,
+    ]
+    return lib
+
+
+def load_native():
+    """Return the configured CDLL, or None when unavailable."""
+    global _lib, _attempted
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _attempted:
+            return None
+        _attempted = True
+        if not os.path.exists(_SO_PATH):
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except (OSError, subprocess.SubprocessError):
+                return None
+        try:
+            _lib = _configure(ctypes.CDLL(_SO_PATH))
+        except OSError:
+            return None
+        return _lib
+
+
+def native_available() -> bool:
+    return load_native() is not None

@@ -128,19 +128,21 @@ class GangScheduler:
     """
 
     def __init__(self, hv_counts: Sequence[int]):
-        self._g = jnp.asarray(hot_penalty_steps(hv_counts))  # [11] int64
+        self._g = jnp.asarray(hot_penalty_steps(hv_counts), dtype=jnp.int32)  # [11]
         self._jit = jax.jit(self._assign_impl)
 
     def __call__(self, scores, schedulable, num_pods, capacity=None) -> GangResult:
         scores = jnp.asarray(scores, dtype=jnp.int32)
         n = scores.shape[0]
+        num_pods = int(min(int(num_pods), 2**31 - 1))
         if capacity is None:
-            capacity = jnp.full((n,), jnp.asarray(num_pods, _idtype()))
+            capacity = np.full((n,), num_pods, dtype=np.int64)
+        capacity = np.minimum(np.asarray(capacity, dtype=np.int64), 2**31 - 1)
         out = self._jit(
             scores,
             jnp.asarray(schedulable, dtype=jnp.bool_),
-            jnp.asarray(num_pods, dtype=_idtype()),
-            jnp.asarray(capacity, dtype=_idtype()),
+            jnp.asarray(num_pods, dtype=jnp.int32),
+            jnp.asarray(capacity, dtype=jnp.int32),
         )
         return GangResult(*out)
 
@@ -150,42 +152,52 @@ class GangScheduler:
         value(t) >= L  <=>  S_n - 10 h(t) >= L  <=>  h(t) <= (S_n - L)//10
         <=>  t < g[(S_n - L)//10].
         """
-        s = scores.astype(_idtype())
+        s = scores.astype(jnp.int32)
         x = jnp.clip((s - level) // 10, 0, 10)
         unlocked = jnp.where(s >= level, self._g[x], 0)
         return jnp.minimum(k_cap, unlocked)
 
     def _assign_impl(self, scores, schedulable, num_pods, capacity):
+        # All internal arithmetic is int32: int64 cumsum/reductions lower
+        # to u32-pair reduce-windows that blow TPU vmem at 50k nodes. This
+        # is exact because per-node tokens are clipped to (2^31-1)/N (so
+        # level totals fit int32); the only divergence from the sequential
+        # oracle would need a single node to absorb > 2^31/N pods.
         n = scores.shape[0]
-        k_cap = jnp.where(schedulable, jnp.maximum(capacity, 0), 0)  # [N] i64
+        num_pods = jnp.minimum(num_pods, jnp.asarray(2**31 - 1)).astype(jnp.int32)
+        capacity = jnp.clip(capacity, 0, 2**31 - 1).astype(jnp.int32)
+        k_cap = jnp.where(schedulable, capacity, 0)  # [N] i32
         # No node ever needs more than num_pods tokens; clipping also keeps
-        # the level-total reductions far from integer overflow.
+        # the level-total reductions within int32.
         k_cap = jnp.minimum(k_cap, jnp.maximum(num_pods, 0))
+        k_cap = jnp.minimum(k_cap, (2**31 - 1) // max(n, 1))
 
         # A[L, n] for L = 0..101; A[0] = all tokens (value >= 0), A[101] = 0.
-        levels = jnp.arange(102, dtype=_idtype())  # [102]
+        levels = jnp.arange(102, dtype=jnp.int32)  # [102]
         a_pos = jax.vmap(lambda lv: self.tokens_at_or_above(scores, k_cap, lv))(
             levels
         )  # [102, N] (level 0 row computed but replaced below)
         a = a_pos.at[0].set(k_cap)
 
-        totals = a.sum(axis=1)  # [102] T(L), nonincreasing in L
+        totals = a.sum(axis=1, dtype=jnp.int32)  # [102] T(L), nonincreasing in L
         meets = totals >= num_pods  # True for L <= L*
         l_star = jnp.max(jnp.where(meets, levels, -1))  # -1 => capacity short
 
         def full_capacity(_):
             counts = k_cap
             unassigned = num_pods - totals[0]
-            return counts, unassigned, jnp.asarray(-1, _idtype())
+            return counts, unassigned, jnp.asarray(-1, jnp.int32)
 
         def waterline(l_star):
             upper = jnp.take(a, l_star + 1, axis=0)  # tokens strictly above
             exact = jnp.take(a, l_star, axis=0) - upper  # tokens at L*
             remainder = num_pods - jnp.take(totals, l_star + 1)
-            prefix = jnp.cumsum(exact) - exact  # exclusive, node-index order
+            # exclusive prefix sum in node-index order (int32 pinned: int64
+            # cumsum lowers to a vmem-hungry u32-pair reduce-window on TPU)
+            prefix = jnp.cumsum(exact, dtype=jnp.int32) - exact
             take = jnp.clip(remainder - prefix, 0, exact)
             counts = upper + take
-            return counts, jnp.asarray(0, _idtype()), l_star
+            return counts, jnp.asarray(0, jnp.int32), l_star
 
         counts, unassigned, lvl = jax.lax.cond(
             l_star < 0, full_capacity, waterline, l_star

@@ -71,10 +71,9 @@ class ScoringService:
     def refresh(self) -> None:
         """Bulk re-read of node annotations into the columnar store."""
         with self._lock:
-            seen = set()
-            for node in self.cluster.list_nodes():
-                self.store.ingest_node_annotations(node.name, node.annotations)
-                seen.add(node.name)
+            nodes = self.cluster.list_nodes()
+            self.store.bulk_ingest((n.name, n.annotations) for n in nodes)
+            seen = {n.name for n in nodes}
             for name in set(self.store.node_names) - seen:
                 self.store.remove_node(name)
             self.stats.refreshes += 1

@@ -1,0 +1,248 @@
+// crane_native: native runtime pieces for crane-scheduler-tpu.
+//
+// The reference's runtime is compiled Go; the performance-relevant host
+// pieces here are implemented in C++ with a C ABI for ctypes:
+//
+//  1. Binding records — the bounded min-heap behind hot values
+//     (ref: pkg/controller/annotator/binding.go). The Go version scans the
+//     whole heap per (node, window) query; the batch API here computes the
+//     counts for EVERY node and window in one pass over the heap.
+//
+//  2. Bulk annotation codec — parse "value,2006-01-02T15:04:05Z" wire
+//     strings (ref: node.go:142, stats.go:51-76) into value/timestamp
+//     arrays. The timestamp's trailing Z is a literal; the string is local
+//     time in a fixed-offset zone (utc_offset_seconds parameter; zones
+//     with DST must use the Python codec).
+//
+// Build: make -C native   (produces libcrane_native.so)
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Binding records
+// ---------------------------------------------------------------------------
+
+struct Binding {
+  int64_t timestamp;
+  uint64_t seq;
+  int32_t node_id;
+};
+
+struct BindingHeap {
+  std::vector<Binding> heap;  // min-heap by (timestamp, seq)
+  int64_t size_cap;
+  int64_t gc_range_seconds;
+  uint64_t seq;
+};
+
+static bool binding_greater(const Binding& a, const Binding& b) {
+  // std::push_heap builds a max-heap; invert for min-heap semantics.
+  if (a.timestamp != b.timestamp) return a.timestamp > b.timestamp;
+  return a.seq > b.seq;
+}
+
+void* crane_bindings_new(int64_t size_cap, int64_t gc_range_seconds) {
+  auto* h = new BindingHeap();
+  h->size_cap = size_cap;
+  h->gc_range_seconds = gc_range_seconds;
+  h->seq = 0;
+  h->heap.reserve(static_cast<size_t>(size_cap > 0 ? size_cap : 16));
+  return h;
+}
+
+void crane_bindings_free(void* handle) {
+  delete static_cast<BindingHeap*>(handle);
+}
+
+int64_t crane_bindings_len(void* handle) {
+  return static_cast<int64_t>(static_cast<BindingHeap*>(handle)->heap.size());
+}
+
+// Push; evict the oldest first when full (ref: binding.go:69-78).
+void crane_bindings_add(void* handle, int32_t node_id, int64_t timestamp) {
+  auto* h = static_cast<BindingHeap*>(handle);
+  if (static_cast<int64_t>(h->heap.size()) == h->size_cap) {
+    std::pop_heap(h->heap.begin(), h->heap.end(), binding_greater);
+    h->heap.pop_back();
+  }
+  h->heap.push_back(Binding{timestamp, h->seq++, node_id});
+  std::push_heap(h->heap.begin(), h->heap.end(), binding_greater);
+}
+
+// Count bindings for one node strictly newer than now - window
+// (ref: binding.go:81-97).
+int64_t crane_bindings_count(void* handle, int32_t node_id,
+                             int64_t window_seconds, int64_t now_seconds) {
+  auto* h = static_cast<BindingHeap*>(handle);
+  const int64_t timeline = now_seconds - window_seconds;
+  int64_t count = 0;
+  for (const auto& b : h->heap) {
+    if (b.timestamp > timeline && b.node_id == node_id) ++count;
+  }
+  return count;
+}
+
+// One pass over the heap, all nodes x all windows:
+// out[w * n_nodes + node_id] = count of bindings newer than now - window_w.
+// node_id must be in [0, n_nodes).
+void crane_bindings_counts_batch(void* handle, int64_t n_nodes,
+                                 const int64_t* window_seconds,
+                                 int64_t n_windows, int64_t now_seconds,
+                                 int64_t* out) {
+  auto* h = static_cast<BindingHeap*>(handle);
+  std::memset(out, 0, sizeof(int64_t) * static_cast<size_t>(n_nodes * n_windows));
+  std::vector<int64_t> timelines(static_cast<size_t>(n_windows));
+  for (int64_t w = 0; w < n_windows; ++w) {
+    timelines[static_cast<size_t>(w)] = now_seconds - window_seconds[w];
+  }
+  for (const auto& b : h->heap) {
+    if (b.node_id < 0 || b.node_id >= n_nodes) continue;
+    for (int64_t w = 0; w < n_windows; ++w) {
+      if (b.timestamp > timelines[static_cast<size_t>(w)]) {
+        ++out[w * n_nodes + b.node_id];
+      }
+    }
+  }
+}
+
+// Pop expired records, stopping at the first live one (ref: binding.go:100-123).
+void crane_bindings_gc(void* handle, int64_t now_seconds) {
+  auto* h = static_cast<BindingHeap*>(handle);
+  if (h->gc_range_seconds == 0) return;
+  const int64_t timeline = now_seconds - h->gc_range_seconds;
+  while (!h->heap.empty()) {
+    const Binding& top = h->heap.front();
+    if (top.timestamp > timeline) return;
+    std::pop_heap(h->heap.begin(), h->heap.end(), binding_greater);
+    h->heap.pop_back();
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Bulk annotation codec
+// ---------------------------------------------------------------------------
+
+// Howard Hinnant's days-from-civil: days since 1970-01-01 for y/m/d.
+static int64_t days_from_civil(int64_t y, int64_t m, int64_t d) {
+  y -= m <= 2;
+  const int64_t era = (y >= 0 ? y : y - 399) / 400;
+  const int64_t yoe = y - era * 400;
+  const int64_t doy = (153 * (m + (m > 2 ? -3 : 9)) + 2) / 5 + d - 1;
+  const int64_t doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+  return era * 146097 + doe - 719468;
+}
+
+static bool parse_2digits(const char* p, int* out) {
+  if (p[0] < '0' || p[0] > '9' || p[1] < '0' || p[1] > '9') return false;
+  *out = (p[0] - '0') * 10 + (p[1] - '0');
+  return true;
+}
+
+// Parse "YYYY-MM-DDTHH:MM:SSZ" (literal Z) as a local time at a fixed UTC
+// offset. Returns epoch seconds or INT64_MIN on failure.
+static int64_t parse_local_timestamp(const char* s, int64_t len,
+                                     int64_t utc_offset_seconds) {
+  if (len != 20) return INT64_MIN;
+  if (s[4] != '-' || s[7] != '-' || s[10] != 'T' || s[13] != ':' ||
+      s[16] != ':' || s[19] != 'Z') {
+    return INT64_MIN;
+  }
+  int year_hi, year_lo, month, day, hour, minute, second;
+  if (!parse_2digits(s, &year_hi) || !parse_2digits(s + 2, &year_lo) ||
+      !parse_2digits(s + 5, &month) || !parse_2digits(s + 8, &day) ||
+      !parse_2digits(s + 11, &hour) || !parse_2digits(s + 14, &minute) ||
+      !parse_2digits(s + 17, &second)) {
+    return INT64_MIN;
+  }
+  const int year = year_hi * 100 + year_lo;
+  if (month < 1 || month > 12 || day < 1 || day > 31 || hour > 23 ||
+      minute > 59 || second > 60) {
+    return INT64_MIN;
+  }
+  const int64_t days = days_from_civil(year, month, day);
+  return days * 86400 + hour * 3600 + minute * 60 + second - utc_offset_seconds;
+}
+
+// Parse n annotation strings packed into one buffer with offsets
+// (offsets[i]..offsets[i+1] delimit string i). Outputs per entry:
+//   values[i] = parsed float (NaN when the value part is invalid/missing)
+//   ts[i]     = epoch seconds, or -inf when the entry is structurally
+//               invalid (wrong comma count / bad timestamp) => fail-open.
+// Mirrors decode_annotation + the Go getResourceUsage split semantics.
+void crane_parse_annotations(const char* buffer, const int64_t* offsets,
+                             int64_t n, int64_t utc_offset_seconds,
+                             double* values, double* ts) {
+  const double neg_inf = -1.0 / 0.0;
+  const double nan = 0.0 / 0.0;
+  for (int64_t i = 0; i < n; ++i) {
+    values[i] = nan;
+    ts[i] = neg_inf;
+    const char* start = buffer + offsets[i];
+    const int64_t len = offsets[i + 1] - offsets[i];
+    // exactly one comma (split must yield 2 parts; ref: stats.go:57-60)
+    const char* comma = nullptr;
+    int comma_count = 0;
+    for (int64_t j = 0; j < len; ++j) {
+      if (start[j] == ',') {
+        if (comma_count++ == 0) comma = start + j;
+      }
+    }
+    if (comma_count != 1) continue;
+    const int64_t ts_len = (start + len) - (comma + 1);
+    const int64_t parsed = parse_local_timestamp(comma + 1, ts_len, utc_offset_seconds);
+    if (parsed == INT64_MIN) continue;
+    ts[i] = static_cast<double>(parsed);
+    // value part: strtod accepts a superset of Go (hex floats, inf/nan);
+    // reject trailing garbage and leading whitespace to match ParseFloat.
+    const int64_t vlen = comma - start;
+    if (vlen == 0 || start[0] == ' ' || start[0] == '\t') {
+      ts[i] = neg_inf;  // unparseable value == structurally invalid
+      continue;
+    }
+    char tmp[64];
+    if (vlen >= static_cast<int64_t>(sizeof(tmp))) {
+      ts[i] = neg_inf;
+      continue;
+    }
+    std::memcpy(tmp, start, static_cast<size_t>(vlen));
+    tmp[vlen] = '\0';
+    // Go rejects underscores except between digits; strtod ignores them as
+    // terminators. Strip valid grouping underscores first.
+    char cleaned[64];
+    int64_t ci = 0;
+    bool bad_underscore = false;
+    for (int64_t j = 0; j < vlen; ++j) {
+      if (tmp[j] == '_') {
+        const bool prev_digit = j > 0 && tmp[j - 1] >= '0' && tmp[j - 1] <= '9';
+        const bool next_digit =
+            j + 1 < vlen && tmp[j + 1] >= '0' && tmp[j + 1] <= '9';
+        if (!prev_digit || !next_digit) {
+          bad_underscore = true;
+          break;
+        }
+        continue;  // drop grouping underscore
+      }
+      cleaned[ci++] = tmp[j];
+    }
+    if (bad_underscore) {
+      ts[i] = neg_inf;
+      continue;
+    }
+    cleaned[ci] = '\0';
+    char* end = nullptr;
+    const double v = std::strtod(cleaned, &end);
+    if (end == cleaned || (end != nullptr && *end != '\0')) {
+      ts[i] = neg_inf;
+      continue;
+    }
+    values[i] = v;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,148 @@
+"""Native (C++) backend parity: the binding heap and bulk annotation codec
+must behave identically to the pure-Python implementations."""
+
+import random
+
+import numpy as np
+import pytest
+
+from crane_scheduler_tpu.annotator.bindings import Binding, BindingRecords
+from crane_scheduler_tpu.loadstore.codec import decode_annotation
+from crane_scheduler_tpu.native import (
+    NativeBindingRecords,
+    bulk_parse_annotations,
+    native_available,
+)
+from crane_scheduler_tpu.utils import format_local_time
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="libcrane_native not built"
+)
+
+NOW = 1753776000.0
+
+
+def test_binding_records_random_parity():
+    rng = random.Random(0)
+    for trial in range(5):
+        size = rng.choice([4, 16, 128])
+        py = BindingRecords(size, 300.0)
+        nat = NativeBindingRecords(size, 300.0)
+        nodes = [f"n{i}" for i in range(8)]
+        for _ in range(rng.randint(1, 300)):
+            b = Binding(
+                rng.choice(nodes), "ns", "p", int(NOW) - rng.randint(0, 600)
+            )
+            py.add_binding(b)
+            nat.add_binding(b)
+            if rng.random() < 0.05:
+                py.bindings_gc(NOW)
+                nat.bindings_gc(NOW)
+        assert len(py) == len(nat)
+        for node in nodes:
+            for window in (60.0, 300.0, 1000.0):
+                assert py.get_last_node_binding_count(
+                    node, window, NOW
+                ) == nat.get_last_node_binding_count(node, window, NOW), (
+                    trial, node, window,
+                )
+
+
+def test_binding_records_batch_counts_match_single():
+    nat = NativeBindingRecords(64, 300.0)
+    rng = random.Random(1)
+    nodes = [f"n{i}" for i in range(5)]
+    for _ in range(100):
+        nat.add_binding(
+            Binding(rng.choice(nodes), "ns", "p", int(NOW) - rng.randint(0, 400))
+        )
+    names, counts = nat.counts_batch([300, 60], now=NOW)
+    for w_idx, window in enumerate((300.0, 60.0)):
+        for n_idx, name in enumerate(names):
+            assert counts[w_idx, n_idx] == nat.get_last_node_binding_count(
+                name, window, NOW
+            )
+
+
+def test_bulk_codec_matches_python_decoder():
+    ts_ok = format_local_time(NOW)
+    cases = [
+        f"0.65000,{ts_ok}",
+        f"NaN,{ts_ok}",
+        f"-0.50000,{ts_ok}",
+        f"1e3,{ts_ok}",
+        f"1_000,{ts_ok}",
+        f"1__0,{ts_ok}",  # bad underscore
+        f"_10,{ts_ok}",  # bad underscore
+        "no-comma",
+        f"a,b,{ts_ok}",  # too many commas
+        "0.5,short",
+        "0.5,2025-13-40T99:99:99Z",  # bad date fields
+        f"bogus,{ts_ok}",
+        f" 0.5,{ts_ok}",  # leading space rejected like Go
+        "",
+        None,
+        f"+Inf,{ts_ok}",
+        f"0.30000,{format_local_time(NOW - 1000)}",
+    ]
+    values, ts = bulk_parse_annotations(cases)
+    for i, raw in enumerate(cases):
+        if raw is None:
+            want_v, want_t = None, None
+        else:
+            want_v, want_t = decode_annotation(raw)
+        if want_v is None or want_t is None:
+            assert ts[i] == float("-inf"), (i, raw, ts[i])
+        else:
+            assert ts[i] == want_t, (i, raw)
+            if want_v != want_v:  # NaN
+                assert values[i] != values[i]
+            else:
+                assert values[i] == want_v, (i, raw)
+
+
+def test_bulk_codec_random_fuzz_parity():
+    rng = random.Random(2)
+    pool = ["0.5", "1.0", "NaN", "bogus", "1e2", "-3", "", "0x1p-2", "1_0"]
+    ts_pool = [
+        format_local_time(NOW),
+        format_local_time(NOW - 500),
+        "2025-07-29T16:00:00Z",
+        "junk",
+        "",
+    ]
+    cases = []
+    for _ in range(500):
+        r = rng.random()
+        if r < 0.1:
+            cases.append(None)
+        elif r < 0.2:
+            cases.append(rng.choice(pool))
+        else:
+            cases.append(f"{rng.choice(pool)},{rng.choice(ts_pool)}")
+    values, ts = bulk_parse_annotations(cases)
+    for i, raw in enumerate(cases):
+        want_v, want_t = decode_annotation(raw) if raw is not None else (None, None)
+        if want_v is None or want_t is None:
+            assert ts[i] == float("-inf"), (i, raw)
+        else:
+            assert ts[i] == want_t, (i, raw)
+            same = values[i] == want_v or (values[i] != values[i] and want_v != want_v)
+            assert same, (i, raw)
+
+
+def test_annotator_uses_native_bindings_by_default():
+    from crane_scheduler_tpu.annotator import AnnotatorConfig, NodeAnnotator
+    from crane_scheduler_tpu.cluster import ClusterState
+    from crane_scheduler_tpu.metrics import FakeMetricsSource
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+
+    ann = NodeAnnotator(ClusterState(), FakeMetricsSource(), DEFAULT_POLICY)
+    assert isinstance(ann.binding_records, NativeBindingRecords)
+    ann_py = NodeAnnotator(
+        ClusterState(),
+        FakeMetricsSource(),
+        DEFAULT_POLICY,
+        AnnotatorConfig(use_native_bindings=False),
+    )
+    assert isinstance(ann_py.binding_records, BindingRecords)
