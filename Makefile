@@ -1,0 +1,31 @@
+# crane-scheduler-tpu build/test entrypoints
+# (equivalent of the reference Makefile's scheduler/controller/test targets)
+
+PYTHON ?= python
+
+.PHONY: all native test test-fast bench sim e2e clean
+
+all: native test
+
+native:
+	$(MAKE) -C native
+
+test: native
+	$(PYTHON) -m pytest tests/ -q
+
+test-fast:
+	$(PYTHON) -m pytest tests/ -q -x
+
+bench: native
+	$(PYTHON) bench.py
+
+sim:
+	$(PYTHON) -m crane_scheduler_tpu.cli.sim_main --nodes 100 --pods 200 --mode batch
+
+e2e:
+	$(PYTHON) examples/run_cpu_stress.py
+
+clean:
+	$(MAKE) -C native clean
+	rm -rf .pytest_cache .jax_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -1,0 +1,62 @@
+"""Scheduler assembly from configuration.
+
+Equivalent of ``cmd/scheduler/main.go:20-23``: the stock scheduler with
+the crane plugins registered via ``app.WithPlugin`` — here, a Scheduler
+built from a decoded SchedulerConfiguration, wiring DynamicPlugin and
+TopologyMatch with their decoded args and score weights.
+"""
+
+from __future__ import annotations
+
+from ..cluster.state import ClusterState
+from ..framework.scheduler import Scheduler
+from ..plugins.dynamic import DynamicPlugin
+from ..policy.v1alpha1 import load_policy_from_file
+from ..topology.plugin import TopologyMatch
+from .types import DynamicArgs, NodeResourceTopologyMatchArgs, SchedulerConfiguration
+
+
+def build_scheduler_from_config(
+    cluster: ClusterState,
+    config: SchedulerConfiguration,
+    nrt_lister=None,
+    clock=None,
+    policy=None,
+) -> Scheduler:
+    """Build a Scheduler for the first profile.
+
+    ``policy`` overrides reading DynamicArgs.policy_config_path from disk
+    (useful in tests/sim); ``nrt_lister`` is required when the NRT plugin
+    is enabled.
+    """
+    import time
+
+    if not config.profiles:
+        raise ValueError("scheduler configuration has no profiles")
+    profile = config.profiles[0]
+    sched = Scheduler(cluster, clock=clock or time.time)
+
+    weights = {pw.name: pw.weight for pw in profile.score_enabled}
+    enabled = set(profile.filter_enabled) | set(weights)
+
+    if "Dynamic" in enabled:
+        args = profile.plugin_config.get("Dynamic", DynamicArgs())
+        if policy is None:
+            policy = load_policy_from_file(args.policy_config_path)
+        plugin = DynamicPlugin(policy, clock=clock or time.time)
+        sched.register(plugin, weight=weights.get("Dynamic", 1))
+
+    if "NodeResourceTopologyMatch" in enabled:
+        if nrt_lister is None:
+            raise ValueError("NodeResourceTopologyMatch enabled but no NRT lister")
+        args = profile.plugin_config.get(
+            "NodeResourceTopologyMatch", NodeResourceTopologyMatchArgs()
+        )
+        plugin = TopologyMatch(
+            nrt_lister,
+            cluster=cluster,
+            topology_aware_resources=frozenset(args.topology_aware_resources),
+        )
+        sched.register(plugin, weight=weights.get("NodeResourceTopologyMatch", 1))
+
+    return sched

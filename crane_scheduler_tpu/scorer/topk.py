@@ -146,17 +146,6 @@ class GangScheduler:
         )
         return GangResult(*out)
 
-    def tokens_at_or_above(self, scores, k_cap, level):
-        """A_n(L): node n's tokens with value >= L (1 <= L <= 101).
-
-        value(t) >= L  <=>  S_n - 10 h(t) >= L  <=>  h(t) <= (S_n - L)//10
-        <=>  t < g[(S_n - L)//10].
-        """
-        s = scores.astype(jnp.int32)
-        x = jnp.clip((s - level) // 10, 0, 10)
-        unlocked = jnp.where(s >= level, self._g[x], 0)
-        return jnp.minimum(k_cap, unlocked)
-
     def _assign_impl(self, scores, schedulable, num_pods, capacity):
         # All internal arithmetic is int32: int64 cumsum/reductions lower
         # to u32-pair reduce-windows that blow TPU vmem at 50k nodes. This
@@ -172,16 +161,38 @@ class GangScheduler:
         k_cap = jnp.minimum(k_cap, jnp.maximum(num_pods, 0))
         k_cap = jnp.minimum(k_cap, (2**31 - 1) // max(n, 1))
 
-        # A[L, n] for L = 0..101; A[0] = all tokens (value >= 0), A[101] = 0.
+        s = scores.astype(jnp.int32)
         levels = jnp.arange(102, dtype=jnp.int32)  # [102]
-        a_pos = jax.vmap(lambda lv: self.tokens_at_or_above(scores, k_cap, lv))(
-            levels
-        )  # [102, N] (level 0 row computed but replaced below)
-        a = a_pos.at[0].set(k_cap)
 
-        totals = a.sum(axis=1, dtype=jnp.int32)  # [102] T(L), nonincreasing in L
+        # Each node's token staircase A_n(L) is constant except at the 11
+        # breakpoint levels L_x = s_n - 10x (x = 0..10), where it gains
+        # exact_x = min(k, g[x]) - min(k, g[x-1]) tokens (g[-1] = 0). So
+        # instead of materializing A as a [102, N] matrix, scatter the
+        # breakpoint deltas into a [102] histogram and suffix-sum it:
+        #   hist[L]  = Σ_n (tokens whose value is exactly L >= 1)
+        #   totals[L] = Σ_{L' >= L} hist[L']  = Σ_n A_n(L)   (for L >= 1)
+        x = jnp.arange(11, dtype=jnp.int32)  # [11]
+        capped = jnp.minimum(k_cap[None, :], self._g[x][:, None])  # [11, N]
+        exact_x = capped - jnp.concatenate(
+            [jnp.zeros((1, n), jnp.int32), capped[:-1]], axis=0
+        )  # [11, N] new tokens unlocked at breakpoint x
+        level_x = s[None, :] - 10 * x[:, None]  # [11, N] breakpoint levels
+        valid_x = level_x >= 1
+        hist = jnp.zeros((102,), jnp.int32).at[
+            jnp.clip(level_x, 0, 101).reshape(-1)
+        ].add(jnp.where(valid_x, exact_x, 0).reshape(-1), mode="drop")
+        # suffix sum over a [102] vector (tiny); totals[0] = all tokens.
+        totals = jnp.cumsum(hist[::-1], dtype=jnp.int32)[::-1]
+        totals = totals.at[0].set(k_cap.sum(dtype=jnp.int32))
+
         meets = totals >= num_pods  # True for L <= L*
         l_star = jnp.max(jnp.where(meets, levels, -1))  # -1 => capacity short
+
+        def a_of(level):
+            """A_n(level) for a traced scalar level >= 1, elementwise."""
+            xq = jnp.clip((s - level) // 10, 0, 10)
+            unlocked = jnp.where(s >= level, self._g[xq], 0)
+            return jnp.minimum(k_cap, unlocked)
 
         def full_capacity(_):
             counts = k_cap
@@ -189,9 +200,11 @@ class GangScheduler:
             return counts, unassigned, jnp.asarray(-1, jnp.int32)
 
         def waterline(l_star):
-            upper = jnp.take(a, l_star + 1, axis=0)  # tokens strictly above
-            exact = jnp.take(a, l_star, axis=0) - upper  # tokens at L*
-            remainder = num_pods - jnp.take(totals, l_star + 1)
+            upper = jnp.where(l_star + 1 >= 102, 0, a_of(l_star + 1))
+            at_or_above = jnp.where(l_star >= 1, a_of(l_star), k_cap)
+            exact = at_or_above - upper  # tokens exactly at L*
+            remainder = num_pods - jnp.take(totals, jnp.minimum(l_star + 1, 101))
+            remainder = jnp.where(l_star + 1 >= 102, num_pods, remainder)
             # exclusive prefix sum in node-index order (int32 pinned: int64
             # cumsum lowers to a vmem-hungry u32-pair reduce-window on TPU)
             prefix = jnp.cumsum(exact, dtype=jnp.int32) - exact

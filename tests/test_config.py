@@ -1,0 +1,148 @@
+"""Plugin-args config API tests (ref: pkg/plugins/apis/config)."""
+
+import pytest
+
+from crane_scheduler_tpu.config import (
+    ConfigDecodeError,
+    DynamicArgs,
+    NodeResourceTopologyMatchArgs,
+    build_scheduler_from_config,
+    load_scheduler_config,
+)
+from crane_scheduler_tpu.config.types import DEFAULT_DYNAMIC_POLICY_CONFIG_PATH
+
+DYNAMIC_CONFIG = """
+apiVersion: kubescheduler.config.k8s.io/v1beta2
+kind: KubeSchedulerConfiguration
+leaderElection:
+  leaderElect: true
+clientConnection:
+  kubeconfig: "ignored"
+profiles:
+  - schedulerName: default-scheduler
+    plugins:
+      filter:
+        enabled:
+          - name: Dynamic
+      score:
+        enabled:
+          - name: Dynamic
+            weight: 3
+    pluginConfig:
+      - name: Dynamic
+        args:
+          policyConfigPath: /etc/kubernetes/policy.yaml
+"""
+
+NRT_CONFIG = """
+apiVersion: kubescheduler.config.k8s.io/v1beta2
+kind: KubeSchedulerConfiguration
+profiles:
+  - schedulerName: default-scheduler
+    plugins:
+      preFilter:
+        enabled:
+          - name: NodeResourceTopologyMatch
+      filter:
+        enabled:
+          - name: NodeResourceTopologyMatch
+      score:
+        enabled:
+          - name: NodeResourceTopologyMatch
+            weight: 2
+      reserve:
+        enabled:
+          - name: NodeResourceTopologyMatch
+      preBind:
+        enabled:
+          - name: NodeResourceTopologyMatch
+"""
+
+
+def test_decode_dynamic_config():
+    cfg = load_scheduler_config(DYNAMIC_CONFIG)
+    profile = cfg.profiles[0]
+    assert profile.filter_enabled == ("Dynamic",)
+    assert profile.score_enabled[0].name == "Dynamic"
+    assert profile.score_enabled[0].weight == 3
+    assert profile.plugin_config["Dynamic"] == DynamicArgs("/etc/kubernetes/policy.yaml")
+
+
+def test_decode_nrt_config_defaults_args():
+    cfg = load_scheduler_config(NRT_CONFIG)
+    profile = cfg.profiles[0]
+    # enabled without explicit args -> defaulted (ref: v1beta2/defaults.go)
+    assert profile.plugin_config["NodeResourceTopologyMatch"] == (
+        NodeResourceTopologyMatchArgs(("cpu",))
+    )
+
+
+def test_v1beta2_empty_path_defaults():
+    doc = DYNAMIC_CONFIG.replace(
+        "policyConfigPath: /etc/kubernetes/policy.yaml", "policyConfigPath: ''"
+    )
+    cfg = load_scheduler_config(doc)
+    assert (
+        cfg.profiles[0].plugin_config["Dynamic"].policy_config_path
+        == DEFAULT_DYNAMIC_POLICY_CONFIG_PATH
+    )
+
+
+def test_v1beta3_pointer_defaulting_preserves_empty():
+    doc = DYNAMIC_CONFIG.replace("v1beta2", "v1beta3").replace(
+        "policyConfigPath: /etc/kubernetes/policy.yaml", "policyConfigPath: ''"
+    )
+    cfg = load_scheduler_config(doc)
+    # v1beta3 pointer semantics: explicitly empty stays empty
+    assert cfg.profiles[0].plugin_config["Dynamic"].policy_config_path == ""
+    # absent -> default
+    doc = DYNAMIC_CONFIG.replace("v1beta2", "v1beta3").replace(
+        "          policyConfigPath: /etc/kubernetes/policy.yaml\n", ""
+    )
+    cfg = load_scheduler_config(doc)
+    assert (
+        cfg.profiles[0].plugin_config["Dynamic"].policy_config_path
+        == DEFAULT_DYNAMIC_POLICY_CONFIG_PATH
+    )
+
+
+def test_unknown_version_and_args_rejected():
+    with pytest.raises(ConfigDecodeError):
+        load_scheduler_config(DYNAMIC_CONFIG.replace("v1beta2", "v1"))
+    with pytest.raises(ConfigDecodeError):
+        load_scheduler_config(
+            DYNAMIC_CONFIG.replace("policyConfigPath", "policyPathTypo")
+        )
+
+
+def test_shipped_configs_decode():
+    from crane_scheduler_tpu.config.scheme import load_scheduler_config_from_file
+
+    cfg = load_scheduler_config_from_file("deploy/dynamic/scheduler-config.yaml")
+    assert cfg.profiles[0].plugin_config["Dynamic"].policy_config_path == (
+        "deploy/dynamic/policy.yaml"
+    )
+    cfg = load_scheduler_config_from_file(
+        "deploy/noderesourcetopology/scheduler-config.yaml"
+    )
+    assert "NodeResourceTopologyMatch" in cfg.profiles[0].plugin_config
+
+
+def test_build_scheduler_from_config_end_to_end(tmp_path):
+    from crane_scheduler_tpu.sim import SimConfig, Simulator
+
+    sim = Simulator(SimConfig(n_nodes=3, seed=0))
+    sim.sync_metrics()
+    cfg = load_scheduler_config(DYNAMIC_CONFIG)
+    sched = build_scheduler_from_config(
+        sim.cluster, cfg, clock=sim.clock, policy=sim.policy
+    )
+    pod = sim.make_pod()
+    result = sched.schedule_one(pod)
+    assert result.node is not None
+    # score weight 3 applied
+    from crane_scheduler_tpu.scorer import oracle
+
+    for name, total in result.scores.items():
+        anno = dict(sim.cluster.get_node(name).annotations)
+        assert total == 3 * oracle.score_node(anno, sim.policy.spec, sim.clock.now())
