@@ -48,9 +48,29 @@ def build_inputs(tensors, n_nodes: int, now: float, rng):
     return values, ts, hot_value, hot_ts, node_valid
 
 
+def _tpu_reachable(timeout: float = 120.0) -> bool:
+    """Probe device init in a subprocess so a wedged accelerator tunnel
+    can't hang the benchmark itself."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout,
+            capture_output=True,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> int:
+    use_cpu = "--cpu" in sys.argv or not _tpu_reachable()
     import jax
 
+    if use_cpu:
+        log("TPU backend unreachable (or --cpu): falling back to CPU")
+        jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)  # int64 for gang counters
     # Persistent compile cache: the remote AOT compile of the full step is
     # expensive; completed compiles survive across bench runs.

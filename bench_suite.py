@@ -1,0 +1,218 @@
+"""The five BASELINE.md benchmark configs, end to end.
+
+  1. single cpu-stress pod, 3-node sim cluster, default policy
+  2. 1k pods / 1k nodes, cpu+mem avg_5m priority weights only
+  3. 10k pods / 10k nodes, full predicate+priority+hotValue policy
+  4. 50k nodes with 12 syncPolicy metrics, streaming annotation refresh
+  5. 100k-pod burst gang-schedule, mesh-sharded across all devices
+
+Each config reports a JSON line to stdout with wall-clock timings.
+Configs 1-3 run the full loop (annotator sync through real annotation
+strings -> bulk ingest -> score -> assign -> bind). Config 4 measures the
+streaming refresh path (string parse + H2D) separately from the scoring
+step. Config 5 is the headline (same as bench.py).
+
+Usage: python bench_suite.py [--device cpu|default] [--configs 1,2,3,4,5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def emit(payload):
+    print(json.dumps(payload), flush=True)
+
+
+def _sim(n_nodes, policy=None, seed=0):
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+    from crane_scheduler_tpu.sim import SimConfig, Simulator
+
+    sim = Simulator(SimConfig(n_nodes=n_nodes, seed=seed), policy=policy or DEFAULT_POLICY)
+    sim.sync_metrics()
+    return sim
+
+
+def config1():
+    sim = _sim(3)
+    sched = sim.build_scheduler()
+    pod = sim.make_pod(cpu_milli=1000, mem=1 << 30)
+    t0 = time.perf_counter()
+    result = sched.schedule_one(pod)
+    ms = (time.perf_counter() - t0) * 1e3
+    emit({"config": 1, "desc": "1 cpu-stress pod, 3 nodes, default policy",
+          "node": result.node, "latency_ms": round(ms, 3)})
+
+
+def _policy_cpu_mem_5m():
+    from crane_scheduler_tpu.policy.types import (
+        DynamicSchedulerPolicy, PolicySpec, PriorityPolicy, SyncPolicy,
+    )
+
+    return DynamicSchedulerPolicy(spec=PolicySpec(
+        sync_period=(SyncPolicy("cpu_usage_avg_5m", 180.0),
+                     SyncPolicy("mem_usage_avg_5m", 180.0)),
+        priority=(PriorityPolicy("cpu_usage_avg_5m", 0.5),
+                  PriorityPolicy("mem_usage_avg_5m", 0.5)),
+    ))
+
+
+def _run_batch(sim, n_pods, dtype, bucket=2048):
+    import jax
+
+    batch = sim.build_batch_scheduler(dtype=dtype, bucket=bucket)
+    pods = [sim.make_pod() for _ in range(n_pods)]
+    t0 = time.perf_counter()
+    batch.schedule_batch(pods, bind=False)
+    warm_ms = (time.perf_counter() - t0) * 1e3  # includes refresh+compile
+    lat = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        result = batch.schedule_batch(pods, bind=False)
+        lat.append((time.perf_counter() - t0) * 1e3)
+    return result, warm_ms, float(np.median(lat))
+
+
+def config2(dtype):
+    sim = _sim(1000, policy=_policy_cpu_mem_5m(), seed=2)
+    result, warm, steady = _run_batch(sim, 1000, dtype)
+    emit({"config": 2, "desc": "1k pods / 1k nodes, cpu+mem avg_5m weights",
+          "assigned": len(result.assignments),
+          "first_ms": round(warm, 1), "steady_ms": round(steady, 2)})
+
+
+def config3(dtype):
+    sim = _sim(10_000, seed=3)
+    result, warm, steady = _run_batch(sim, 10_000, dtype, bucket=16384)
+    emit({"config": 3, "desc": "10k pods / 10k nodes, full policy",
+          "assigned": len(result.assignments),
+          "first_ms": round(warm, 1), "steady_ms": round(steady, 2)})
+
+
+def config4(dtype):
+    from crane_scheduler_tpu.policy import compile_policy, load_policy_from_file
+    from crane_scheduler_tpu.loadstore import NodeLoadStore, encode_annotation
+    from crane_scheduler_tpu.scorer import BatchedScorer
+    from crane_scheduler_tpu.utils import format_local_time
+
+    policy = load_policy_from_file("deploy/dynamic/policy-12metrics.yaml")
+    tensors = compile_policy(policy)
+    n = 50_000
+    now = time.time()
+    rng = np.random.default_rng(4)
+    ts_str = format_local_time(now)
+    log(f"config4: building {n} nodes x {tensors.num_metrics} metric annotations")
+    annos = []
+    for i in range(n):
+        annos.append((f"node-{i:05d}", {
+            m: f"{rng.uniform(0, 1):.5f},{ts_str}" for m in tensors.metric_names
+        }))
+    store = NodeLoadStore(tensors, initial_capacity=n)
+    t0 = time.perf_counter()
+    store.bulk_ingest(annos)
+    ingest_ms = (time.perf_counter() - t0) * 1e3
+    t0 = time.perf_counter()
+    snap = store.snapshot()
+    snapshot_ms = (time.perf_counter() - t0) * 1e3
+    scorer = BatchedScorer(tensors, dtype=dtype)
+    import jax
+
+    r = scorer(snap.values, snap.ts, snap.hot_value, snap.hot_ts, snap.node_valid, now)
+    jax.block_until_ready(r.scores)
+    lat = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        r = scorer(snap.values, snap.ts, snap.hot_value, snap.hot_ts, snap.node_valid, now)
+        jax.block_until_ready(r.scores)
+        lat.append((time.perf_counter() - t0) * 1e3)
+    emit({"config": 4,
+          "desc": "50k nodes x 12 metrics streaming refresh + score",
+          "bulk_ingest_ms": round(ingest_ms, 1),
+          "snapshot_ms": round(snapshot_ms, 1),
+          "score_ms_median": round(float(np.median(lat)), 2),
+          "schedulable": int(np.asarray(r.schedulable).sum())})
+
+
+def config5(dtype):
+    import jax
+
+    from crane_scheduler_tpu.loadstore.store import DeviceSnapshot
+    from crane_scheduler_tpu.parallel import ShardedScheduleStep, make_node_mesh
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY, compile_policy
+
+    tensors = compile_policy(DEFAULT_POLICY)
+    n, p = 50_000, 100_000
+    now = time.time()
+    rng = np.random.default_rng(5)
+    snap = DeviceSnapshot(
+        values=rng.uniform(0, 1, size=(n, tensors.num_metrics)),
+        ts=np.full((n, tensors.num_metrics), now - 30.0),
+        hot_value=rng.integers(0, 3, size=(n,)).astype(np.float64),
+        hot_ts=np.full((n,), now - 30.0),
+        node_valid=np.ones((n,), dtype=bool),
+        n_nodes=n,
+        node_names=(),
+    )
+    mesh = make_node_mesh(len(jax.devices()))
+    step = ShardedScheduleStep(tensors, mesh, dtype=dtype)
+    prepared = step.prepare(snap, now, capacity=np.full((n,), 110, dtype=np.int64))
+    t0 = time.perf_counter()
+    result = step(prepared, p)
+    jax.block_until_ready(result.counts)
+    first = (time.perf_counter() - t0) * 1e3
+    lat = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        result = step(prepared, p)
+        jax.block_until_ready(result.counts)
+        lat.append((time.perf_counter() - t0) * 1e3)
+    emit({"config": 5,
+          "desc": "100k-pod burst gang-schedule, mesh-sharded",
+          "devices": len(jax.devices()),
+          "first_ms": round(first, 1),
+          "p50_ms": round(float(np.percentile(lat, 50)), 3),
+          "p99_ms": round(float(np.percentile(lat, 99)), 3),
+          "assigned": int(np.asarray(result.counts).sum())})
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--device", choices=["cpu", "default"], default="default")
+    parser.add_argument("--configs", default="1,2,3,4,5")
+    parser.add_argument("--f64", action="store_true")
+    args = parser.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    if args.device == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    dtype = jnp.float64 if args.f64 else jnp.float32
+    log(f"devices: {jax.devices()}, dtype: {dtype}")
+    todo = {int(c) for c in args.configs.split(",")}
+    if 1 in todo:
+        config1()
+    if 2 in todo:
+        config2(dtype)
+    if 3 in todo:
+        config3(dtype)
+    if 4 in todo:
+        config4(dtype)
+    if 5 in todo:
+        config5(dtype)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
