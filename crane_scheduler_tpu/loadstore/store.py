@@ -65,6 +65,8 @@ class NodeLoadStore:
         self.ts = np.full((cap, m), _NEG_INF, dtype=np.float64)
         self.hot_value = np.full((cap,), np.nan, dtype=np.float64)
         self.hot_ts = np.full((cap,), _NEG_INF, dtype=np.float64)
+        # per-node annotation-map identity for skip-unchanged refreshes
+        self._last_anno: dict[str, object] = {}
 
     # -- node membership ---------------------------------------------------
 
@@ -96,6 +98,7 @@ class NodeLoadStore:
     def remove_node(self, name: str) -> None:
         """Swap-remove; row order is not part of the contract."""
         i = self._index.pop(name, None)
+        self._last_anno.pop(name, None)
         if i is None:
             return
         last = self._n - 1
@@ -130,6 +133,7 @@ class NodeLoadStore:
         i = self._index.get(node)
         if i is None:
             i = self.add_node(node)
+        self._last_anno.pop(node, None)
         col = self.tensors.metric_index.get(metric)
         if col is None:
             return  # metric not referenced by the policy: ignore
@@ -140,6 +144,7 @@ class NodeLoadStore:
         i = self._index.get(node)
         if i is None:
             i = self.add_node(node)
+        self._last_anno.pop(node, None)
         self.hot_value[i] = value
         self.hot_ts[i] = ts
 
@@ -161,6 +166,7 @@ class NodeLoadStore:
         deleted annotation doesn't linger as live metric state.
         """
         i = self.add_node(node)
+        self._last_anno[node] = anno
         self.values[i, :] = np.nan
         self.ts[i, :] = _NEG_INF
         self.hot_value[i] = np.nan
@@ -196,19 +202,27 @@ class NodeLoadStore:
         self.hot_value[ids] = values
         self.hot_ts[ids] = ts
 
-    def bulk_ingest(self, items) -> None:
+    def bulk_ingest(self, items, skip_unchanged: bool = True) -> None:
         """Ingest many (node_name, annotation_map) pairs with one native
         parse call (falls back to the Python codec transparently).
 
         Semantics identical to calling ``ingest_node_annotations`` per
-        node: each map is authoritative for its node.
+        node: each map is authoritative for its node. With
+        ``skip_unchanged`` (default), a node whose annotation map is the
+        *same object* as last time is skipped — the cluster model replaces
+        the map on every patch, so identity works like an informer's
+        resourceVersion check and steady-state refreshes are O(changed).
         """
         from ..native.codec import bulk_parse_annotations
 
         raws: list[str | None] = []
-        slots: list[tuple[int, int]] = []  # (row, col); col -1 == hot value
+        rows: list[int] = []
+        cols: list[int] = []  # -1 == hot value
         for name, anno in items:
             i = self.add_node(name)
+            if skip_unchanged and self._last_anno.get(name) is anno:
+                continue
+            self._last_anno[name] = anno
             self.values[i, :] = np.nan
             self.ts[i, :] = _NEG_INF
             self.hot_value[i] = np.nan
@@ -218,22 +232,25 @@ class NodeLoadStore:
             for key, raw in anno.items():
                 if key == NODE_HOT_VALUE_KEY:
                     raws.append(raw)
-                    slots.append((i, -1))
+                    rows.append(i)
+                    cols.append(-1)
                 else:
                     col = self.tensors.metric_index.get(key)
                     if col is not None:
                         raws.append(raw)
-                        slots.append((i, col))
+                        rows.append(i)
+                        cols.append(col)
         if not raws:
             return
         values, ts = bulk_parse_annotations(raws)
-        for k, (row, col) in enumerate(slots):
-            if col < 0:
-                self.hot_value[row] = values[k]
-                self.hot_ts[row] = ts[k]
-            else:
-                self.values[row, col] = values[k]
-                self.ts[row, col] = ts[k]
+        rows_arr = np.asarray(rows, dtype=np.int64)
+        cols_arr = np.asarray(cols, dtype=np.int64)
+        metric_mask = cols_arr >= 0
+        self.values[rows_arr[metric_mask], cols_arr[metric_mask]] = values[metric_mask]
+        self.ts[rows_arr[metric_mask], cols_arr[metric_mask]] = ts[metric_mask]
+        hot_mask = ~metric_mask
+        self.hot_value[rows_arr[hot_mask]] = values[hot_mask]
+        self.hot_ts[rows_arr[hot_mask]] = ts[hot_mask]
 
     # -- snapshot ----------------------------------------------------------
 

@@ -92,3 +92,33 @@ def test_negative_period_claims_active_duration():
 def test_multi_dot_duration_is_duration_error():
     with pytest.raises(DurationError):
         parse_go_duration("1.2.3h")
+
+
+def test_bulk_ingest_skip_unchanged_identity():
+    spec = PolicySpec(
+        sync_period=(SyncPolicy("a", 60.0),),
+        priority=(PriorityPolicy("a", 1.0),),
+    )
+    tensors = compile_policy(DynamicSchedulerPolicy(spec=spec))
+    store = NodeLoadStore(tensors)
+    anno = {"a": entry("0.20000")}
+    store.bulk_ingest([("n", anno)])
+    col = tensors.metric_index["a"]
+    assert store.values[store.node_id("n"), col] == 0.2
+    # same object: skipped even if mutated in place (documented contract:
+    # the cluster replaces maps on patch, never mutates)
+    store.bulk_ingest([("n", anno)])
+    assert store.values[store.node_id("n"), col] == 0.2
+    # new object with new content: re-ingested
+    store.bulk_ingest([("n", {"a": entry("0.70000")})])
+    assert store.values[store.node_id("n"), col] == 0.7
+    # direct write invalidates the identity cache
+    anno2 = {"a": entry("0.40000")}
+    store.bulk_ingest([("n", anno2)])
+    store.set_metric("n", "a", 0.99, 0.0)
+    store.bulk_ingest([("n", anno2)])  # same object, but cache was popped
+    assert store.values[store.node_id("n"), col] == 0.4
+    # removal clears the cache entry
+    store.remove_node("n")
+    store.bulk_ingest([("n", anno2)])
+    assert store.values[store.node_id("n"), col] == 0.4
