@@ -1,0 +1,22 @@
+# crane-scheduler-tpu image (equivalent of the reference's two-stage,
+# one-parameterized-image-per-binary Dockerfile; ENTRYPOINT_MODULE selects
+# the entrypoint the way the reference's ARG PKGNAME selects the binary).
+FROM python:3.12-slim AS builder
+RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY native/ native/
+RUN make -C native
+
+FROM python:3.12-slim
+RUN apt-get update && apt-get install -y --no-install-recommends tzdata \
+    && rm -rf /var/lib/apt/lists/*
+ENV TZ=Asia/Shanghai
+RUN pip install --no-cache-dir "jax[cpu]" pyyaml numpy
+WORKDIR /app
+COPY crane_scheduler_tpu/ crane_scheduler_tpu/
+COPY deploy/ deploy/
+COPY --from=builder /src/native/libcrane_native.so native/libcrane_native.so
+ARG ENTRYPOINT_MODULE=crane_scheduler_tpu.cli.annotator_main
+ENV ENTRYPOINT_MODULE=${ENTRYPOINT_MODULE}
+ENTRYPOINT ["sh", "-c", "exec python -m ${ENTRYPOINT_MODULE} \"$@\"", "--"]

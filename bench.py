@@ -121,12 +121,19 @@ def main() -> int:
     for _ in range(WARMUP - 1):
         jax.block_until_ready(step(prepared, N_PODS).counts)
 
+    from crane_scheduler_tpu.utils.profiling import jax_trace
+
+    profile_dir = None
+    if "--profile" in sys.argv:
+        profile_dir = "/tmp/crane_bench_trace"
+        log(f"profiling to {profile_dir}")
     lat = []
-    for _ in range(ITERS):
-        t0 = time.perf_counter()
-        result = step(prepared, N_PODS)
-        jax.block_until_ready(result.counts)
-        lat.append(time.perf_counter() - t0)
+    with jax_trace(profile_dir):
+        for _ in range(ITERS):
+            t0 = time.perf_counter()
+            result = step(prepared, N_PODS)
+            jax.block_until_ready(result.counts)
+            lat.append(time.perf_counter() - t0)
     lat_ms = np.array(lat) * 1e3
     p50, p99 = float(np.percentile(lat_ms, 50)), float(np.percentile(lat_ms, 99))
     mean = float(lat_ms.mean())

@@ -168,6 +168,55 @@ class NodeAnnotator:
             for node_name in self.cluster.node_names():
                 self.sync_node(_meta_key(node_name, sp.name), now)
 
+    def sync_metric_bulk(self, metric_name: str, now: float | None = None) -> int:
+        """Bulk sync: ONE metrics query covers every node.
+
+        The reference issues |nodes| filtered Prometheus queries per
+        metric per cycle (ref: node.go:148-177); sources exposing
+        ``query_all_by_metric`` serve the whole column in one instant
+        query. Nodes without a sample fall back to the per-node work
+        queue (IP-then-name path with backoff). Returns patched count.
+        """
+        if now is None:
+            now = time.time()
+        query_all = getattr(self.metrics, "query_all_by_metric", None)
+        if query_all is None:
+            # source has no bulk support: per-node path for everyone
+            self.enqueue_metric(metric_name)
+            return 0
+        try:
+            samples = query_all(metric_name)
+        except MetricsQueryError:
+            self.enqueue_metric(metric_name)
+            return 0
+        # index samples by exact instance and by host (port stripped)
+        by_host: dict[str, str] = {}
+        for instance, value in samples.items():
+            by_host.setdefault(instance, value)
+            host = instance.rsplit(":", 1)[0]
+            if host != instance:
+                by_host.setdefault(host, value)
+        patched = 0
+        for node in self.cluster.list_nodes():
+            value = by_host.get(node.internal_ip()) or by_host.get(node.name)
+            if not value:
+                self.queue.add(_meta_key(node.name, metric_name))
+                continue
+            self.cluster.patch_node_annotation(
+                node.name, metric_name, encode_annotation(value, now)
+            )
+            self.annotate_node_hot_value(node, now)
+            patched += 1
+            self.synced += 1
+        return patched
+
+    def sync_all_once_bulk(self, now: float | None = None) -> None:
+        """Deterministic bulk pass over syncPolicy metrics."""
+        if now is None:
+            now = time.time()
+        for sp in self.policy.spec.sync_period:
+            self.sync_metric_bulk(sp.name, now)
+
     # -- TPU-native bulk refresh ------------------------------------------
 
     def refresh_store(self, store: NodeLoadStore) -> None:

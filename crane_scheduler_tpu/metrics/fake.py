@@ -52,6 +52,14 @@ class FakeMetricsSource:
             value = 0.0
         return format_metric_value(value)
 
+    def query_all_by_metric(self, metric_name: str) -> dict:
+        """Bulk variant: every known instance's value for one metric."""
+        out = {}
+        for (metric, instance), value in self._by_ip.items():
+            if metric == metric_name and (metric, instance) not in self._fail_ip:
+                out[instance] = self._render(value)
+        return out
+
     def query_by_node_ip(self, metric_name: str, ip: str) -> str:
         self.ip_queries += 1
         key = (metric_name, ip)

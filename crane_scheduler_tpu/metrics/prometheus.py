@@ -52,6 +52,41 @@ class PrometheusClient:
             return result
         raise MetricsQueryError(f"no data for {metric_name}{{instance=~{name}}}")
 
+    def query_all_by_metric(self, metric_name: str) -> dict:
+        """One unfiltered instant query: every instance's value at once.
+
+        The bulk-refresh path the reference lacks — it issues
+        |nodes| x |metrics| filtered queries per sync cycle
+        (ref: node.go:148-177); this issues |metrics|. Returns
+        {instance_label: value_string} with the same clamping and
+        5-decimal rendering; the instance label may carry a port suffix
+        (callers strip it when matching node IPs).
+        """
+        url = f"{self.address}/api/v1/query?" + urllib.parse.urlencode(
+            {"query": f"{metric_name} /100"}
+        )
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout) as resp:
+                payload = json.load(resp)
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            raise MetricsQueryError(f"query failed: {e}") from e
+        if payload.get("status") != "success":
+            raise MetricsQueryError(f"query error: {payload.get('error')}")
+        data = payload.get("data", {})
+        if data.get("resultType") != "vector":
+            raise MetricsQueryError(f"illegal result type: {data.get('resultType')}")
+        out: dict[str, str] = {}
+        for elem in data.get("result", []):
+            try:
+                instance = elem["metric"].get("instance", "")
+                value = float(elem["value"][1])
+            except (KeyError, IndexError, TypeError, ValueError):
+                continue
+            if value < 0 or math.isnan(value):
+                value = 0.0
+            out[instance] = format_metric_value(value)  # last sample wins per instance
+        return out
+
     def query_by_node_ip_with_offset(self, metric_name: str, ip: str, offset: str) -> str:
         result = self._try_query(f'{metric_name}{{instance=~"{ip}"}} offset {offset} /100')
         if result:

@@ -1,0 +1,201 @@
+"""Fused Pallas TPU kernel for the Dynamic filter+score hot op.
+
+One VMEM pass computes both verdicts for a block of nodes: the load
+matrix lives transposed ``[M_pad, N]`` so the (tiny, static) metric axis
+sits on sublanes and the node axis streams along lanes; every predicate
+and priority entry is unrolled at trace time from the compiled policy
+(thresholds/weights/windows are kernel constants), so the whole scoring
+function — staleness masks, fail-open logic, ordered weighted
+accumulation, Go-style truncation, hot-value penalty, clamp — is a single
+fused VPU loop with no intermediate HBM traffic.
+
+This is the float32 fast path only (the float64 parity mode stays on the
+XLA scorer); like ``BatchedScorer`` float32 mode it expects timestamps
+rebased to ``now`` (now = 0). Correctness is tested against
+``BatchedScorer(float32)`` in interpret mode on CPU and compiled on TPU.
+
+Layout notes (pallas_guide.md): float32 min tile is (8, 128), so M pads
+to a multiple of 8 and node blocks are multiples of 128; int32 outputs
+are materialized as an (8, BN) block (row 0 is the payload) to respect
+output tiling.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from ..constants import (
+    HOT_VALUE_ACTIVE_PERIOD_SECONDS,
+    MAX_NODE_SCORE,
+    MIN_NODE_SCORE,
+)
+from ..policy.compile import PolicyTensors
+
+_MIN_I32 = np.int32(-(2**31))
+_LIMIT_F32 = np.float32(2.0**31)
+
+
+def _go_trunc_i32(q):
+    ok = jnp.isfinite(q) & (q > -_LIMIT_F32) & (q < _LIMIT_F32)
+    safe = jnp.where(ok, jnp.trunc(q), 0.0)
+    return jnp.where(ok, safe.astype(jnp.int32), _MIN_I32)
+
+
+def _make_kernel(tensors: PolicyTensors):
+    pred = [
+        (int(tensors.pred_idx[p]), float(tensors.pred_threshold[p]), float(tensors.pred_active[p]))
+        for p in range(len(tensors.pred_idx))
+    ]
+    prio = [
+        (int(tensors.prio_idx[k]), float(tensors.prio_weight[k]), float(tensors.prio_active[k]))
+        for k in range(len(tensors.prio_idx))
+    ]
+    weight_sum = float(tensors.weight_sum)
+    has_prio = len(prio) > 0
+
+    def kernel(values_ref, ts_ref, hot_ref, hot_ts_ref, valid_ref, sched_ref, score_ref):
+        # refs: values/ts [M_pad, BN]; hot/hot_ts/valid [8, BN]; outputs [8, BN]
+        zero = jnp.float32(0.0)
+
+        over = None
+        for idx, threshold, active in pred:
+            if active <= 0.0:  # entry disabled: skipped before any read
+                continue
+            u = values_ref[idx, :]
+            t = ts_ref[idx, :]
+            ok = (zero < t + jnp.float32(active)) & ~(u < 0)
+            if threshold != 0.0:  # zero threshold disables the entry
+                o = ok & (u > jnp.float32(threshold))
+                over = o if over is None else (over | o)
+        n_lanes = values_ref.shape[1]
+        if over is None:
+            over = jnp.zeros((n_lanes,), dtype=jnp.bool_)
+
+        if has_prio:
+            acc = jnp.zeros((n_lanes,), dtype=jnp.float32)
+            for idx, weight, active in prio:
+                if active > 0.0:
+                    u = values_ref[idx, :]
+                    t = ts_ref[idx, :]
+                    ok = (zero < t + jnp.float32(active)) & ~(u < 0)
+                    contrib = (1.0 - u) * jnp.float32(weight) * jnp.float32(MAX_NODE_SCORE)
+                    acc = acc + jnp.where(ok, contrib, zero)
+                # inactive entries contribute 0 (weight is in weight_sum)
+            if weight_sum == 0.0:
+                q = jnp.where(acc == 0.0, jnp.float32(jnp.nan), jnp.sign(acc) * jnp.float32(jnp.inf))
+            else:
+                q = acc / jnp.float32(weight_sum)
+            base = _go_trunc_i32(q)
+        else:
+            base = jnp.zeros((n_lanes,), dtype=jnp.int32)
+
+        hot = hot_ref[0, :]
+        hot_t = hot_ts_ref[0, :]
+        hot_ok = (zero < hot_t + jnp.float32(HOT_VALUE_ACTIVE_PERIOD_SECONDS)) & ~(hot < 0)
+        hv = jnp.where(hot_ok, hot, zero)
+        penalty = _go_trunc_i32(hv * 10.0)
+        score = jnp.clip(base - penalty, MIN_NODE_SCORE, MAX_NODE_SCORE)
+
+        valid = valid_ref[0, :] != 0
+        score = jnp.where(valid, score, 0)
+        sched = (~over) & valid
+
+        # broadcast payload across the 8 sublanes of the output tile
+        sched_ref[:, :] = jnp.broadcast_to(
+            sched.astype(jnp.int32)[None, :], sched_ref.shape
+        )
+        score_ref[:, :] = jnp.broadcast_to(score[None, :], score_ref.shape)
+
+    return kernel
+
+
+class PallasScorer:
+    """Drop-in float32 scorer backed by the fused Pallas kernel.
+
+    Same call convention as ``BatchedScorer`` (epoch timestamps in, the
+    wrapper rebases them around ``now``); requires the node axis padded
+    to a multiple of ``block_nodes`` (snapshots already pad to 2048).
+    """
+
+    def __init__(self, tensors: PolicyTensors, block_nodes: int = 2048, interpret: bool = False):
+        self.tensors = tensors
+        self.block = block_nodes
+        self.interpret = interpret
+        self._kernel = _make_kernel(tensors)
+        self._m_pad = max(8, math.ceil(max(tensors.num_metrics, 1) / 8) * 8)
+        self._jit = jax.jit(functools.partial(self._run))
+
+    def _run(self, values_t, ts_t, hot, hot_ts, valid):
+        m_pad, n = values_t.shape
+        bn = min(self.block, n)
+        grid = (n // bn,)
+        row_specs = pl.BlockSpec((m_pad, bn), lambda i: (0, i))
+        vec_specs = pl.BlockSpec((8, bn), lambda i: (0, i))
+        out = pl.pallas_call(
+            self._kernel,
+            grid=grid,
+            in_specs=[row_specs, row_specs, vec_specs, vec_specs, vec_specs],
+            out_specs=[vec_specs, vec_specs],
+            out_shape=[
+                jax.ShapeDtypeStruct((8, n), jnp.int32),
+                jax.ShapeDtypeStruct((8, n), jnp.int32),
+            ],
+            interpret=self.interpret,
+        )(values_t, ts_t, hot, hot_ts, valid)
+        return out[0][0, :] != 0, out[1][0, :]
+
+    def __call__(self, values, ts, hot_value, hot_ts, node_valid, now):
+        from .batched import ScoreResult
+
+        now = float(now)
+        n, m = np.asarray(values).shape
+        if n % 128 != 0:
+            raise ValueError(f"node axis must pad to a multiple of 128, got {n}")
+        values_t = np.full((self._m_pad, n), np.nan, dtype=np.float32)
+        values_t[:m, :] = np.asarray(values, dtype=np.float32).T
+        ts_rel = np.asarray(ts, dtype=np.float64) - now
+        ts_t = np.full((self._m_pad, n), -np.inf, dtype=np.float32)
+        ts_t[:m, :] = ts_rel.T
+        hot = np.zeros((8, n), dtype=np.float32)
+        hot[0, :] = np.asarray(hot_value, dtype=np.float32)
+        hts = np.full((8, n), -np.inf, dtype=np.float32)
+        hts[0, :] = np.asarray(hot_ts, dtype=np.float64) - now
+        valid = np.zeros((8, n), dtype=np.int32)
+        valid[0, :] = np.asarray(node_valid).astype(np.int32)
+        schedulable, scores = self._jit(
+            jnp.asarray(values_t),
+            jnp.asarray(ts_t),
+            jnp.asarray(hot),
+            jnp.asarray(hts),
+            jnp.asarray(valid),
+        )
+        return ScoreResult(schedulable, scores)
+
+    def prepare(self, snapshot, now: float):
+        """Pre-transpose a snapshot once (device-resident inputs for
+        repeated calls); returns args for ``run_prepared``."""
+        now = float(now)
+        n, m = snapshot.values.shape
+        values_t = np.full((self._m_pad, n), np.nan, dtype=np.float32)
+        values_t[:m, :] = np.asarray(snapshot.values, dtype=np.float32).T
+        ts_t = np.full((self._m_pad, n), -np.inf, dtype=np.float32)
+        ts_t[:m, :] = (np.asarray(snapshot.ts, dtype=np.float64) - now).T
+        hot = np.zeros((8, n), dtype=np.float32)
+        hot[0, :] = np.asarray(snapshot.hot_value, dtype=np.float32)
+        hts = np.full((8, n), -np.inf, dtype=np.float32)
+        hts[0, :] = np.asarray(snapshot.hot_ts, dtype=np.float64) - now
+        valid = np.zeros((8, n), dtype=np.int32)
+        valid[0, :] = np.asarray(snapshot.node_valid).astype(np.int32)
+        return tuple(jnp.asarray(a) for a in (values_t, ts_t, hot, hts, valid))
+
+    def run_prepared(self, prepared):
+        from .batched import ScoreResult
+
+        schedulable, scores = self._jit(*prepared)
+        return ScoreResult(schedulable, scores)

@@ -1,0 +1,55 @@
+"""Tracing/profiling hooks.
+
+SURVEY §5: the reference has no tracing — its only latency visibility is
+log lines timing each sync. Here:
+
+- ``phase_timer``: lightweight wall-clock phase timing with counters
+  (always available, no deps);
+- ``jax_trace``: wraps a block in a JAX profiler trace (viewable with
+  TensorBoard / xprof) for device-level analysis of the scorer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+
+class PhaseTimer:
+    """Accumulates per-phase wall time and counts."""
+
+    def __init__(self):
+        self.seconds: dict[str, float] = defaultdict(float)
+        self.counts: dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.seconds[name] += time.perf_counter() - start
+            self.counts[name] += 1
+
+    def report(self) -> dict:
+        return {
+            name: {
+                "total_ms": round(self.seconds[name] * 1e3, 3),
+                "count": self.counts[name],
+                "mean_ms": round(self.seconds[name] * 1e3 / max(self.counts[name], 1), 3),
+            }
+            for name in sorted(self.seconds)
+        }
+
+
+@contextlib.contextmanager
+def jax_trace(log_dir: str | None):
+    """JAX profiler trace when ``log_dir`` is set; no-op otherwise."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

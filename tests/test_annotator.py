@@ -326,3 +326,36 @@ def test_threaded_annotator_end_to_end():
             raise AssertionError("annotations not written in time")
     finally:
         ann.stop()
+
+
+def test_bulk_metric_sync_one_query_all_nodes():
+    cluster = make_cluster(3)
+    fake = FakeMetricsSource()
+    for i in range(3):
+        fake.set("cpu_usage_avg_5m", f"10.0.0.{i}", 0.2 + 0.1 * i, by="ip")
+    ann = NodeAnnotator(cluster, fake, DEFAULT_POLICY)
+    patched = ann.sync_metric_bulk("cpu_usage_avg_5m", NOW)
+    assert patched == 3
+    for i in range(3):
+        anno = cluster.get_node(f"node-{i}").annotations
+        assert anno["cpu_usage_avg_5m"].startswith(f"0.{2 + i}0000,")
+        assert "node_hot_value" in anno
+
+
+def test_bulk_metric_sync_port_suffix_instances():
+    cluster = make_cluster(1)
+    fake = FakeMetricsSource()
+    fake.set("cpu_usage_avg_5m", "10.0.0.0:9100", 0.5, by="ip")
+    ann = NodeAnnotator(cluster, fake, DEFAULT_POLICY)
+    assert ann.sync_metric_bulk("cpu_usage_avg_5m", NOW) == 1
+    assert cluster.get_node("node-0").annotations["cpu_usage_avg_5m"].startswith("0.50000,")
+
+
+def test_bulk_metric_sync_missing_node_falls_back_to_queue():
+    cluster = make_cluster(2)
+    fake = FakeMetricsSource()
+    fake.set("cpu_usage_avg_5m", "10.0.0.0", 0.3, by="ip")  # node-1 missing
+    ann = NodeAnnotator(cluster, fake, DEFAULT_POLICY)
+    assert ann.sync_metric_bulk("cpu_usage_avg_5m", NOW) == 1
+    assert len(ann.queue) == 1  # node-1 queued for the per-node path
+    assert ann.queue.get(timeout=0) == "node-1/cpu_usage_avg_5m"

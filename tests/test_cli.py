@@ -1,0 +1,67 @@
+"""CLI entrypoint smoke tests (in-process main() calls on the CPU backend)."""
+
+import json
+
+import pytest
+
+from crane_scheduler_tpu.cli import annotator_main, sim_main
+
+
+def test_sim_main_batch(capsys):
+    assert sim_main.main(["--nodes", "12", "--pods", "20", "--mode", "batch"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["scheduled"] == 20
+    assert out["unschedulable"] == 0
+    assert out["mode"] == "batch"
+
+
+def test_sim_main_plugin_with_sync(capsys):
+    assert (
+        sim_main.main(
+            ["--nodes", "6", "--pods", "9", "--mode", "plugin", "--sync-every", "3"]
+        )
+        == 0
+    )
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["scheduled"] == 9
+    assert out["latency_ms"]["p99"] > 0
+
+
+def test_sim_main_sharded(capsys):
+    assert (
+        sim_main.main(
+            ["--nodes", "16", "--pods", "24", "--mode", "sharded", "--devices", "8"]
+        )
+        == 0
+    )
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["scheduled"] == 24
+
+
+def test_annotator_main_demo(capsys, tmp_path):
+    rc = annotator_main.main(
+        [
+            "--demo-nodes", "3",
+            "--run-seconds", "0.8",
+            "--health-port", "0",
+            "--concurrent-syncs", "2",
+        ]
+    )
+    assert rc == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    stats = json.loads(lines[-1])
+    assert stats["synced"] > 0
+    assert stats["sync_errors"] == 0
+
+
+def test_annotator_main_nodes_file(capsys, tmp_path):
+    nodes_file = tmp_path / "nodes.json"
+    nodes_file.write_text(json.dumps([{"name": "n1", "ip": "10.0.0.1"}]))
+    rc = annotator_main.main(
+        [
+            "--nodes-file", str(nodes_file),
+            "--run-seconds", "0.5",
+            "--health-port", "0",
+        ]
+    )
+    assert rc == 0

@@ -114,3 +114,24 @@ def test_query_by_name_no_port_fallback(stub):
     with pytest.raises(MetricsQueryError):
         client.query_by_node_name("m", "node-1")
     assert StubProm.queries == ['m{instance=~"node-1"} /100']
+
+
+def test_query_all_by_metric_bulk(stub):
+    client = PrometheusClient(f"http://127.0.0.1:{stub.server_port}")
+    StubProm.responses["m /100"] = {
+        "status": "success",
+        "data": {
+            "resultType": "vector",
+            "result": [
+                {"metric": {"instance": "10.0.0.1:9100"}, "value": [0, "0.4"]},
+                {"metric": {"instance": "10.0.0.2:9100"}, "value": [0, "-1"]},
+                {"metric": {"instance": "10.0.0.3"}, "value": [0, "0.75"]},
+            ],
+        },
+    }
+    out = client.query_all_by_metric("m")
+    assert out == {
+        "10.0.0.1:9100": "0.40000",
+        "10.0.0.2:9100": "0.00000",  # negative clamped
+        "10.0.0.3": "0.75000",
+    }
