@@ -1,0 +1,164 @@
+"""Concurrency stress + cold-start resume (SURVEY §5: race-detection via
+run-time invariants, checkpoint/resume via cluster-as-source-of-truth)."""
+
+import threading
+import time
+
+import pytest
+
+from crane_scheduler_tpu.annotator import AnnotatorConfig, NodeAnnotator
+from crane_scheduler_tpu.cluster import ClusterState, Node, NodeAddress, Pod
+from crane_scheduler_tpu.metrics import FakeMetricsSource
+from crane_scheduler_tpu.policy import DEFAULT_POLICY, compile_policy
+from crane_scheduler_tpu.policy.types import (
+    DynamicSchedulerPolicy,
+    HotValuePolicy,
+    PolicySpec,
+    SyncPolicy,
+)
+
+NOW = 1753776000.0
+
+
+def test_concurrent_annotator_scheduler_store_refresh():
+    """Annotator workers, pod binds, and store refreshes race freely; the
+    invariants: no exceptions anywhere, annotations stay well-formed, the
+    store stays consistent with the node set."""
+    from crane_scheduler_tpu.loadstore import NodeLoadStore, decode_annotation
+
+    cluster = ClusterState()
+    fake = FakeMetricsSource()
+    for i in range(20):
+        name, ip = f"node-{i}", f"10.0.0.{i}"
+        cluster.add_node(Node(name=name, addresses=(NodeAddress("InternalIP", ip),)))
+        fake.set("cpu_usage_avg_5m", ip, lambda i=i: 0.1 + (i % 7) * 0.1, by="ip")
+        fake.set("mem_usage_avg_5m", ip, 0.4, by="ip")
+    policy = DynamicSchedulerPolicy(spec=PolicySpec(
+        sync_period=(SyncPolicy("cpu_usage_avg_5m", 0.02),
+                     SyncPolicy("mem_usage_avg_5m", 0.03)),
+        hot_value=(HotValuePolicy(300.0, 2),),
+    ))
+    ann = NodeAnnotator(cluster, fake, policy, AnnotatorConfig(concurrent_syncs=4))
+    tensors = compile_policy(policy)
+    store = NodeLoadStore(tensors)
+    errors = []
+    stop = threading.Event()
+
+    def binder():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            pod = Pod(name=f"p{i}", namespace="d")
+            cluster.add_pod(pod)
+            cluster.bind_pod(pod.key(), f"node-{i % 20}")
+            time.sleep(0.002)
+
+    def refresher():
+        while not stop.is_set():
+            try:
+                ann.refresh_store(store)
+                snap = store.snapshot(bucket=32)
+                assert snap.n_nodes <= 23  # 20 base + up to 3 churner extras
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+            time.sleep(0.005)
+
+    def churner():
+        j = 0
+        while not stop.is_set():
+            j += 1
+            name = f"extra-{j % 3}"
+            cluster.add_node(Node(name=name))
+            time.sleep(0.004)
+            cluster.delete_node(name)
+
+    ann.start()
+    threads = [threading.Thread(target=f, daemon=True) for f in (binder, refresher, churner)]
+    for t in threads:
+        t.start()
+    time.sleep(1.2)
+    stop.set()
+    for t in threads:
+        t.join(timeout=2.0)
+    ann.stop()
+    assert not errors
+    # every annotation written during the storm is well-formed
+    for node in cluster.list_nodes():
+        for key, raw in node.annotations.items():
+            value, ts = decode_annotation(raw)
+            assert value is not None and ts is not None, (node.name, key, raw)
+    assert ann.synced > 0
+
+
+def test_cold_start_rebuilds_hot_values_from_event_replay():
+    """A restarted annotator (fresh heap) replays the bounded event log and
+    recovers hot values — the reference's recovery story (SURVEY §5)."""
+    cluster = ClusterState()
+    fake = FakeMetricsSource()
+    cluster.add_node(Node(name="n1", addresses=(NodeAddress("InternalIP", "10.0.0.1"),)))
+    fake.set("cpu_usage_avg_5m", "10.0.0.1", 0.2, by="ip")
+
+    first = NodeAnnotator(cluster, fake, DEFAULT_POLICY)
+    first.event_ingestor.start()
+    for i in range(10):
+        pod = Pod(name=f"p{i}", namespace="d")
+        cluster.add_pod(pod)
+        cluster.bind_pod(pod.key(), "n1", NOW - 5)
+    assert first.binding_records.get_last_node_binding_count("n1", 300, NOW) == 10
+
+    # "restart": a brand-new annotator with an empty heap
+    second = NodeAnnotator(cluster, fake, DEFAULT_POLICY)
+    assert second.binding_records.get_last_node_binding_count("n1", 300, NOW) == 0
+    second.event_ingestor.replay()
+    assert second.binding_records.get_last_node_binding_count("n1", 300, NOW) == 10
+    # and the hot value annotation it writes reflects the replayed history:
+    # 10 bindings -> 10//5 + 10//2 = 7 with the default policy
+    second.sync_node("n1/cpu_usage_avg_5m", NOW)
+    hot = cluster.get_node("n1").annotations["node_hot_value"]
+    assert hot.startswith("7,")
+
+
+def test_store_is_cache_not_source_of_truth():
+    """Dropping the store loses nothing: a rebuild from cluster
+    annotations yields identical scoring inputs."""
+    import numpy as np
+
+    from crane_scheduler_tpu.loadstore import NodeLoadStore
+    from crane_scheduler_tpu.sim import SimConfig, Simulator
+
+    sim = Simulator(SimConfig(n_nodes=10, seed=11))
+    sim.sync_metrics()
+    tensors = compile_policy(DEFAULT_POLICY)
+    store1 = NodeLoadStore(tensors)
+    sim.annotator.refresh_store(store1)
+    # "crash": rebuild from scratch
+    store2 = NodeLoadStore(tensors)
+    sim.annotator.refresh_store(store2)
+    for name in store1.node_names:
+        i1, i2 = store1.node_id(name), store2.node_id(name)
+        np.testing.assert_array_equal(store1.values[i1], store2.values[i2])
+        np.testing.assert_array_equal(store1.ts[i1], store2.ts[i2])
+        assert store1.hot_value[i1] == store2.hot_value[i2] or (
+            np.isnan(store1.hot_value[i1]) and np.isnan(store2.hot_value[i2])
+        )
+
+
+def test_scheduler_cli_main(capsys):
+    from crane_scheduler_tpu.cli import scheduler_main
+
+    assert scheduler_main.main(
+        ["--config", "deploy/dynamic/scheduler-config.yaml",
+         "--demo-nodes", "8", "--pods", "12"]
+    ) == 0
+    import json
+
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["scheduled"] == 12
+    assert out["plugins"] == ["Dynamic"]
+
+    assert scheduler_main.main(
+        ["--config", "deploy/dynamic/scheduler-config.yaml",
+         "--demo-nodes", "8", "--pods", "20", "--batch-size", "10"]
+    ) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["scheduled"] == 20
