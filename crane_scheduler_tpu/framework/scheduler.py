@@ -25,9 +25,8 @@ import time
 from dataclasses import dataclass, field
 
 from ..cluster.state import ClusterState, Pod
-from ..framework.types import Code, CycleState, NodeInfo, Status
+from ..framework.types import CycleState, NodeInfo, Status
 from ..loadstore.store import NodeLoadStore
-from ..parallel.mesh import make_node_mesh
 from ..policy.compile import compile_policy
 from ..policy.types import DynamicSchedulerPolicy
 from ..scorer.batched import BatchedScorer

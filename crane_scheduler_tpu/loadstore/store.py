@@ -25,7 +25,8 @@ from typing import Iterable, Mapping
 
 import numpy as np
 
-from ..policy.compile import NODE_HOT_VALUE_KEY, PolicyTensors
+from ..constants import NODE_HOT_VALUE_KEY
+from ..policy.compile import PolicyTensors
 from .codec import decode_annotation
 
 _NEG_INF = float("-inf")

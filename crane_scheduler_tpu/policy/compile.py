@@ -14,11 +14,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from .types import DynamicSchedulerPolicy
-from ..constants import (
-    EXTRA_ACTIVE_PERIOD_SECONDS,
-    HOT_VALUE_ACTIVE_PERIOD_SECONDS,
-    NODE_HOT_VALUE_KEY,
-)
+from ..constants import EXTRA_ACTIVE_PERIOD_SECONDS
 
 
 @dataclass(frozen=True)

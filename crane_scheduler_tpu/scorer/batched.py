@@ -31,7 +31,6 @@ within float32 epsilon of a threshold).
 
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass
 from typing import Any
 

@@ -17,8 +17,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..cluster.state import Container, Pod
-from ..framework.types import Resource, is_hugepage_resource
-from ..utils.quantity import parse_quantity, to_milli
+from ..framework.types import Resource
+from ..utils.quantity import to_milli
 from .types import (
     ANNOTATION_POD_CPU_POLICY,
     ANNOTATION_POD_TOPOLOGY_AWARENESS,
