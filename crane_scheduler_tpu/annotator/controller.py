@@ -51,6 +51,10 @@ class AnnotatorConfig:
     # Prefer the C++ binding heap (one-pass batch counts) when the native
     # library builds; the Python heap is the always-available fallback.
     use_native_bindings: bool = True
+    # Tickers call sync_metric_bulk (one metrics query per metric per
+    # tick) instead of fanning out per-node work items; nodes missing
+    # from the bulk result still take the per-node queue path.
+    bulk_sync: bool = False
 
 
 def _split_meta_key(key: str) -> tuple[str, str]:
@@ -241,7 +245,11 @@ class NodeAnnotator:
             t.start()
             self._threads.append(t)
         for sp in self.policy.spec.sync_period:
-            self.enqueue_metric(sp.name)  # immediate first sync
+            # immediate first sync, then the periodic ticker
+            if self.config.bulk_sync:
+                self.sync_metric_bulk(sp.name)
+            else:
+                self.enqueue_metric(sp.name)
             t = threading.Thread(target=self._ticker, args=(sp,), daemon=True)
             t.start()
             self._threads.append(t)
@@ -273,7 +281,10 @@ class NodeAnnotator:
     def _ticker(self, sync_policy) -> None:
         period = max(sync_policy.period_seconds, 0.01)
         while not self._stop.wait(timeout=period):
-            self.enqueue_metric(sync_policy.name)
+            if self.config.bulk_sync:
+                self.sync_metric_bulk(sync_policy.name)
+            else:
+                self.enqueue_metric(sync_policy.name)
 
     def _gc_loop(self) -> None:
         while not self._stop.wait(timeout=60.0):

@@ -55,6 +55,7 @@ class ScoringService:
         dtype=None,
         clock=time.time,
         snapshot_bucket: int = 2048,
+        backend: str = "xla",
     ):
         import jax.numpy as jnp
 
@@ -62,7 +63,15 @@ class ScoringService:
         self.policy = policy
         self.tensors = compile_policy(policy)
         self.store = NodeLoadStore(self.tensors)
-        self.scorer = BatchedScorer(self.tensors, dtype=dtype or jnp.float64)
+        if backend == "pallas":
+            from ..scorer.pallas_kernel import PallasScorer
+
+            # fused-kernel float32 fast path (node axis must pad to 128;
+            # the snapshot bucket guarantees it)
+            self.scorer = PallasScorer(self.tensors)
+        else:
+            self.scorer = BatchedScorer(self.tensors, dtype=dtype or jnp.float64)
+        self.backend = backend
         self.stats = ServiceStats()
         self._bucket = snapshot_bucket
         self._clock = clock

@@ -265,3 +265,55 @@ def test_combined_plugins_schedule():
 
     zones = get_pod_numa_node_result(bound)
     assert len(zones) == 1  # single-NUMA placement recorded on the pod
+
+
+def test_scoring_service_pallas_backend():
+    from crane_scheduler_tpu.service import ScoringService
+    from crane_scheduler_tpu.scorer.pallas_kernel import PallasScorer
+
+    sim = make_sim(5, seed=9)
+    svc = ScoringService(sim.cluster, DEFAULT_POLICY, backend="pallas")
+    svc.scorer = PallasScorer(svc.tensors, interpret=True)  # CPU interpret
+    svc.refresh()
+    verdicts = svc.score_batch(now=sim.clock.now())
+    assert verdicts.backend == "tpu"
+    for node in sim.cluster.list_nodes():
+        assert verdicts.scores[node.name] == oracle.score_node(
+            dict(node.annotations), DEFAULT_POLICY.spec, sim.clock.now()
+        )
+
+
+def test_threaded_annotator_bulk_sync_mode():
+    from crane_scheduler_tpu.annotator import AnnotatorConfig, NodeAnnotator
+    from crane_scheduler_tpu.cluster import ClusterState, Node, NodeAddress
+    from crane_scheduler_tpu.metrics import FakeMetricsSource
+    from crane_scheduler_tpu.policy.types import (
+        DynamicSchedulerPolicy, HotValuePolicy, PolicySpec, SyncPolicy,
+    )
+
+    cluster = ClusterState()
+    fake = FakeMetricsSource()
+    for i in range(4):
+        cluster.add_node(Node(name=f"n{i}", addresses=(NodeAddress("InternalIP", f"10.1.0.{i}"),)))
+        fake.set("cpu_usage_avg_5m", f"10.1.0.{i}", 0.3, by="ip")
+    policy = DynamicSchedulerPolicy(spec=PolicySpec(
+        sync_period=(SyncPolicy("cpu_usage_avg_5m", 0.05),),
+        hot_value=(HotValuePolicy(300.0, 5),),
+    ))
+    ann = NodeAnnotator(cluster, fake, policy, AnnotatorConfig(bulk_sync=True))
+    ann.start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if all(
+                "cpu_usage_avg_5m" in (cluster.get_node(f"n{i}").annotations or {})
+                for i in range(4)
+            ):
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError("bulk sync did not annotate in time")
+    finally:
+        ann.stop()
+    # exactly zero per-node IP queries were needed (bulk path only)
+    assert fake.ip_queries == 0
