@@ -1,0 +1,53 @@
+"""Soak: multiple sync/burst rounds with feedback — load rises where pods
+land, hot values penalize popular nodes, placements stay balanced, and
+batch vs plugin scorers agree at every round."""
+
+import numpy as np
+
+from crane_scheduler_tpu.policy import DEFAULT_POLICY
+from crane_scheduler_tpu.scorer import oracle
+from crane_scheduler_tpu.sim import SimConfig, Simulator
+
+
+def test_multi_round_burst_with_feedback():
+    sim = Simulator(SimConfig(n_nodes=30, seed=42, per_pod_load=0.01))
+    sim.sync_metrics()
+    batch = sim.build_batch_scheduler()
+
+    total = 0
+    for round_idx in range(6):
+        pods = [sim.make_pod() for _ in range(60)]
+        result = batch.schedule_batch(pods)
+        total += len(result.assignments)
+        # scores agree with the oracle on every node, every round
+        now = sim.clock.now()
+        for node in sim.cluster.list_nodes():
+            anno = dict(node.annotations)
+            assert result.scores[node.name] == oracle.score_node(
+                anno, DEFAULT_POLICY.spec, now
+            ), (round_idx, node.name)
+        sim.clock.advance(30.0)
+        sim.sync_metrics()  # feedback: loads + hot values update
+
+    assert total == 360
+    placements = np.array(
+        [len(sim.cluster.list_pods(n.name)) for n in sim.cluster.list_nodes()]
+    )
+    assert placements.sum() == 360
+    # feedback keeps any single node from absorbing the cluster
+    assert placements.max() <= 80
+    assert (placements > 0).sum() >= 10
+    # hot values actually appeared on popular nodes
+    hot_nodes = 0
+    for node in sim.cluster.list_nodes():
+        hot = node.annotations.get("node_hot_value", "0,")
+        if int(hot.split(",")[0]) > 0:
+            hot_nodes += 1
+    assert hot_nodes >= 1
+    # and loads rose on nodes that took pods (stream feedback)
+    loaded = sim.cluster.list_nodes()[int(np.argmax(placements))]
+    usage = oracle.get_resource_usage(
+        dict(loaded.annotations), "cpu_usage_avg_5m", 480, sim.clock.now()
+    )
+    base = sim._base[(loaded.name, "cpu_usage_avg_5m")]
+    assert usage >= round(base, 5)

@@ -1,0 +1,124 @@
+"""Unit coverage for the utility layer: quantities, durations, trunc,
+normalize, phase timer, framework Resource accounting."""
+
+import math
+
+import pytest
+
+from crane_scheduler_tpu.framework.types import (
+    Resource,
+    pod_effective_request,
+    resource_from_requests,
+)
+from crane_scheduler_tpu.cluster import Container, Pod, ResourceRequirements
+from crane_scheduler_tpu.utils import (
+    format_go_duration,
+    go_trunc,
+    normalize_score,
+    parse_go_duration,
+)
+from crane_scheduler_tpu.utils.duration import DurationError
+from crane_scheduler_tpu.utils.profiling import PhaseTimer
+from crane_scheduler_tpu.utils.quantity import (
+    QuantityError,
+    parse_quantity,
+    to_milli,
+    to_value,
+)
+
+
+def test_parse_quantity_forms():
+    assert parse_quantity("2") == 2.0
+    assert parse_quantity("500m") == 0.5
+    assert parse_quantity("1Gi") == 1024**3
+    assert parse_quantity("4Mi") == 4 * 1024**2
+    assert parse_quantity("1k") == 1000.0
+    assert parse_quantity("2.5") == 2.5
+    assert parse_quantity("1e3") == 1000.0
+    assert parse_quantity(3) == 3.0
+    assert parse_quantity(0.25) == 0.25
+
+
+def test_parse_quantity_errors():
+    for bad in ("", None, "abc", "1Qi", True):
+        with pytest.raises(QuantityError):
+            parse_quantity(bad)
+
+
+def test_to_milli_and_value_round_up():
+    assert to_milli("2.5") == 2500
+    assert to_milli("100m") == 100
+    assert to_milli("1") == 1000
+    assert to_value("1.5") == 2  # ceil, like Quantity.Value()
+    assert to_value("2") == 2
+    assert to_value("1Gi") == 1024**3
+
+
+def test_duration_roundtrip_and_errors():
+    assert parse_go_duration("-90s") == -90.0
+    assert parse_go_duration("1h30m10s") == 5410.0
+    assert format_go_duration(5410.0) == "1h30m10s"
+    assert format_go_duration(0) == "0s"
+    assert format_go_duration(-60) == "-1m"
+    assert parse_go_duration("1.h") == 3600.0  # Go allows an empty fraction
+    for bad in ("", "5", "h", "1x", ".h"):
+        with pytest.raises(DurationError):
+            parse_go_duration(bad)
+
+
+def test_go_trunc_edges():
+    assert go_trunc(1.9) == 1
+    assert go_trunc(-1.9) == -1
+    assert go_trunc(0.0) == 0
+    min64 = -(2**63)
+    assert go_trunc(float("nan")) == min64
+    assert go_trunc(float("inf")) == min64
+    assert go_trunc(-float("inf")) == min64
+    assert go_trunc(1e300) == min64
+    assert go_trunc(-1e300) == min64
+
+
+def test_normalize_score():
+    assert normalize_score(-5) == 0
+    assert normalize_score(105) == 100
+    assert normalize_score(55) == 55
+
+
+def test_resource_accounting():
+    r = resource_from_requests({"cpu": "1500m", "memory": "2Gi", "pods": "10",
+                                "ephemeral-storage": "1G", "nvidia.com/gpu": "2"})
+    assert r.milli_cpu == 1500
+    assert r.memory == 2 * 1024**3
+    assert r.allowed_pod_number == 10
+    assert r.ephemeral_storage == 10**9
+    assert r.scalar_resources["nvidia.com/gpu"] == 2
+    clone = r.clone()
+    clone.add({"cpu": "500m"})
+    assert r.milli_cpu == 1500 and clone.milli_cpu == 2000
+
+
+def test_pod_effective_request_sums_containers():
+    pod = Pod(
+        name="p",
+        containers=(
+            Container("a", ResourceRequirements(requests={"cpu": "1"})),
+            Container("b", ResourceRequirements(requests={"cpu": "250m", "memory": "1Gi"})),
+        ),
+    )
+    r = pod_effective_request(pod)
+    assert r.milli_cpu == 1250
+    assert r.memory == 1024**3
+
+
+def test_phase_timer():
+    timer = PhaseTimer()
+    with timer.phase("a"):
+        pass
+    with timer.phase("a"):
+        pass
+    with timer.phase("b"):
+        pass
+    report = timer.report()
+    assert report["a"]["count"] == 2
+    assert report["b"]["count"] == 1
+    assert report["a"]["total_ms"] >= 0
