@@ -1,0 +1,140 @@
+"""Batched NUMA topology evaluation: every candidate node at once.
+
+The reference's TopologyMatch runs per node inside Filter
+(ref: pkg/plugins/noderesourcetopology/filter.go:45-86): rebuild zone
+usage, check fit, greedily pack. For burst scheduling this vectorizes —
+one ``[N, Z, R]`` free-capacity tensor evaluates the aware fit mask and
+the greedy zone count (hence the 100/zones score) for the whole cluster:
+
+- zones sort per node by free CPU descending (the reference's order);
+- non-aware packing floors zone CPU to whole cores, then assigns the
+  request across sorted zones; a zone "contributes" when any resource
+  takes a nonzero bite; the score divides by the number of contributing
+  zones (ref: helper.go:173-212, scorer.go:11-29);
+- aware pods need a single zone that fits everything.
+
+Host-side prep (zone usage from pod annotations) stays in
+``helper.NodeWrapper``; this module only replaces the per-node math with
+one jitted evaluation. Validated against the scalar helper on randomized
+clusters.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..constants import MAX_NODE_SCORE
+from ..framework.types import Resource
+from .helper import NodeWrapper
+
+# resource channels: [cpu_milli, memory, ephemeral_storage]
+_R = 3
+
+
+@dataclass
+class BatchedTopologyResult:
+    aware_fits: Any  # [N] bool — some single zone fits the whole request
+    zones_used: Any  # [N] int32 — contributing zones under greedy pack
+    finished: Any  # [N] bool — the request fully packed
+    scores: Any  # [N] int32 — 100 // zones_used (0 when nothing packs)
+
+
+def pack_node_wrappers(wrappers: list[NodeWrapper], max_zones: int | None = None):
+    """[N, Z, R] allocatable + requested tensors (+validity) from
+    per-node wrappers (allocatable kept raw: the greedy pack floors CPU,
+    the aware fit check does not — ref: helper.go:194 vs :230-282)."""
+    n = len(wrappers)
+    z = max(max_zones or max((len(w.numa_nodes) for w in wrappers), default=1), 1)
+    alloc = np.zeros((n, z, _R), dtype=np.float64)
+    used = np.zeros((n, z, _R), dtype=np.float64)
+    valid = np.zeros((n, z), dtype=bool)
+    for i, w in enumerate(wrappers):
+        for j, nn in enumerate(w.numa_nodes[:z]):
+            alloc[i, j] = (
+                nn.allocatable.milli_cpu,
+                nn.allocatable.memory,
+                nn.allocatable.ephemeral_storage,
+            )
+            used[i, j] = (
+                nn.requested.milli_cpu,
+                nn.requested.memory,
+                nn.requested.ephemeral_storage,
+            )
+            valid[i, j] = True
+    return alloc, used, valid
+
+
+def request_vector(request: Resource) -> np.ndarray:
+    return np.array(
+        [request.milli_cpu, request.memory, request.ephemeral_storage],
+        dtype=np.float64,
+    )
+
+
+@jax.jit
+def _evaluate(alloc, used, valid, request):
+    """alloc/used [N,Z,R] f64, valid [N,Z] bool, request [R].
+
+    Mirrors ``assign_request_for_numa_node`` faithfully, including the
+    Go arithmetic on overcommitted zones: ``assigned = min(remaining,
+    capacity)`` with *negative* capacity inflates the remaining request
+    (capacity is never clamped), and packing stops after the zone that
+    finishes the request. The zone axis is small and static, so the
+    sequential recurrence unrolls at trace time.
+    """
+    free = alloc - used  # raw free, used for both fit check and sort order
+
+    # aware: one zone fitting the whole request (ref: filter.go:107-123)
+    fits_zone = jnp.all(free >= request[None, None, :], axis=2) & valid
+    aware_fits = jnp.any(fits_zone, axis=1)
+
+    # greedy pack order: free CPU descending (stable, invalid zones last)
+    order = jnp.argsort(-jnp.where(valid, free[:, :, 0], -jnp.inf), axis=1)
+    s_alloc = jnp.take_along_axis(alloc, order[:, :, None], axis=1)
+    s_used = jnp.take_along_axis(used, order[:, :, None], axis=1)
+    s_valid = jnp.take_along_axis(valid, order, axis=1)
+    # whole-core rounding of *allocatable* CPU (ref: helper.go:194)
+    cpu_cap = jnp.floor(s_alloc[:, :, 0] / 1000.0) * 1000.0 - s_used[:, :, 0]
+    capacity = jnp.concatenate(
+        [cpu_cap[:, :, None], (s_alloc - s_used)[:, :, 1:]], axis=2
+    )  # may be negative: overcommitted zones give back
+
+    n, z, _ = capacity.shape
+    remaining = jnp.broadcast_to(request[None, :], (n, _R))
+    active = jnp.ones((n,), dtype=jnp.bool_)
+    zones_used = jnp.zeros((n,), dtype=jnp.int32)
+    for j in range(z):  # Z is tiny (NUMA zones); unrolled
+        can = active & s_valid[:, j]
+        nonzero_request = jnp.any(remaining != 0, axis=1)  # ref: helper.go:288-293
+        can = can & nonzero_request
+        assigned = jnp.where(
+            can[:, None], jnp.minimum(remaining, capacity[:, j, :]), 0.0
+        )
+        remaining = remaining - assigned
+        zones_used = zones_used + (can & jnp.any(assigned > 0, axis=1)).astype(jnp.int32)
+        finished_now = can & jnp.all(remaining <= 0, axis=1)
+        active = active & ~finished_now
+    finished = jnp.all(remaining <= 0, axis=1)
+
+    score = jnp.where(
+        zones_used > 0, MAX_NODE_SCORE // jnp.maximum(zones_used, 1), 0
+    ).astype(jnp.int32)
+    return aware_fits, zones_used, finished, score
+
+
+def evaluate_topology_batch(
+    wrappers: list[NodeWrapper], request: Resource
+) -> BatchedTopologyResult:
+    alloc, used, valid = pack_node_wrappers(wrappers)
+    out = _evaluate(
+        jnp.asarray(alloc),
+        jnp.asarray(used),
+        jnp.asarray(valid),
+        jnp.asarray(request_vector(request)),
+    )
+    return BatchedTopologyResult(*out)

@@ -1,0 +1,84 @@
+"""Batched NUMA evaluation vs the scalar helper on randomized clusters."""
+
+import random
+
+import numpy as np
+
+from crane_scheduler_tpu.framework.types import Resource
+from crane_scheduler_tpu.topology.batched import evaluate_topology_batch
+from crane_scheduler_tpu.topology.helper import (
+    NumaNode,
+    assign_topology_result,
+    fits_request_for_numa_node,
+    new_node_wrapper,
+)
+from crane_scheduler_tpu.topology.types import Zone, ZoneResourceInfo
+
+
+def make_wrapper(zone_specs, seed_used=None):
+    zones = [
+        Zone(f"numa-{j}", resources=ZoneResourceInfo(
+            allocatable={"cpu": f"{cpu}m", "memory": str(mem)}))
+        for j, (cpu, mem) in enumerate(zone_specs)
+    ]
+    nw = new_node_wrapper("node", frozenset({"cpu", "memory"}), zones, lambda p: [])
+    if seed_used:
+        for j, (cpu_used, mem_used) in enumerate(seed_used):
+            nw.numa_nodes[j].requested.milli_cpu = cpu_used
+            nw.numa_nodes[j].requested.memory = mem_used
+    return nw
+
+
+def test_batched_matches_scalar_helper_random():
+    rng = random.Random(0)
+    GiB = 1024**3
+    for trial in range(30):
+        n_nodes = rng.randint(1, 12)
+        wrappers = []
+        for _ in range(n_nodes):
+            n_zones = rng.randint(1, 4)
+            specs = [
+                (rng.choice([1000, 2500, 3900, 8000]), rng.choice([2, 4, 8]) * GiB)
+                for _ in range(n_zones)
+            ]
+            used = [
+                (rng.choice([0, 500, 1000, 3000]), rng.choice([0, 1, 3]) * GiB)
+                for _ in range(n_zones)
+            ]
+            wrappers.append(make_wrapper(specs, used))
+        req = Resource(
+            milli_cpu=rng.choice([500, 1000, 2000, 7000]),
+            memory=rng.choice([1, 2, 6]) * GiB,
+        )
+
+        batch_wrappers = [
+            make_wrapper(
+                [(nn.allocatable.milli_cpu, nn.allocatable.memory) for nn in w.numa_nodes],
+                [(nn.requested.milli_cpu, nn.requested.memory) for nn in w.numa_nodes],
+            )
+            for w in wrappers
+        ]
+        result = evaluate_topology_batch(batch_wrappers, req)
+
+        for i, w in enumerate(wrappers):
+            # aware fit: scalar check
+            want_fit = any(
+                not fits_request_for_numa_node(req, nn) for nn in w.numa_nodes
+            )
+            assert bool(result.aware_fits[i]) == want_fit, (trial, i)
+            # greedy pack: scalar assign
+            assign_topology_result(w, req.clone())
+            want_zones = len(w.result)
+            assert int(result.zones_used[i]) == want_zones, (trial, i)
+            if want_zones:
+                assert int(result.scores[i]) == 100 // want_zones, (trial, i)
+
+
+def test_batched_finished_flag():
+    GiB = 1024**3
+    small = make_wrapper([(1000, GiB)])
+    big = make_wrapper([(4000, 4 * GiB), (4000, 4 * GiB)])
+    req = Resource(milli_cpu=3000, memory=2 * GiB)
+    result = evaluate_topology_batch([small, big], req)
+    assert not bool(result.finished[0])
+    assert bool(result.finished[1])
