@@ -57,6 +57,9 @@ def build_scheduler_from_config(
             cluster=cluster,
             topology_aware_resources=frozenset(args.topology_aware_resources),
         )
+        # the reference starts the assumed-pod cleaner with the cache
+        # (ref: cache.go:111-117); tests drive cleanup(now) directly
+        plugin.cache.start_cleaner()
         sched.register(plugin, weight=weights.get("NodeResourceTopologyMatch", 1))
 
     return sched
