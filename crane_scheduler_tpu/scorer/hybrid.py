@@ -1,0 +1,225 @@
+"""Hybrid scorer: float32 speed, float64 placement parity.
+
+The float32 fast path can disagree with the Go semantics only where a
+value sits within float32 error of a decision boundary:
+
+- a usage within ~2^-24 of a predicate threshold (filter flip),
+- a score quotient within accumulated-rounding error of an integer
+  (trunc flip),
+- a hot value within error of a multiple of 0.1 (penalty flip).
+
+Those cases are *detectable on device*: the jitted f32 pass emits a
+conservative risk mask alongside its verdicts. Risky rows — typically a
+tiny fraction — are re-scored exactly in float64 numpy on the host
+(``score_rows_f64``, the same IEEE-double operation sequence as the Go
+code and the oracle, with no dependency on jax x64). The result is
+bit-parity everywhere at f32 throughput.
+
+Tolerances are deliberately loose (1e-4 absolute on comparisons, 1e-3 on
+truncation distance for a ≤16-term accumulation of O(100) magnitudes —
+orders of magnitude above the true f32 error bounds), trading a few
+extra host re-scores for a safety margin.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..constants import (
+    HOT_VALUE_ACTIVE_PERIOD_SECONDS,
+    MAX_NODE_SCORE,
+    MIN_NODE_SCORE,
+)
+from ..policy.compile import PolicyTensors
+from .batched import BatchedScorer, ScoreResult
+
+_CMP_TOL = 1e-4  # |usage - threshold| risk window
+_TRUNC_TOL = 1e-3  # distance-to-integer risk window for quotients
+_GO_MIN_I64 = -(2**63)
+
+
+def _trunc_f64(q: np.ndarray) -> np.ndarray:
+    """Vectorized Go int64(float64) with the amd64 indefinite."""
+    out = np.full(q.shape, _GO_MIN_I64, dtype=np.int64)
+    ok = np.isfinite(q) & (q > -(2.0**63)) & (q < 2.0**63)
+    out[ok] = np.trunc(q[ok]).astype(np.int64)
+    return out
+
+
+def score_rows_f64(
+    values: np.ndarray,
+    ts: np.ndarray,
+    hot_value: np.ndarray,
+    hot_ts: np.ndarray,
+    now: float,
+    tensors: PolicyTensors,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Exact float64 verdicts for a row subset (IEEE-double, same op
+    order as stats.go; bit-identical to the oracle)."""
+    n = values.shape[0]
+    # filter
+    schedulable = np.ones((n,), dtype=bool)
+    for p in range(len(tensors.pred_idx)):
+        active = tensors.pred_active[p]
+        if active <= 0:
+            continue
+        col = tensors.pred_idx[p]
+        threshold = tensors.pred_threshold[p]
+        if threshold == 0:
+            continue
+        u = values[:, col]
+        fresh = now < ts[:, col] + active
+        with np.errstate(invalid="ignore"):
+            ok = fresh & ~(u < 0)
+            over = ok & (u > threshold)
+        schedulable &= ~over
+    # score
+    if len(tensors.prio_idx) == 0:
+        base = np.zeros((n,), dtype=np.int64)
+    else:
+        acc = np.zeros((n,), dtype=np.float64)
+        for k in range(len(tensors.prio_idx)):
+            col = tensors.prio_idx[k]
+            active = tensors.prio_active[k]
+            u = values[:, col]
+            fresh = now < ts[:, col] + active
+            with np.errstate(invalid="ignore"):
+                ok = (active > 0) & fresh & ~(u < 0)
+            contrib = (1.0 - u) * tensors.prio_weight[k] * float(MAX_NODE_SCORE)
+            acc = acc + np.where(ok, contrib, 0.0)
+        if tensors.weight_sum == 0.0:
+            with np.errstate(invalid="ignore"):
+                q = np.where(acc == 0.0, np.nan, np.sign(acc) * np.inf)
+                q = np.where(np.isnan(acc), np.nan, q)
+        else:
+            q = acc / tensors.weight_sum
+        base = _trunc_f64(q)
+    hot_fresh = now < hot_ts + HOT_VALUE_ACTIVE_PERIOD_SECONDS
+    with np.errstate(invalid="ignore"):
+        hot_ok = hot_fresh & ~(hot_value < 0)
+    hv = np.where(hot_ok, hot_value, 0.0)
+    penalty = _trunc_f64(hv * 10.0)
+    score = (base - penalty).astype(np.int64)  # wraps like Go int64
+    score = np.clip(score, MIN_NODE_SCORE, MAX_NODE_SCORE)
+    return schedulable, score.astype(np.int32)
+
+
+@dataclass
+class HybridResult:
+    schedulable: Any
+    scores: Any
+    rescored: int  # rows that took the f64 path
+
+
+class HybridScorer:
+    """f32 batched pass + risk mask + exact f64 host re-score."""
+
+    def __init__(self, tensors: PolicyTensors):
+        self.tensors = tensors
+        self._f32 = BatchedScorer(tensors, dtype=jnp.float32)
+        t = tensors
+        self._jit = jax.jit(self._impl)
+        self._pred_idx32 = jnp.asarray(t.pred_idx, jnp.int32)
+        self._pred_thr32 = jnp.asarray(t.pred_threshold, jnp.float32)
+        self._pred_act32 = jnp.asarray(t.pred_active, jnp.float32)
+        self._prio_idx32 = jnp.asarray(t.prio_idx, jnp.int32)
+        self._prio_act32 = jnp.asarray(t.prio_active, jnp.float32)
+
+    def _risk_mask_f64(self, values, ts, hot_value, hot_ts, now) -> np.ndarray:
+        """Host-side exact risk detection (vectorized numpy float64).
+
+        A node is risky when an f32 evaluation *could* flip a decision:
+        the exact f64 quantity sits within the f32 rounding band of a
+        boundary. Exactly-on-boundary counts as risky too (an f32
+        accumulation can land microscopically on the other side), but a
+        hot value that is a clean integer or a usage far from its
+        threshold is provably safe — which is what keeps the rescore
+        fraction tiny on real annotator data.
+        """
+        t = self.tensors
+        n = values.shape[0]
+        risk = np.zeros((n,), dtype=bool)
+        # staleness boundaries: the f32 path compares rebased (ts - now),
+        # which only rounds when `now` is fractional — flag windows whose
+        # expiry sits within the rounding band of `now`.
+        stale_tol = 1e-3
+        with np.errstate(invalid="ignore"):
+            if len(t.pred_idx):
+                u = values[:, t.pred_idx]
+                expiry = ts[:, t.pred_idx] + t.pred_active
+                fresh = now < expiry
+                near = np.abs(u - t.pred_threshold) <= _CMP_TOL
+                risk |= np.any(fresh & near & (t.pred_active > 0), axis=1)
+                risk |= np.any(
+                    (np.abs(expiry - now) <= stale_tol) & (t.pred_active > 0), axis=1
+                )
+            if len(t.prio_idx) and t.weight_sum != 0.0:
+                u = values[:, t.prio_idx]
+                expiry = ts[:, t.prio_idx] + t.prio_active
+                fresh = now < expiry
+                valid = fresh & ~(u < 0) & (t.prio_active > 0)
+                risk |= np.any(
+                    (np.abs(expiry - now) <= stale_tol) & (t.prio_active > 0), axis=1
+                )
+                contrib = (1.0 - u) * t.prio_weight * float(MAX_NODE_SCORE)
+                masked = np.where(valid, contrib, 0.0)
+                acc = masked.sum(axis=1)
+                q = acc / t.weight_sum
+                finite = np.isfinite(q)
+                dist = np.abs(q - np.round(q))
+                # f32 accumulation error is bounded by K*eps32 times the
+                # magnitude of the partial sums; 1e-5 gives ~25x margin.
+                abs_sum = np.abs(masked).sum(axis=1)
+                tol = _TRUNC_TOL * 0.1 + 1e-5 * abs_sum / abs(t.weight_sum)
+                risk |= finite & (dist <= tol)
+                risk |= ~finite  # NaN/Inf: let f64 decide the indefinite
+            hot_expiry = hot_ts + HOT_VALUE_ACTIVE_PERIOD_SECONDS
+            risk |= np.abs(hot_expiry - now) <= stale_tol
+            hot_fresh = now < hot_expiry
+            hv = np.where(hot_fresh & ~(hot_value < 0), hot_value, 0.0)
+            hp = hv * 10.0
+            dist = np.abs(hp - np.round(hp))
+            # a clean multiple of 10 (integral hot value) converts to f32
+            # exactly and truncates identically: safe. Near-misses aren't.
+            risk |= np.isfinite(hp) & (dist > 0) & (dist <= _CMP_TOL * 10)
+            risk |= ~np.isfinite(hp)
+        return risk
+
+    def _impl(self, values, ts, hot_value, hot_ts, node_valid, now):
+        return self._f32._score_impl(values, ts, hot_value, hot_ts, node_valid, now)
+
+    def __call__(self, values, ts, hot_value, hot_ts, node_valid, now) -> HybridResult:
+        now_f = float(now)
+        values64 = np.asarray(values, dtype=np.float64)
+        ts64 = np.asarray(ts, dtype=np.float64)
+        hot64 = np.asarray(hot_value, dtype=np.float64)
+        hot_ts64 = np.asarray(hot_ts, dtype=np.float64)
+        ts_rel = ts64 - now_f
+        hot_ts_rel = hot_ts64 - now_f
+        schedulable, scores = self._jit(
+            jnp.asarray(values64, jnp.float32),
+            jnp.asarray(ts_rel, jnp.float32),
+            jnp.asarray(hot64, jnp.float32),
+            jnp.asarray(hot_ts_rel, jnp.float32),
+            jnp.asarray(node_valid, jnp.bool_),
+            jnp.asarray(0.0, jnp.float32),
+        )
+        schedulable = np.asarray(schedulable)
+        scores = np.asarray(scores)
+        risk = self._risk_mask_f64(values64, ts64, hot64, hot_ts64, now_f)
+        risky = np.nonzero(risk & np.asarray(node_valid))[0]
+        if len(risky):
+            sched64, score64 = score_rows_f64(
+                values64[risky], ts64[risky], hot64[risky], hot_ts64[risky],
+                now_f, self.tensors,
+            )
+            schedulable = schedulable.copy()
+            scores = scores.copy()
+            schedulable[risky] = sched64 & np.asarray(node_valid)[risky]
+            scores[risky] = np.where(np.asarray(node_valid)[risky], score64, 0)
+        return HybridResult(schedulable, scores, rescored=len(risky))

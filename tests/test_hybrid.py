@@ -1,0 +1,120 @@
+"""Hybrid scorer: bit-parity with the oracle at f32 speed, including
+adversarial boundary-straddling inputs the plain f32 path gets wrong."""
+
+import random
+
+import numpy as np
+import pytest
+
+from crane_scheduler_tpu.loadstore import NodeLoadStore
+from crane_scheduler_tpu.policy import DEFAULT_POLICY, compile_policy
+from crane_scheduler_tpu.scorer import oracle
+from crane_scheduler_tpu.scorer.hybrid import HybridScorer, score_rows_f64
+from crane_scheduler_tpu.utils import format_local_time
+
+NOW = 1753776000.0
+TENSORS = compile_policy(DEFAULT_POLICY)
+
+
+def boundary_value(rng):
+    """Values engineered to sit at or microscopically around decision
+    boundaries: thresholds, integer-quotient points, hot steps."""
+    roll = rng.random()
+    if roll < 0.3:
+        return rng.choice([0.65, 0.75, 0.6500001, 0.6499999, 0.7500001])
+    if roll < 0.6:
+        # quotient boundaries: with all six weights on value v the
+        # quotient is (1-v)*100, integral when v is a multiple of 0.01
+        return round(rng.randint(0, 100) / 100, 7)
+    return rng.random()
+
+
+def build_store(n_nodes, seed):
+    rng = random.Random(seed)
+    store = NodeLoadStore(TENSORS)
+    ts_fresh = format_local_time(NOW)
+    for i in range(n_nodes):
+        anno = {}
+        for m in TENSORS.metric_names:
+            if rng.random() < 0.1:
+                continue
+            anno[m] = f"{boundary_value(rng):.7f},{ts_fresh}"
+        if rng.random() < 0.6:
+            hv = rng.choice(["0", "1", "2", "0.1", "0.19999", "0.20001", "1.0000001"])
+            anno["node_hot_value"] = f"{hv},{ts_fresh}"
+        store.ingest_node_annotations(f"n{i}", anno)
+    return store
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_hybrid_bit_parity_on_boundary_heavy_inputs(seed):
+    store = build_store(400, seed)
+    snap = store.snapshot(bucket=128)
+    hybrid = HybridScorer(TENSORS)
+    result = hybrid(
+        snap.values, snap.ts, snap.hot_value, snap.hot_ts, snap.node_valid, NOW
+    )
+    mismatches = []
+    for name in store.node_names:
+        i = store.node_id(name)
+        anno = None
+        # reconstruct via store arrays through the exact f64 scorer
+    sched64, score64 = score_rows_f64(
+        snap.values, snap.ts, snap.hot_value, snap.hot_ts, NOW, TENSORS
+    )
+    n = snap.n_nodes
+    np.testing.assert_array_equal(result.schedulable[:n], sched64[:n])
+    np.testing.assert_array_equal(result.scores[:n], score64[:n])
+    # boundary-heavy inputs must actually exercise the rescore path
+    assert result.rescored > 0
+
+
+def test_score_rows_f64_matches_oracle():
+    rng = random.Random(9)
+    store = build_store(150, 9)
+    snap = store.snapshot(bucket=64)
+    sched64, score64 = score_rows_f64(
+        snap.values, snap.ts, snap.hot_value, snap.hot_ts, NOW, TENSORS
+    )
+    for name in store.node_names:
+        i = store.node_id(name)
+        # rebuild the annotation view the oracle reads
+        anno = {}
+        for m, col in TENSORS.metric_index.items():
+            if np.isfinite(snap.ts[i, col]):
+                anno[m] = f"{snap.values[i, col]:.7f},{format_local_time(snap.ts[i, col])}"
+        if np.isfinite(snap.hot_ts[i]):
+            anno["node_hot_value"] = (
+                f"{snap.hot_value[i]:.7f},{format_local_time(snap.hot_ts[i])}"
+            )
+        ok, _ = oracle.filter_node(anno, DEFAULT_POLICY.spec, NOW)
+        want = oracle.score_node(anno, DEFAULT_POLICY.spec, NOW)
+        assert bool(sched64[i]) == ok, name
+        assert int(score64[i]) == want, name
+
+
+def test_plain_f32_would_disagree_hybrid_does_not():
+    """Construct a case where f32 provably flips a verdict; the hybrid
+    must still match f64."""
+    import jax.numpy as jnp
+
+    from crane_scheduler_tpu.scorer import BatchedScorer
+
+    store = NodeLoadStore(TENSORS)
+    ts_fresh = format_local_time(NOW)
+    # usage microscopically above the 0.65 threshold: f64 filters the
+    # node; f32 rounds 0.6500000001 to 0.65 exactly-ish and passes it
+    store.ingest_node_annotations(
+        "edge", {"cpu_usage_avg_5m": f"0.6500000001,{ts_fresh}"}
+    )
+    snap = store.snapshot(bucket=8)
+    sched64, _ = score_rows_f64(
+        snap.values, snap.ts, snap.hot_value, snap.hot_ts, NOW, TENSORS
+    )
+    assert not bool(sched64[0])  # exact semantics: filtered
+    hybrid = HybridScorer(TENSORS)
+    result = hybrid(
+        snap.values, snap.ts, snap.hot_value, snap.hot_ts, snap.node_valid, NOW
+    )
+    assert not bool(result.schedulable[0])
+    assert result.rescored >= 1
