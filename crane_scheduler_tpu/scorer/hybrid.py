@@ -26,7 +26,6 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -122,13 +121,6 @@ class HybridScorer:
     def __init__(self, tensors: PolicyTensors):
         self.tensors = tensors
         self._f32 = BatchedScorer(tensors, dtype=jnp.float32)
-        t = tensors
-        self._jit = jax.jit(self._impl)
-        self._pred_idx32 = jnp.asarray(t.pred_idx, jnp.int32)
-        self._pred_thr32 = jnp.asarray(t.pred_threshold, jnp.float32)
-        self._pred_act32 = jnp.asarray(t.pred_active, jnp.float32)
-        self._prio_idx32 = jnp.asarray(t.prio_idx, jnp.int32)
-        self._prio_act32 = jnp.asarray(t.prio_active, jnp.float32)
 
     def _risk_mask_f64(self, values, ts, hot_value, hot_ts, now) -> np.ndarray:
         """Host-side exact risk detection (vectorized numpy float64).
@@ -144,10 +136,23 @@ class HybridScorer:
         t = self.tensors
         n = values.shape[0]
         risk = np.zeros((n,), dtype=bool)
-        # staleness boundaries: the f32 path compares rebased (ts - now),
-        # which only rounds when `now` is fractional — flag windows whose
-        # expiry sits within the rounding band of `now`.
-        stale_tol = 1e-3
+
+        def sign_flip(u):
+            # The f32 downcast can flush a tiny negative (e.g. -1e-310) to
+            # -0.0, flipping the `u < 0` validity test between the f64 and
+            # f32 paths — whole w*100 contributions appear/vanish, far from
+            # any truncation boundary. Flag any row where the sign test
+            # itself disagrees across precisions.
+            return (u < 0) != (u.astype(np.float32) < 0)
+
+        def stale_tol(tstamp, active):
+            # The f32 freshness error scales with the operand magnitudes
+            # (fl32(ts-now) + fl32(active) carries ~eps32*(|ts-now|+active)
+            # of rounding), so an absolute tolerance under-flags long
+            # windows (>~2h). eps32 ~ 1.2e-7; 1e-6 gives ~4x margin over
+            # the two roundings involved.
+            return 1e-3 + 1e-6 * (np.abs(tstamp - now) + np.abs(active))
+
         with np.errstate(invalid="ignore"):
             if len(t.pred_idx):
                 u = values[:, t.pred_idx]
@@ -155,16 +160,20 @@ class HybridScorer:
                 fresh = now < expiry
                 near = np.abs(u - t.pred_threshold) <= _CMP_TOL
                 risk |= np.any(fresh & near & (t.pred_active > 0), axis=1)
+                risk |= np.any(sign_flip(u) & fresh & (t.pred_active > 0), axis=1)
+                tol = stale_tol(ts[:, t.pred_idx], t.pred_active)
                 risk |= np.any(
-                    (np.abs(expiry - now) <= stale_tol) & (t.pred_active > 0), axis=1
+                    (np.abs(expiry - now) <= tol) & (t.pred_active > 0), axis=1
                 )
             if len(t.prio_idx) and t.weight_sum != 0.0:
                 u = values[:, t.prio_idx]
                 expiry = ts[:, t.prio_idx] + t.prio_active
                 fresh = now < expiry
                 valid = fresh & ~(u < 0) & (t.prio_active > 0)
+                risk |= np.any(sign_flip(u) & fresh & (t.prio_active > 0), axis=1)
+                tol = stale_tol(ts[:, t.prio_idx], t.prio_active)
                 risk |= np.any(
-                    (np.abs(expiry - now) <= stale_tol) & (t.prio_active > 0), axis=1
+                    (np.abs(expiry - now) <= tol) & (t.prio_active > 0), axis=1
                 )
                 contrib = (1.0 - u) * t.prio_weight * float(MAX_NODE_SCORE)
                 masked = np.where(valid, contrib, 0.0)
@@ -179,7 +188,9 @@ class HybridScorer:
                 risk |= finite & (dist <= tol)
                 risk |= ~finite  # NaN/Inf: let f64 decide the indefinite
             hot_expiry = hot_ts + HOT_VALUE_ACTIVE_PERIOD_SECONDS
-            risk |= np.abs(hot_expiry - now) <= stale_tol
+            risk |= np.abs(hot_expiry - now) <= stale_tol(
+                hot_ts, HOT_VALUE_ACTIVE_PERIOD_SECONDS
+            )
             hot_fresh = now < hot_expiry
             hv = np.where(hot_fresh & ~(hot_value < 0), hot_value, 0.0)
             hp = hv * 10.0
@@ -190,27 +201,16 @@ class HybridScorer:
             risk |= ~np.isfinite(hp)
         return risk
 
-    def _impl(self, values, ts, hot_value, hot_ts, node_valid, now):
-        return self._f32._score_impl(values, ts, hot_value, hot_ts, node_valid, now)
-
     def __call__(self, values, ts, hot_value, hot_ts, node_valid, now) -> HybridResult:
         now_f = float(now)
         values64 = np.asarray(values, dtype=np.float64)
         ts64 = np.asarray(ts, dtype=np.float64)
         hot64 = np.asarray(hot_value, dtype=np.float64)
         hot_ts64 = np.asarray(hot_ts, dtype=np.float64)
-        ts_rel = ts64 - now_f
-        hot_ts_rel = hot_ts64 - now_f
-        schedulable, scores = self._jit(
-            jnp.asarray(values64, jnp.float32),
-            jnp.asarray(ts_rel, jnp.float32),
-            jnp.asarray(hot64, jnp.float32),
-            jnp.asarray(hot_ts_rel, jnp.float32),
-            jnp.asarray(node_valid, jnp.bool_),
-            jnp.asarray(0.0, jnp.float32),
-        )
-        schedulable = np.asarray(schedulable)
-        scores = np.asarray(scores)
+        # BatchedScorer's f32 mode owns the rebase/downcast invariants.
+        f32 = self._f32(values64, ts64, hot64, hot_ts64, node_valid, now_f)
+        schedulable = np.asarray(f32.schedulable)
+        scores = np.asarray(f32.scores)
         risk = self._risk_mask_f64(values64, ts64, hot64, hot_ts64, now_f)
         risky = np.nonzero(risk & np.asarray(node_valid))[0]
         if len(risky):

@@ -128,8 +128,21 @@ class GangScheduler:
     """
 
     def __init__(self, hv_counts: Sequence[int]):
-        self._g = jnp.asarray(hot_penalty_steps(hv_counts), dtype=jnp.int32)  # [11]
+        self._g_host = hot_penalty_steps(hv_counts)  # [11] np.int64
         self._jit = jax.jit(self._assign_impl)
+
+    def _g_lookup(self, xq):
+        """g[xq] for a traced int array xq in [0, 10].
+
+        Unrolled select chain over the 11 static table entries: a
+        dynamic-index gather (``g[xq]``) is pathologically slow on TPU
+        even for a tiny table, while 11 fused selects are free.
+        """
+        out = jnp.asarray(int(self._g_host[10]), jnp.int32)
+        out = jnp.broadcast_to(out, xq.shape)
+        for x in range(9, -1, -1):
+            out = jnp.where(xq <= x, jnp.int32(int(self._g_host[x])), out)
+        return out
 
     def __call__(self, scores, schedulable, num_pods, capacity=None) -> GangResult:
         scores = jnp.asarray(scores, dtype=jnp.int32)
@@ -164,25 +177,19 @@ class GangScheduler:
         s = scores.astype(jnp.int32)
         levels = jnp.arange(102, dtype=jnp.int32)  # [102]
 
-        # Each node's token staircase A_n(L) is constant except at the 11
-        # breakpoint levels L_x = s_n - 10x (x = 0..10), where it gains
-        # exact_x = min(k, g[x]) - min(k, g[x-1]) tokens (g[-1] = 0). So
-        # instead of materializing A as a [102, N] matrix, scatter the
-        # breakpoint deltas into a [102] histogram and suffix-sum it:
-        #   hist[L]  = Σ_n (tokens whose value is exactly L >= 1)
-        #   totals[L] = Σ_{L' >= L} hist[L']  = Σ_n A_n(L)   (for L >= 1)
-        x = jnp.arange(11, dtype=jnp.int32)  # [11]
-        capped = jnp.minimum(k_cap[None, :], self._g[x][:, None])  # [11, N]
-        exact_x = capped - jnp.concatenate(
-            [jnp.zeros((1, n), jnp.int32), capped[:-1]], axis=0
-        )  # [11, N] new tokens unlocked at breakpoint x
-        level_x = s[None, :] - 10 * x[:, None]  # [11, N] breakpoint levels
-        valid_x = level_x >= 1
-        hist = jnp.zeros((102,), jnp.int32).at[
-            jnp.clip(level_x, 0, 101).reshape(-1)
-        ].add(jnp.where(valid_x, exact_x, 0).reshape(-1), mode="drop")
-        # suffix sum over a [102] vector (tiny); totals[0] = all tokens.
-        totals = jnp.cumsum(hist[::-1], dtype=jnp.int32)[::-1]
+        # totals[L] = Σ_n A_n(L), the number of tokens valued >= L, where
+        # A_n(L) = min(k_cap_n, g[floor((s_n - L)/10)]) for s_n >= L >= 1.
+        # Materialize the [102, N] level table directly (elementwise ops +
+        # one reduction over N — 5.1M int32 lanes, trivial for the VPU).
+        # An earlier formulation scattered breakpoint deltas into a [102]
+        # histogram; TPU lowers 1D scatter-adds poorly (and the scatter
+        # emitter can abort in fusion: scatter_emitter.cc operand check),
+        # so the dense table is both faster and safer here.
+        lv = levels[:, None]  # [102, 1]
+        xq = jnp.clip((s[None, :] - lv) // 10, 0, 10)  # [102, N]
+        unlocked = jnp.where(s[None, :] >= lv, self._g_lookup(xq), 0)
+        a_table = jnp.minimum(k_cap[None, :], unlocked)  # [102, N]
+        totals = a_table.sum(axis=1, dtype=jnp.int32)  # [102]
         totals = totals.at[0].set(k_cap.sum(dtype=jnp.int32))
 
         meets = totals >= num_pods  # True for L <= L*
@@ -191,7 +198,7 @@ class GangScheduler:
         def a_of(level):
             """A_n(level) for a traced scalar level >= 1, elementwise."""
             xq = jnp.clip((s - level) // 10, 0, 10)
-            unlocked = jnp.where(s >= level, self._g[xq], 0)
+            unlocked = jnp.where(s >= level, self._g_lookup(xq), 0)
             return jnp.minimum(k_cap, unlocked)
 
         def full_capacity(_):

@@ -54,11 +54,6 @@ def test_hybrid_bit_parity_on_boundary_heavy_inputs(seed):
     result = hybrid(
         snap.values, snap.ts, snap.hot_value, snap.hot_ts, snap.node_valid, NOW
     )
-    mismatches = []
-    for name in store.node_names:
-        i = store.node_id(name)
-        anno = None
-        # reconstruct via store arrays through the exact f64 scorer
     sched64, score64 = score_rows_f64(
         snap.values, snap.ts, snap.hot_value, snap.hot_ts, NOW, TENSORS
     )
@@ -70,7 +65,6 @@ def test_hybrid_bit_parity_on_boundary_heavy_inputs(seed):
 
 
 def test_score_rows_f64_matches_oracle():
-    rng = random.Random(9)
     store = build_store(150, 9)
     snap = store.snapshot(bucket=64)
     sched64, score64 = score_rows_f64(
@@ -112,9 +106,42 @@ def test_plain_f32_would_disagree_hybrid_does_not():
         snap.values, snap.ts, snap.hot_value, snap.hot_ts, NOW, TENSORS
     )
     assert not bool(sched64[0])  # exact semantics: filtered
+    # pin the premise: the plain f32 scorer really does flip this verdict
+    f32_only = BatchedScorer(TENSORS, dtype=jnp.float32)
+    plain = f32_only(
+        snap.values, snap.ts, snap.hot_value, snap.hot_ts, snap.node_valid, NOW
+    )
+    assert bool(np.asarray(plain.schedulable)[0])  # f32 wrongly passes it
     hybrid = HybridScorer(TENSORS)
     result = hybrid(
         snap.values, snap.ts, snap.hot_value, snap.hot_ts, snap.node_valid, NOW
     )
     assert not bool(result.schedulable[0])
     assert result.rescored >= 1
+
+
+def test_f32_underflow_negative_usage_rescored():
+    """A tiny negative usage (-1e-310) flushes to -0.0 in float32, which
+    flips the `u < 0` validity test: f64 drops the entry (contributes 0,
+    weight counted), f32 would keep it (full w*100 contribution). The
+    risk mask must catch the sign flip and rescore in f64."""
+    store = NodeLoadStore(TENSORS)
+    ts_fresh = format_local_time(NOW)
+    anno = {m: f"0.5,{ts_fresh}" for m in TENSORS.metric_names}
+    anno["cpu_usage_avg_5m"] = f"-1e-310,{ts_fresh}"
+    store.ingest_node_annotations("tiny-neg", anno)
+    snap = store.snapshot(bucket=8)
+    sched64, score64 = score_rows_f64(
+        snap.values, snap.ts, snap.hot_value, snap.hot_ts, NOW, TENSORS
+    )
+    hybrid = HybridScorer(TENSORS)
+    result = hybrid(
+        snap.values, snap.ts, snap.hot_value, snap.hot_ts, snap.node_valid, NOW
+    )
+    assert result.rescored >= 1
+    assert int(result.scores[0]) == int(score64[0])
+    # oracle cross-check of the exact semantics
+    ok, _ = oracle.filter_node(anno, DEFAULT_POLICY.spec, NOW)
+    want = oracle.score_node(anno, DEFAULT_POLICY.spec, NOW)
+    assert bool(result.schedulable[0]) == ok
+    assert int(result.scores[0]) == want
