@@ -68,6 +68,14 @@ class NodeLoadStore:
         self.hot_ts = np.full((cap,), _NEG_INF, dtype=np.float64)
         # per-node annotation-map identity for skip-unchanged refreshes
         self._last_anno: dict[str, object] = {}
+        # monotonic mutation counter: snapshot/upload caches key on this,
+        # so an unchanged store costs zero host->device traffic per cycle
+        self._version = 0
+
+    @property
+    def version(self) -> int:
+        """Bumped by every mutation that can change snapshot contents."""
+        return self._version
 
     # -- node membership ---------------------------------------------------
 
@@ -94,6 +102,7 @@ class NodeLoadStore:
         self.ts[i, :] = _NEG_INF
         self.hot_value[i] = np.nan
         self.hot_ts[i] = _NEG_INF
+        self._version += 1
         return i
 
     def remove_node(self, name: str) -> None:
@@ -113,6 +122,7 @@ class NodeLoadStore:
             self._index[last_name] = i
         self._names.pop()
         self._n = last
+        self._version += 1
 
     def _grow(self, new_cap: int) -> None:
         m = self.tensors.num_metrics
@@ -140,6 +150,7 @@ class NodeLoadStore:
             return  # metric not referenced by the policy: ignore
         self.values[i, col] = value
         self.ts[i, col] = ts
+        self._version += 1
 
     def set_hot_value(self, node: str, value: float, ts: float) -> None:
         i = self._index.get(node)
@@ -148,6 +159,7 @@ class NodeLoadStore:
         self._last_anno.pop(node, None)
         self.hot_value[i] = value
         self.hot_ts[i] = ts
+        self._version += 1
 
     def ingest_annotation(self, node: str, key: str, raw: str) -> None:
         """Decode one ``"value,timestamp"`` annotation into the store."""
@@ -172,6 +184,7 @@ class NodeLoadStore:
         self.ts[i, :] = _NEG_INF
         self.hot_value[i] = np.nan
         self.hot_ts[i] = _NEG_INF
+        self._version += 1
         if not anno:
             return
         for key, raw in anno.items():
@@ -192,6 +205,7 @@ class NodeLoadStore:
         ids = np.asarray(node_ids, dtype=np.int64)
         self.values[ids, col] = values
         self.ts[ids, col] = ts
+        self._version += 1
 
     def bulk_set_hot_value(
         self,
@@ -202,6 +216,7 @@ class NodeLoadStore:
         ids = np.asarray(node_ids, dtype=np.int64)
         self.hot_value[ids] = values
         self.hot_ts[ids] = ts
+        self._version += 1
 
     def bulk_ingest(self, items, skip_unchanged: bool = True) -> None:
         """Ingest many (node_name, annotation_map) pairs with one native
@@ -223,6 +238,7 @@ class NodeLoadStore:
             i = self.add_node(name)
             if skip_unchanged and self._last_anno.get(name) is anno:
                 continue
+            self._version += 1
             self._last_anno[name] = anno
             self.values[i, :] = np.nan
             self.ts[i, :] = _NEG_INF

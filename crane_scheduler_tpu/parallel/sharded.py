@@ -38,8 +38,10 @@ from .mesh import node_sharding, replicated_sharding
 class PreparedSnapshot:
     """Device-resident, sharded scoring inputs.
 
-    In float32 mode timestamps are stored rebased to ``now`` (epoch
-    seconds don't survive a float32 downcast) and ``now`` is 0.
+    In float32 mode timestamps are stored rebased to ``epoch`` (epoch
+    seconds don't survive a float32 downcast); ``now`` holds the rebased
+    scheduling time. A cached snapshot can be re-scored at a later wall
+    time by passing ``now`` to the step call — the upload is not redone.
     """
 
     values: Any  # [N, M] dtype, node-sharded
@@ -47,8 +49,9 @@ class PreparedSnapshot:
     hot_value: Any  # [N]
     hot_ts: Any  # [N] (possibly rebased)
     node_valid: Any  # [N] bool
-    now: Any  # scalar dtype
+    now: Any  # scalar dtype (rebased: wall now - epoch)
     capacity: Any  # [N] int64
+    epoch: float = 0.0  # host-side rebase origin (0 in float64 mode)
 
 
 @dataclass
@@ -76,6 +79,14 @@ class ShardedScheduleStep:
             in_shardings=((row, row, vec, vec, vec, rep, vec), rep),
             out_shardings=(vec, vec, vec, rep, rep),
         )
+        # Packed variant: one int32 output so the host needs exactly one
+        # device->host fetch per scheduling cycle (each fetch costs a full
+        # runtime round-trip; five of them dominated the batch path).
+        self._jit_packed = jax.jit(
+            self._step_packed,
+            in_shardings=((row, row, vec, vec, vec, rep, vec), rep),
+            out_shardings=rep,
+        )
 
     def _step(self, prepared, num_pods):
         values, ts, hot_value, hot_ts, node_valid, now, capacity = prepared
@@ -87,6 +98,22 @@ class ShardedScheduleStep:
         )
         return schedulable, scores, counts, unassigned, waterline
 
+    def _step_packed(self, prepared, num_pods):
+        """[3N+2] int32: schedulable | scores | counts | unassigned, level."""
+        schedulable, scores, counts, unassigned, waterline = self._step(
+            prepared, num_pods
+        )
+        return jnp.concatenate(
+            [
+                schedulable.astype(jnp.int32),
+                scores.astype(jnp.int32),
+                counts.astype(jnp.int32),
+                jnp.stack(
+                    [unassigned.astype(jnp.int32), waterline.astype(jnp.int32)]
+                ),
+            ]
+        )
+
     def prepare(self, snapshot, now: float, capacity=None) -> PreparedSnapshot:
         """Upload a store snapshot with node-axis shardings.
 
@@ -97,9 +124,11 @@ class ShardedScheduleStep:
         ts = np.asarray(snapshot.ts, np.float64)
         hot_ts = np.asarray(snapshot.hot_ts, np.float64)
         now_value = float(now)
+        epoch = 0.0
         if dtype != jnp.dtype(jnp.float64):
-            ts = ts - now_value  # exact in f64; small enough for f32
-            hot_ts = hot_ts - now_value
+            epoch = now_value  # exact in f64; deltas small enough for f32
+            ts = ts - epoch
+            hot_ts = hot_ts - epoch
             now_value = 0.0
         n = ts.shape[0]
         if capacity is None:
@@ -114,19 +143,45 @@ class ShardedScheduleStep:
             ),
             now=jnp.asarray(now_value, dtype),
             capacity=jax.device_put(jnp.asarray(capacity), self._vec),
+            epoch=epoch,
         )
 
-    def __call__(self, prepared: PreparedSnapshot, num_pods) -> ShardedStepResult:
-        out = self._jit(
+    def _args(self, prepared: PreparedSnapshot, num_pods, now):
+        now_arr = (
+            prepared.now
+            if now is None
+            else jnp.asarray(float(now) - prepared.epoch, self.scorer.dtype)
+        )
+        return (
             (
                 prepared.values,
                 prepared.ts,
                 prepared.hot_value,
                 prepared.hot_ts,
                 prepared.node_valid,
-                prepared.now,
+                now_arr,
                 prepared.capacity,
             ),
             jnp.asarray(num_pods),
         )
+
+    def __call__(
+        self, prepared: PreparedSnapshot, num_pods, now: float | None = None
+    ) -> ShardedStepResult:
+        out = self._jit(*self._args(prepared, num_pods, now))
         return ShardedStepResult(*out)
+
+    def packed(self, prepared: PreparedSnapshot, num_pods, now: float | None = None):
+        """One-fetch variant: device [3N+2] int32 (see ``unpack``)."""
+        return self._jit_packed(*self._args(prepared, num_pods, now))
+
+    @staticmethod
+    def unpack(packed_host: np.ndarray, n: int):
+        """Split a fetched packed result into host-side step outputs."""
+        npad = (packed_host.shape[0] - 2) // 3
+        schedulable = packed_host[:n].astype(bool)
+        scores = packed_host[npad : npad + n]
+        counts = packed_host[2 * npad : 2 * npad + n]
+        unassigned = int(packed_host[3 * npad])
+        waterline = int(packed_host[3 * npad + 1])
+        return schedulable, scores, counts, unassigned, waterline

@@ -150,8 +150,13 @@ class HybridScorer:
             # (fl32(ts-now) + fl32(active) carries ~eps32*(|ts-now|+active)
             # of rounding), so an absolute tolerance under-flags long
             # windows (>~2h). eps32 ~ 1.2e-7; 1e-6 gives ~4x margin over
-            # the two roundings involved.
-            return 1e-3 + 1e-6 * (np.abs(tstamp - now) + np.abs(active))
+            # the two roundings involved. A missing timestamp (-inf) is
+            # exactly stale in both precisions — no risk, tol 0 (a naive
+            # formula would yield tol=inf and flag every sparse node,
+            # forcing the whole cluster onto the slow f64 path).
+            with np.errstate(invalid="ignore"):
+                tol = 1e-3 + 1e-6 * (np.abs(tstamp - now) + np.abs(active))
+                return np.where(np.isfinite(tstamp), tol, 0.0)
 
         with np.errstate(invalid="ignore"):
             if len(t.pred_idx):

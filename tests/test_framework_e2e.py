@@ -317,3 +317,29 @@ def test_threaded_annotator_bulk_sync_mode():
         ann.stop()
     # exactly zero per-node IP queries were needed (bulk path only)
     assert fake.ip_queries == 0
+
+
+def test_batch_device_cache_invalidates_on_annotation_change():
+    """The prepared-snapshot cache must never serve stale scores: an
+    annotation patch between batches bumps the store version and forces a
+    re-upload."""
+    from crane_scheduler_tpu.loadstore import encode_annotation
+    from crane_scheduler_tpu.sim import SimConfig, Simulator
+
+    sim = Simulator(SimConfig(n_nodes=3, seed=11))
+    sim.sync_metrics()
+    batch = sim.build_batch_scheduler()
+    pods = [sim.make_pod() for _ in range(2)]
+    r1 = batch.schedule_batch(pods, bind=False)
+    key1 = batch._prepared_key
+    # steady state: same cluster state -> cache reused
+    batch.schedule_batch(pods, bind=False)
+    assert batch._prepared_key == key1
+    # overload one node via its annotation; the next batch must see it
+    node = sim.cluster.list_nodes()[0]
+    ts = sim.clock()
+    for m in batch.tensors.metric_names:
+        sim.cluster.patch_node_annotation(node.name, m, encode_annotation(0.99, ts))
+    r2 = batch.schedule_batch(pods, bind=False)
+    assert batch._prepared_key != key1
+    assert r2.schedulable[node.name] is False or r2.scores[node.name] < r1.scores[node.name]

@@ -145,3 +145,35 @@ def test_f32_underflow_negative_usage_rescored():
     want = oracle.score_node(anno, DEFAULT_POLICY.spec, NOW)
     assert bool(result.schedulable[0]) == ok
     assert int(result.scores[0]) == want
+
+
+def test_sparse_annotations_stay_on_fast_path():
+    """Missing annotations (-inf timestamps) are exactly stale in both
+    precisions — they must NOT be flagged risky. Regression: an inf
+    stale_tol once forced every sparsely-annotated node onto the f64
+    path (rescored == N), silently defeating the hybrid's purpose."""
+    store = NodeLoadStore(TENSORS)
+    ts_fresh = format_local_time(NOW)
+    for i in range(50):
+        # one metric missing per node, no hot value, values far from
+        # thresholds and truncation boundaries
+        anno = {
+            m: f"0.31000,{ts_fresh}"
+            for j, m in enumerate(TENSORS.metric_names)
+            if j != i % len(TENSORS.metric_names)
+        }
+        store.ingest_node_annotations(f"node-{i}", anno)
+    snap = store.snapshot(bucket=64)
+    res = HybridScorer(TENSORS)(
+        snap.values, snap.ts, snap.hot_value, snap.hot_ts, snap.node_valid, NOW
+    )
+    assert res.rescored == 0
+    # and the verdicts still match the exact f64 evaluation
+    sched64, score64 = score_rows_f64(
+        snap.values, snap.ts, snap.hot_value, snap.hot_ts, NOW, TENSORS
+    )
+    valid = np.asarray(snap.node_valid)
+    np.testing.assert_array_equal(np.asarray(res.scores)[valid], score64[valid])
+    np.testing.assert_array_equal(
+        np.asarray(res.schedulable)[valid], sched64[valid]
+    )

@@ -88,3 +88,68 @@ def test_sharded_output_is_actually_sharded():
     res = step(step.prepare(snap, NOW), 10)
     # scores live sharded across all 8 devices
     assert len(res.scores.sharding.device_set) == 8
+
+
+def test_packed_matches_unpacked():
+    rng = random.Random(7)
+    store = build_store(rng, 100)
+    snap = store.snapshot(bucket=64)
+    mesh = make_node_mesh(8)
+    step = ShardedScheduleStep(TENSORS, mesh, dtype=jnp.float64)
+    prepared = step.prepare(snap, NOW)
+    res = step(prepared, 123)
+    packed = np.asarray(step.packed(prepared, 123))
+    schedulable, scores, counts, unassigned, waterline = step.unpack(
+        packed, snap.n_nodes
+    )
+    n = snap.n_nodes
+    np.testing.assert_array_equal(schedulable, np.asarray(res.schedulable)[:n])
+    np.testing.assert_array_equal(scores, np.asarray(res.scores)[:n])
+    np.testing.assert_array_equal(counts, np.asarray(res.counts)[:n])
+    assert unassigned == int(res.unassigned)
+    assert waterline == int(res.waterline)
+
+
+def test_step_now_override_rescores_cached_snapshot():
+    """A cached (uploaded-once) snapshot re-scored at a later `now` must
+    match a fresh prepare at that time — in both dtypes (the f32 path
+    rebases timestamps to the upload epoch)."""
+    rng = random.Random(8)
+    store = build_store(rng, 64)
+    snap = store.snapshot(bucket=64)
+    mesh = make_node_mesh(8)
+    later = NOW + 240.0  # pushes the age-600 annotations past some windows
+    for dtype in (jnp.float64, jnp.float32):
+        step = ShardedScheduleStep(TENSORS, mesh, dtype=dtype)
+        cached = step.prepare(snap, NOW)
+        fresh = step.prepare(snap, later)
+        res_cached = step(cached, 10, now=later)
+        res_fresh = step(fresh, 10)
+        np.testing.assert_array_equal(
+            np.asarray(res_cached.scores), np.asarray(res_fresh.scores)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res_cached.schedulable), np.asarray(res_fresh.schedulable)
+        )
+
+
+def test_store_version_counter():
+    store = NodeLoadStore(TENSORS)
+    v0 = store.version
+    store.add_node("a")
+    assert store.version > v0
+    v1 = store.version
+    store.set_metric("a", TENSORS.metric_names[0], 0.5, NOW)
+    assert store.version > v1
+    v2 = store.version
+    # unchanged bulk ingest (same annotation map object) must NOT bump
+    anno = {TENSORS.metric_names[0]: "0.50000,2025-01-01T00:00:00Z"}
+    store.bulk_ingest([("b", anno)])
+    v3 = store.version
+    store.bulk_ingest([("b", anno)])  # identical map object -> skipped
+    assert store.version == v3
+    store.bulk_ingest([("b", dict(anno))])  # new object -> re-ingested
+    v4 = store.version
+    assert v4 > v3
+    store.remove_node("a")
+    assert store.version > v4
