@@ -190,9 +190,12 @@ class BatchScheduler:
         dtype = dtype or jnp.float64
         if mesh is None:
             mesh = make_node_mesh(1)
+        self._mesh = mesh
+        self._dtype = dtype
         self._sharded = ShardedScheduleStep(self.tensors, mesh, dtype=dtype)
         self.scorer = self._sharded.scorer
         self.gang = self._sharded.gang
+        self._combined = None  # lazy: combined-score step for schedule_gang
         # device-resident snapshot cache: (store version, padded N) it was
         # built from; an unchanged store re-dispatches with zero uploads
         self._prepared = None
@@ -225,30 +228,214 @@ class BatchScheduler:
         now = self._clock()
         self.refresh()
         prepared = self._prepare(now)
-        n = self._prepared_n
 
         packed = np.asarray(
             self._sharded.packed(prepared, len(pods), now=now)
         )  # the cycle's single device->host fetch
-        schedulable, scores, counts, _unassigned, _ = self._sharded.unpack(packed, n)
+        result = self._build_result(packed, [pod.key() for pod in pods])
 
-        # expand per-node counts into the sequential pod order (pods are
-        # interchangeable within a batch; see scorer.topk docstring)
+        if bind:
+            for pod_key, node_name in result.assignments.items():
+                self.cluster.bind_pod(pod_key, node_name, now)
+        return result
+
+    def _build_result(self, packed, keys) -> BatchResult:
+        """Expand per-node counts into the sequential pod-key order (pods
+        are interchangeable within a batch; see scorer.topk docstring)."""
+        import numpy as np
+
+        n = self._prepared_n
         names = self._prepared_names
+        schedulable, scores, counts, _unassigned, _ = self._sharded.unpack(packed, n)
         by_score = np.argsort(-scores, kind="stable")
         order = np.repeat(by_score, counts[by_score])
         assignments = {
-            pod.key(): names[node_idx] for pod, node_idx in zip(pods, order)
+            key: names[node_idx] for key, node_idx in zip(keys, order)
         }
-        unassigned = [pod.key() for pod in pods[len(order):]]
-
-        if bind:
-            for pod_key, node_name in assignments.items():
-                self.cluster.bind_pod(pod_key, node_name, now)
-
+        unassigned = list(keys[len(order):])
         return BatchResult(
             assignments=assignments,
             unassigned=unassigned,
             scores={names[i]: int(scores[i]) for i in range(n)},
             schedulable={names[i]: bool(schedulable[i]) for i in range(n)},
         )
+
+    # -- combined-score gang mode (Dynamic + NodeResourceTopology) ---------
+
+    def _combined_step(self, dynamic_weight: int, topology_weight: int):
+        from ..constants import MAX_NODE_SCORE
+        from ..parallel.sharded import ShardedScheduleStep
+
+        key = (dynamic_weight, topology_weight)
+        if self._combined is None or self._combined[0] != key:
+            step = ShardedScheduleStep(
+                self.tensors,
+                self._mesh,
+                dtype=self._dtype,
+                dynamic_weight=dynamic_weight,
+                max_offset=MAX_NODE_SCORE * topology_weight,
+            )
+            self._combined = (key, step)
+        return self._combined[1]
+
+    def _numa_vectors(self, template, topology, topology_weight: int, names, n):
+        """Per-node combined-score offsets (+ copy capacity) for a burst
+        of ``template`` clones, using the TopologyMatch plugin's own
+        request/wrapper semantics (ref: filter.go:45-123, scorer.go:11-29):
+
+        - nodes the plugin would skip (no guaranteed-CPU containers,
+          non-Static CPUManagerPolicy) contribute offset 0, unlimited
+          capacity — exactly the plugin's no-op score 0;
+        - a missing NRT CR is Unschedulable -> capacity 0;
+        - aware bursts: offset weight*100 when a zone fits (the single
+          assigned zone), otherwise capacity 0 (ERR_NUMA_INSUFFICIENT);
+        - non-aware: offset weight*(100 // greedy zones used), capacity
+          from the pooled copies bound (see topology.batched).
+        """
+        import numpy as np
+
+        from ..framework.types import CycleState, NodeInfo
+        from ..topology.batched import (
+            copies_capacity,
+            evaluate_topology_batch,
+        )
+
+        offsets = np.zeros((n,), dtype=np.int32)
+        capacity = np.full((n,), 1 << 30, dtype=np.int64)
+        state = CycleState()
+        topology.pre_filter(state, template)
+        s = topology._get_state(state)
+        if (
+            s is None
+            or template.is_daemonset_pod()
+            or not s.target_container_indices
+        ):
+            return offsets, capacity  # plugin no-ops for this pod
+
+        from ..topology.types import CPU_MANAGER_POLICY_STATIC
+
+        pods_by_node: dict[str, list] = {}
+        for pod in self.cluster.list_pods():
+            if pod.node_name:
+                pods_by_node.setdefault(pod.node_name, []).append(pod)
+        nodes_by_name = {node.name: node for node in self.cluster.list_nodes()}
+
+        enforced: list[tuple[int, object]] = []  # (row, wrapper)
+        for i, name in enumerate(names[:n]):
+            node = nodes_by_name.get(name)
+            if node is None:
+                capacity[i] = 0
+                continue
+            try:
+                nrt = topology.lister.get(name)
+            except KeyError:
+                capacity[i] = 0  # ref: filter.go:56-58 Unschedulable
+                continue
+            if nrt.crane_manager_policy.cpu_manager_policy != CPU_MANAGER_POLICY_STATIC:
+                continue  # kubelet handles cpuset; plugin no-op
+            nw = topology._initialize_node_wrapper(
+                s, NodeInfo(node=node, pods=pods_by_node.get(name, [])), nrt
+            )
+            enforced.append((i, nw))
+        if not enforced:
+            return offsets, capacity
+
+        request = s.target_container_resource
+        rows = [i for i, _ in enforced]
+        wrappers = [nw for _, nw in enforced]
+        aware_mask = np.array([nw.aware for nw in wrappers], dtype=bool)
+        ev = evaluate_topology_batch(wrappers, request)
+        aware_fits = np.asarray(ev.aware_fits)
+        numa_scores = np.asarray(ev.scores)
+
+        caps = copies_capacity(wrappers, request, aware=aware_mask).astype(np.int64)
+        caps = np.where(aware_mask & ~aware_fits, 0, caps)
+        # aware pods take one whole zone: plugin score 100 (ref: helper.go
+        # :276-284 single-zone result); non-aware: 100 // zones used
+        offs = np.where(
+            aware_mask, 100 * int(topology_weight),
+            numa_scores.astype(np.int64) * int(topology_weight),
+        )
+        offsets[rows] = offs.astype(np.int32)
+        capacity[rows] = caps
+        return offsets, capacity
+
+    def schedule_gang(
+        self,
+        template,
+        count: int,
+        topology=None,
+        bind: bool = True,
+        dynamic_weight: int = 3,
+        topology_weight: int = 2,
+    ) -> BatchResult:
+        """Burst-schedule ``count`` identical copies of ``template`` with
+        combined plugin scoring — Dynamic x3 + NodeResourceTopologyMatch
+        x2, the deploy-config weights (ref: deploy/manifests/*/scheduler-
+        config.yaml) — and NUMA copy-capacity as the gang capacity vector.
+
+        The water-filling solver runs in the weighted-sum score domain
+        (see scorer.topk combined-score mode). With ``bind=True`` each
+        assigned copy is created in the cluster and driven through the
+        topology plugin's own Filter -> Reserve -> PreBind per pod, so
+        zone results land on pod annotations and subsequent cycles see
+        the NUMA usage (placement itself stays the gang's decision).
+        """
+        import numpy as np
+
+        now = self._clock()
+        self.refresh()
+        prepared = self._prepare(now)
+        n = self._prepared_n
+        names = self._prepared_names
+
+        step = self._combined_step(dynamic_weight, topology_weight)
+        if topology is not None:
+            offsets, capacity = self._numa_vectors(
+                template, topology, topology_weight, names, n
+            )
+            npad = prepared.capacity.shape[0]
+            offsets = np.pad(offsets, (0, npad - n))
+            capacity = np.pad(capacity, (0, npad - n))
+            gang_prepared = step.with_vectors(prepared, capacity, offsets)
+        else:
+            gang_prepared = prepared
+
+        packed = np.asarray(step.packed(gang_prepared, count, now=now))
+        keys = [f"{template.namespace}/{template.name}-{i}" for i in range(count)]
+        result = self._build_result(packed, keys)
+
+        if bind:
+            self._bind_gang(template, result.assignments, topology, now)
+        return result
+
+    def _bind_gang(self, template, assignments, topology, now: float) -> None:
+        """Create + bind each assigned copy; run the topology plugin's
+        per-pod extension points so zone usage is durably recorded
+        (ref: reserver.go, binder.go). A copy the plugin's Filter rejects
+        (the copies-capacity estimate over-admitted) still binds — the
+        gang owns placement — but without a zone annotation."""
+        from dataclasses import replace
+
+        from ..framework.types import CycleState, NodeInfo
+
+        nodes_by_name = {node.name: node for node in self.cluster.list_nodes()}
+        for pod_key, node_name in assignments.items():
+            pod = replace(
+                template,
+                name=pod_key.split("/", 1)[1],
+                annotations=dict(template.annotations),
+                node_name="",
+            )
+            self.cluster.add_pod(pod)
+            if topology is not None and node_name in nodes_by_name:
+                state = CycleState()
+                topology.pre_filter(state, pod)
+                node_info = NodeInfo(
+                    node=nodes_by_name[node_name],
+                    pods=self.cluster.list_pods(node_name),
+                )
+                if topology.filter(state, pod, node_info).ok():
+                    if topology.reserve(state, pod, node_name).ok():
+                        topology.pre_bind(state, pod, node_name)
+            self.cluster.bind_pod(pod_key, node_name, now)

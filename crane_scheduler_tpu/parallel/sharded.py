@@ -51,6 +51,7 @@ class PreparedSnapshot:
     node_valid: Any  # [N] bool
     now: Any  # scalar dtype (rebased: wall now - epoch)
     capacity: Any  # [N] int64
+    offsets: Any = None  # [N] int32 combined-score offsets (see scorer.topk)
     epoch: float = 0.0  # host-side rebase origin (0 in float64 mode)
 
 
@@ -66,17 +67,26 @@ class ShardedStepResult:
 class ShardedScheduleStep:
     """score + gang-assign, jitted with node-axis shardings on ``mesh``."""
 
-    def __init__(self, tensors: PolicyTensors, mesh: Mesh, dtype=jnp.float32):
+    def __init__(
+        self,
+        tensors: PolicyTensors,
+        mesh: Mesh,
+        dtype=jnp.float32,
+        dynamic_weight: int = 1,
+        max_offset: int = 0,
+    ):
         self.mesh = mesh
         self.scorer = BatchedScorer(tensors, dtype=dtype)
-        self.gang = GangScheduler(tensors.hv_count)
+        self.gang = GangScheduler(
+            tensors.hv_count, dynamic_weight=dynamic_weight, max_offset=max_offset
+        )
         row = node_sharding(mesh, 2)
         vec = node_sharding(mesh, 1)
         rep = replicated_sharding(mesh)
         self._row, self._vec, self._rep = row, vec, rep
         self._jit = jax.jit(
             self._step,
-            in_shardings=((row, row, vec, vec, vec, rep, vec), rep),
+            in_shardings=((row, row, vec, vec, vec, rep, vec, vec), rep),
             out_shardings=(vec, vec, vec, rep, rep),
         )
         # Packed variant: one int32 output so the host needs exactly one
@@ -84,17 +94,17 @@ class ShardedScheduleStep:
         # runtime round-trip; five of them dominated the batch path).
         self._jit_packed = jax.jit(
             self._step_packed,
-            in_shardings=((row, row, vec, vec, vec, rep, vec), rep),
+            in_shardings=((row, row, vec, vec, vec, rep, vec, vec), rep),
             out_shardings=rep,
         )
 
     def _step(self, prepared, num_pods):
-        values, ts, hot_value, hot_ts, node_valid, now, capacity = prepared
+        values, ts, hot_value, hot_ts, node_valid, now, capacity, offsets = prepared
         schedulable, scores = self.scorer._score_impl(
             values, ts, hot_value, hot_ts, node_valid, now
         )
         counts, unassigned, waterline = self.gang._assign_impl(
-            scores, schedulable, num_pods, capacity
+            scores, schedulable, num_pods, capacity, offsets
         )
         return schedulable, scores, counts, unassigned, waterline
 
@@ -114,7 +124,9 @@ class ShardedScheduleStep:
             ]
         )
 
-    def prepare(self, snapshot, now: float, capacity=None) -> PreparedSnapshot:
+    def prepare(
+        self, snapshot, now: float, capacity=None, offsets=None
+    ) -> PreparedSnapshot:
         """Upload a store snapshot with node-axis shardings.
 
         Host -> device transfer happens here, once per refresh; the jitted
@@ -133,6 +145,8 @@ class ShardedScheduleStep:
         n = ts.shape[0]
         if capacity is None:
             capacity = np.full((n,), 1 << 30, dtype=np.int64)
+        if offsets is None:
+            offsets = np.zeros((n,), dtype=np.int32)
         return PreparedSnapshot(
             values=jax.device_put(jnp.asarray(snapshot.values, dtype), self._row),
             ts=jax.device_put(jnp.asarray(ts, dtype), self._row),
@@ -143,8 +157,27 @@ class ShardedScheduleStep:
             ),
             now=jnp.asarray(now_value, dtype),
             capacity=jax.device_put(jnp.asarray(capacity), self._vec),
+            offsets=jax.device_put(jnp.asarray(offsets, jnp.int32), self._vec),
             epoch=epoch,
         )
+
+    def with_vectors(
+        self, prepared: PreparedSnapshot, capacity=None, offsets=None
+    ) -> PreparedSnapshot:
+        """Clone a prepared snapshot with new per-node gang vectors,
+        reusing the resident load matrices (uploads only [N]-sized data —
+        the per-gang-request path)."""
+        import dataclasses
+
+        changes = {}
+        if capacity is not None:
+            capacity = np.minimum(np.asarray(capacity, np.int64), 2**31 - 1)
+            changes["capacity"] = jax.device_put(jnp.asarray(capacity), self._vec)
+        if offsets is not None:
+            changes["offsets"] = jax.device_put(
+                jnp.asarray(offsets, jnp.int32), self._vec
+            )
+        return dataclasses.replace(prepared, **changes) if changes else prepared
 
     def _args(self, prepared: PreparedSnapshot, num_pods, now):
         now_arr = (
@@ -161,6 +194,7 @@ class ShardedScheduleStep:
                 prepared.node_valid,
                 now_arr,
                 prepared.capacity,
+                prepared.offsets,
             ),
             jnp.asarray(num_pods),
         )

@@ -30,6 +30,22 @@ where cumulative capacity crosses P, and split the waterline level by
 prefix-sum in node-index order. Everything is O(101·N) tensor work — no
 sequential loop over pods — and shards over the node axis.
 
+**Combined-score mode**: the scheduler framework sums weighted plugin
+scores (deploy configs: Dynamic weight 3, NodeResourceTopologyMatch
+weight 2 — ref: deploy/manifests/*/scheduler-config.yaml). Only the
+Dynamic component moves with in-batch assignments; other plugins'
+scores are pod-independent within a burst of identical pods. So token
+values generalize to
+
+    value_n(t) = dynamic_weight * max(S_n - 10·h(t), 0) + offset_n
+
+with ``offset_n = Σ_other w_i·score_i(n)`` a per-node constant. The
+level grid widens to [0, 100·dynamic_weight + max_offset] and the
+per-level token count inverts through the same g staircase:
+``A_n(L) = k_cap`` when L <= offset_n, else ``min(k_cap, g[(S_n-q)//10])``
+with ``q = ceil((L-offset_n)/dynamic_weight)`` (0 when q > 100 or
+S_n < q). Defaults (weight 1, offsets 0) reproduce the plain grid.
+
 Entries with ``count <= 0`` are skipped in h (the reference would panic
 on integer division by zero; a policy that does this is invalid).
 """
@@ -93,11 +109,15 @@ def gang_assign_oracle(
     num_pods: int,
     hv_counts: Sequence[int],
     capacity: Sequence[int] | None = None,
+    offsets: Sequence[int] | None = None,
+    dynamic_weight: int = 1,
 ) -> GangResult:
     """Sequential greedy reference implementation (slow; parity oracle)."""
     n = len(scores)
     counts = [int(c) for c in hv_counts if int(c) > 0]
     cap = [num_pods] * n if capacity is None else [int(c) for c in capacity]
+    offs = [0] * n if offsets is None else [int(o) for o in offsets]
+    w = int(dynamic_weight)
     assigned = [0] * n
 
     def h(c: int) -> int:
@@ -109,7 +129,10 @@ def gang_assign_oracle(
         for i in range(n):
             if not schedulable[i] or assigned[i] >= cap[i]:
                 continue
-            eff = normalize_score(int(scores[i]) - 10 * h(assigned[i]), MAX_NODE_SCORE, 0)
+            dyn = normalize_score(
+                int(scores[i]) - 10 * h(assigned[i]), MAX_NODE_SCORE, 0
+            )
+            eff = w * dyn + offs[i]
             if eff > best_eff:
                 best, best_eff = i, eff
         if best < 0:
@@ -123,12 +146,29 @@ def gang_assign_oracle(
 class GangScheduler:
     """Jitted water-filling gang assignment.
 
-    Static over (policy hotValue table); jitted per (N,) shape with
-    ``num_pods`` and per-node capacity as traced inputs.
+    Static over (policy hotValue table, dynamic_weight, max_offset);
+    jitted per (N,) shape with ``num_pods``, per-node capacity, and
+    per-node combined-score offsets as traced inputs. Defaults
+    (``dynamic_weight=1``, ``max_offset=0``, zero offsets) are the plain
+    Dynamic-score domain.
     """
 
-    def __init__(self, hv_counts: Sequence[int]):
+    def __init__(
+        self,
+        hv_counts: Sequence[int],
+        dynamic_weight: int = 1,
+        max_offset: int = 0,
+    ):
+        if dynamic_weight < 1:
+            raise ValueError("dynamic_weight must be >= 1")
+        if max_offset < 0:
+            raise ValueError("max_offset must be >= 0")
         self._g_host = hot_penalty_steps(hv_counts)  # [11] np.int64
+        self._weight = int(dynamic_weight)
+        self._max_offset = int(max_offset)
+        # token values live in [0, 100*w + max_offset]; one extra level so
+        # waterline+1 indexing stays in range
+        self._n_levels = MAX_NODE_SCORE * self._weight + self._max_offset + 2
         self._jit = jax.jit(self._assign_impl)
 
     def _g_lookup(self, xq):
@@ -144,28 +184,46 @@ class GangScheduler:
             out = jnp.where(xq <= x, jnp.int32(int(self._g_host[x])), out)
         return out
 
-    def __call__(self, scores, schedulable, num_pods, capacity=None) -> GangResult:
+    def __call__(
+        self, scores, schedulable, num_pods, capacity=None, offsets=None
+    ) -> GangResult:
         scores = jnp.asarray(scores, dtype=jnp.int32)
         n = scores.shape[0]
         num_pods = int(min(int(num_pods), 2**31 - 1))
         if capacity is None:
             capacity = np.full((n,), num_pods, dtype=np.int64)
         capacity = np.minimum(np.asarray(capacity, dtype=np.int64), 2**31 - 1)
+        if offsets is None:
+            offsets = np.zeros((n,), dtype=np.int32)
         out = self._jit(
             scores,
             jnp.asarray(schedulable, dtype=jnp.bool_),
             jnp.asarray(num_pods, dtype=jnp.int32),
             jnp.asarray(capacity, dtype=jnp.int32),
+            jnp.asarray(offsets, dtype=jnp.int32),
         )
         return GangResult(*out)
 
-    def _assign_impl(self, scores, schedulable, num_pods, capacity):
+    def _a_table(self, s, offsets, k_cap, lv):
+        """A_n(L): tokens of node n valued >= level L, for L broadcast
+        against the node axis. Level 0 (and any L <= offset) is always
+        the full k_cap: token values never drop below the offset."""
+        qnum = lv - offsets  # may broadcast [L, N] or [N]
+        w = self._weight
+        q = (qnum + (w - 1)) // w  # ceil; only meaningful when qnum > 0
+        xq = jnp.clip((s - q) // 10, 0, 10)
+        unlocked = jnp.where((q <= MAX_NODE_SCORE) & (s >= q), self._g_lookup(xq), 0)
+        unlocked = jnp.where(qnum <= 0, k_cap, unlocked)
+        return jnp.minimum(k_cap, unlocked)
+
+    def _assign_impl(self, scores, schedulable, num_pods, capacity, offsets):
         # All internal arithmetic is int32: int64 cumsum/reductions lower
         # to u32-pair reduce-windows that blow TPU vmem at 50k nodes. This
         # is exact because per-node tokens are clipped to (2^31-1)/N (so
         # level totals fit int32); the only divergence from the sequential
         # oracle would need a single node to absorb > 2^31/N pods.
         n = scores.shape[0]
+        n_levels = self._n_levels
         num_pods = jnp.minimum(num_pods, jnp.asarray(2**31 - 1)).astype(jnp.int32)
         capacity = jnp.clip(capacity, 0, 2**31 - 1).astype(jnp.int32)
         k_cap = jnp.where(schedulable, capacity, 0)  # [N] i32
@@ -175,31 +233,22 @@ class GangScheduler:
         k_cap = jnp.minimum(k_cap, (2**31 - 1) // max(n, 1))
 
         s = scores.astype(jnp.int32)
-        levels = jnp.arange(102, dtype=jnp.int32)  # [102]
+        offs = jnp.clip(offsets.astype(jnp.int32), 0, self._max_offset)
+        levels = jnp.arange(n_levels, dtype=jnp.int32)
 
-        # totals[L] = Σ_n A_n(L), the number of tokens valued >= L, where
-        # A_n(L) = min(k_cap_n, g[floor((s_n - L)/10)]) for s_n >= L >= 1.
-        # Materialize the [102, N] level table directly (elementwise ops +
-        # one reduction over N — 5.1M int32 lanes, trivial for the VPU).
-        # An earlier formulation scattered breakpoint deltas into a [102]
+        # totals[L] = Σ_n A_n(L), the number of tokens valued >= L.
+        # Materialize the [n_levels, N] level table directly (elementwise
+        # ops + one reduction over N — int32 lanes, trivial for the VPU).
+        # An earlier formulation scattered breakpoint deltas into a
         # histogram; TPU lowers 1D scatter-adds poorly (and the scatter
         # emitter can abort in fusion: scatter_emitter.cc operand check),
         # so the dense table is both faster and safer here.
-        lv = levels[:, None]  # [102, 1]
-        xq = jnp.clip((s[None, :] - lv) // 10, 0, 10)  # [102, N]
-        unlocked = jnp.where(s[None, :] >= lv, self._g_lookup(xq), 0)
-        a_table = jnp.minimum(k_cap[None, :], unlocked)  # [102, N]
-        totals = a_table.sum(axis=1, dtype=jnp.int32)  # [102]
-        totals = totals.at[0].set(k_cap.sum(dtype=jnp.int32))
+        a_table = self._a_table(s[None, :], offs[None, :], k_cap[None, :],
+                                levels[:, None])
+        totals = a_table.sum(axis=1, dtype=jnp.int32)  # [n_levels]
 
         meets = totals >= num_pods  # True for L <= L*
         l_star = jnp.max(jnp.where(meets, levels, -1))  # -1 => capacity short
-
-        def a_of(level):
-            """A_n(level) for a traced scalar level >= 1, elementwise."""
-            xq = jnp.clip((s - level) // 10, 0, 10)
-            unlocked = jnp.where(s >= level, self._g_lookup(xq), 0)
-            return jnp.minimum(k_cap, unlocked)
 
         def full_capacity(_):
             counts = k_cap
@@ -207,11 +256,15 @@ class GangScheduler:
             return counts, unassigned, jnp.asarray(-1, jnp.int32)
 
         def waterline(l_star):
-            upper = jnp.where(l_star + 1 >= 102, 0, a_of(l_star + 1))
-            at_or_above = jnp.where(l_star >= 1, a_of(l_star), k_cap)
+            upper = jnp.where(
+                l_star + 1 >= n_levels, 0, self._a_table(s, offs, k_cap, l_star + 1)
+            )
+            at_or_above = self._a_table(s, offs, k_cap, l_star)
             exact = at_or_above - upper  # tokens exactly at L*
-            remainder = num_pods - jnp.take(totals, jnp.minimum(l_star + 1, 101))
-            remainder = jnp.where(l_star + 1 >= 102, num_pods, remainder)
+            remainder = num_pods - jnp.take(
+                totals, jnp.minimum(l_star + 1, n_levels - 1)
+            )
+            remainder = jnp.where(l_star + 1 >= n_levels, num_pods, remainder)
             # exclusive prefix sum in node-index order (int32 pinned: int64
             # cumsum lowers to a vmem-hungry u32-pair reduce-window on TPU)
             prefix = jnp.cumsum(exact, dtype=jnp.int32) - exact

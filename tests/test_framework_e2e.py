@@ -343,3 +343,144 @@ def test_batch_device_cache_invalidates_on_annotation_change():
     r2 = batch.schedule_batch(pods, bind=False)
     assert batch._prepared_key != key1
     assert r2.schedulable[node.name] is False or r2.scores[node.name] < r1.scores[node.name]
+
+
+def _nrt_fixture(sim, zone_cpus_by_node):
+    from crane_scheduler_tpu.topology.types import (
+        CPU_MANAGER_POLICY_STATIC,
+        TOPOLOGY_MANAGER_POLICY_NONE,
+        CraneManagerPolicy,
+        InMemoryNRTLister,
+        NodeResourceTopology,
+        Zone,
+        ZoneResourceInfo,
+    )
+
+    lister = InMemoryNRTLister()
+    for node, zone_cpus in zip(sim.cluster.list_nodes(), zone_cpus_by_node):
+        lister.upsert(
+            NodeResourceTopology(
+                name=node.name,
+                crane_manager_policy=CraneManagerPolicy(
+                    CPU_MANAGER_POLICY_STATIC, TOPOLOGY_MANAGER_POLICY_NONE
+                ),
+                zones=tuple(
+                    Zone(
+                        f"numa-{j}",
+                        resources=ZoneResourceInfo(
+                            allocatable={"cpu": f"{c}m", "memory": "64Gi"}
+                        ),
+                    )
+                    for j, c in enumerate(zone_cpus)
+                ),
+            )
+        )
+    return lister
+
+
+def test_schedule_gang_numa_offsets_flip_winner():
+    """Combined-score gang: a node whose request fits one NUMA zone
+    (offset 200) must beat a slightly-higher-Dynamic node that crosses
+    two zones (offset 100) — and match the sequential combined oracle."""
+    from crane_scheduler_tpu.loadstore import encode_annotation
+    from crane_scheduler_tpu.scorer.topk import gang_assign_oracle
+    from crane_scheduler_tpu.topology import TopologyMatch
+
+    sim = make_sim(2, seed=21)
+    batch = sim.build_batch_scheduler()
+    nodes = sim.cluster.list_nodes()
+    now = sim.clock()
+    # node0: dynamic usage 0.40 everywhere; single 4-core zone (fits 2k)
+    # node1: dynamic usage 0.10; two 1.5-core zones — whole-core flooring
+    # (helper.go:194) leaves 1000m usable per zone, so 2000m crosses both
+    for node, usage in ((nodes[0], 0.40), (nodes[1], 0.10)):
+        for m in batch.tensors.metric_names:
+            sim.cluster.patch_node_annotation(
+                node.name, m, encode_annotation(usage, now)
+            )
+    lister = _nrt_fixture(sim, [[4000], [1500, 1500]])
+    topology = TopologyMatch(lister, cluster=sim.cluster)
+    template = sim.make_pod(cpu_milli=2000, mem=1 << 30)
+    sim.cluster.delete_pod(template.key())  # template only, not pending
+
+    result = batch.schedule_gang(template, 2, topology=topology, bind=False)
+    # dyn0=60, dyn1=90; combined first tokens: 3*60+200=380 vs 3*90+100=370
+    dyn = [result.scores[n.name] for n in nodes]
+    assert dyn == [60, 90]
+    spread = {}
+    for node_name in result.assignments.values():
+        spread[node_name] = spread.get(node_name, 0) + 1
+    want = gang_assign_oracle(
+        dyn, [True, True], 2, batch.tensors.hv_count,
+        capacity=[2, 1], offsets=[200, 100], dynamic_weight=3,
+    )
+    got = [spread.get(n.name, 0) for n in nodes]
+    assert got == list(want.counts)
+    assert got[0] >= 1  # the single-zone node won the first pod
+
+
+def test_schedule_gang_capacity_and_aware_unschedulable():
+    """Aware template: nodes with no single fitting zone get capacity 0;
+    fitting nodes cap at their zone copy count."""
+    from crane_scheduler_tpu.topology import TopologyMatch
+    from crane_scheduler_tpu.topology.types import ANNOTATION_POD_TOPOLOGY_AWARENESS
+
+    sim = make_sim(3, seed=22)
+    batch = sim.build_batch_scheduler()
+    # node0: two 4-core zones (2 aware copies of a 3-core pod: 1 per zone)
+    # node1: one 2-core zone (no fit); node2: one 8-core zone (2 copies)
+    lister = _nrt_fixture(sim, [[4000, 4000], [2000], [8000]])
+    topology = TopologyMatch(lister, cluster=sim.cluster)
+    template = sim.make_pod(cpu_milli=3000, mem=1 << 30)
+    sim.cluster.delete_pod(template.key())
+    template.annotations[ANNOTATION_POD_TOPOLOGY_AWARENESS] = "true"
+
+    result = batch.schedule_gang(template, 10, topology=topology, bind=False)
+    nodes = [n.name for n in sim.cluster.list_nodes()]
+    spread = {}
+    for node_name in result.assignments.values():
+        spread[node_name] = spread.get(node_name, 0) + 1
+    assert spread.get(nodes[1], 0) == 0  # no zone fits 3 cores
+    assert spread.get(nodes[0], 0) <= 2
+    assert spread.get(nodes[2], 0) <= 2
+    assert len(result.unassigned) == 10 - len(result.assignments)
+    assert len(result.assignments) == 4  # total NUMA capacity
+
+
+def test_schedule_gang_bind_creates_pods_and_consumes_numa():
+    """bind=True must create + bind real pods (feeding Scheduled events),
+    write per-pod zone annotations via the plugin path, and make the
+    consumed NUMA capacity visible to the next burst."""
+    from crane_scheduler_tpu.topology import TopologyMatch
+    from crane_scheduler_tpu.topology.helper import get_pod_numa_node_result
+    from crane_scheduler_tpu.topology.types import ANNOTATION_POD_TOPOLOGY_AWARENESS
+
+    sim = make_sim(2, seed=23)
+    batch = sim.build_batch_scheduler()
+    # each node: one 4-core zone -> one aware 3-core copy per node
+    lister = _nrt_fixture(sim, [[4000], [4000]])
+    topology = TopologyMatch(lister, cluster=sim.cluster)
+    template = sim.make_pod(cpu_milli=3000, mem=1 << 30)
+    sim.cluster.delete_pod(template.key())
+    template.annotations[ANNOTATION_POD_TOPOLOGY_AWARENESS] = "true"
+
+    r1 = batch.schedule_gang(template, 2, topology=topology, bind=True)
+    assert len(r1.assignments) == 2 and not r1.unassigned
+    for key, node_name in r1.assignments.items():
+        pod = sim.cluster.get_pod(key)
+        assert pod is not None and pod.node_name == node_name
+        zones = get_pod_numa_node_result(pod)
+        assert len(zones) == 1  # aware: single zone recorded
+    # binding emitted Scheduled events (hot-value feedback path)
+    now = sim.clock.now()
+    for node_name in r1.assignments.values():
+        assert (
+            sim.annotator.binding_records.get_last_node_binding_count(
+                node_name, 300.0, now
+            )
+            >= 1
+        )
+    # zones are now full: a second burst finds zero NUMA capacity
+    r2 = batch.schedule_gang(template, 2, topology=topology, bind=False)
+    assert len(r2.assignments) == 0
+    assert len(r2.unassigned) == 2

@@ -107,3 +107,73 @@ def test_random_parity(seed):
     if rng.random() < 0.5:
         capacity = [rng.randint(0, 20) for _ in range(n)]
     run_both(scores, schedulable, p, hv, capacity)
+
+
+def run_both_combined(scores, schedulable, p, hv, capacity, offsets, weight,
+                      max_offset):
+    want = gang_assign_oracle(
+        scores, schedulable, p, hv, capacity,
+        offsets=offsets, dynamic_weight=weight,
+    )
+    got = GangScheduler(hv, dynamic_weight=weight, max_offset=max_offset)(
+        scores, schedulable, p, capacity, offsets=offsets
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.counts), want.counts,
+        err_msg=f"scores={scores} p={p} cap={capacity} off={offsets} w={weight}",
+    )
+    assert int(got.unassigned) == want.unassigned
+    return got
+
+
+def test_combined_offsets_pick_numa_winner():
+    # equal dynamic scores; NUMA offset (score*2) decides
+    got = run_both_combined(
+        [50, 50, 50], [True] * 3, 2, [1], None,
+        offsets=[100, 200, 66], weight=3, max_offset=200,
+    )
+    # node 1 leads at 3*50+200=350; its second token (3*40+200=320) still
+    # beats node 0's first (3*50+100=250): both pods land on node 1
+    assert np.asarray(got.counts).tolist() == [0, 2, 0]
+
+
+def test_combined_weight_trades_against_offset():
+    # node 0: dyn 90 w3 = 270 + off 0; node 1: dyn 60 w3 = 180 + off 100=280
+    got = run_both_combined(
+        [90, 60], [True, True], 1, [], None,
+        offsets=[0, 100], weight=3, max_offset=200,
+    )
+    assert np.asarray(got.counts).tolist() == [0, 1]
+
+
+def test_combined_defaults_match_plain():
+    rng = random.Random(99)
+    n = 30
+    scores = [rng.randint(0, 100) for _ in range(n)]
+    sched = [rng.random() > 0.2 for _ in range(n)]
+    plain = GangScheduler(DEFAULT_HV)(scores, sched, 40)
+    combined = GangScheduler(DEFAULT_HV, dynamic_weight=1, max_offset=0)(
+        scores, sched, 40, offsets=[0] * n
+    )
+    np.testing.assert_array_equal(
+        np.asarray(plain.counts), np.asarray(combined.counts)
+    )
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_combined_random_parity(seed):
+    rng = random.Random(1000 + seed)
+    n = rng.randint(1, 30)
+    weight = rng.choice([1, 2, 3, 5])
+    max_offset = rng.choice([0, 100, 200, 250])
+    scores = [rng.randint(0, 100) for _ in range(n)]
+    schedulable = [rng.random() > 0.2 for _ in range(n)]
+    p = rng.randint(0, 100)
+    hv = rng.choice([DEFAULT_HV, [1], [3, 7], []])
+    capacity = None
+    if rng.random() < 0.5:
+        capacity = [rng.randint(0, 15) for _ in range(n)]
+    offsets = [rng.randint(0, max_offset) for _ in range(n)]
+    run_both_combined(
+        scores, schedulable, p, hv, capacity, offsets, weight, max_offset
+    )

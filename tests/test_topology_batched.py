@@ -82,3 +82,94 @@ def test_batched_finished_flag():
     result = evaluate_topology_batch([small, big], req)
     assert not bool(result.finished[0])
     assert bool(result.finished[1])
+
+
+def _sim_copies_nonaware(zone_specs, seed_used, request):
+    """Sequential simulation: pack identical copies until one fails."""
+    alloc = [[float(c), float(m)] for c, m in zone_specs]
+    used = [[float(c), float(m)] for c, m in seed_used]
+    req = [float(request.milli_cpu), float(request.memory)]
+    copies = 0
+    while copies < 10_000:
+        order = sorted(
+            range(len(alloc)), key=lambda j: alloc[j][0] - used[j][0], reverse=True
+        )
+        remaining = list(req)
+        taken = [[0.0, 0.0] for _ in alloc]
+        for j in order:
+            cap = [alloc[j][0] // 1000 * 1000 - used[j][0], alloc[j][1] - used[j][1]]
+            for r in range(2):
+                a = min(remaining[r], cap[r])
+                remaining[r] -= a
+                taken[j][r] += a
+            if all(v <= 0 for v in remaining):
+                break
+        if any(v > 0 for v in remaining):
+            return copies
+        for j in range(len(alloc)):
+            for r in range(2):
+                used[j][r] += taken[j][r]
+        copies += 1
+    return copies
+
+
+def _sim_copies_aware_cpu(zone_specs, seed_used, request):
+    """Aware, CPU-bound request: each copy consumes from the max-free zone."""
+    free = [float(c) - float(u[0]) for (c, _), u in zip(zone_specs, seed_used)]
+    req = float(request.milli_cpu)
+    copies = 0
+    while copies < 10_000:
+        j = max(range(len(free)), key=lambda k: free[k])
+        if free[j] < req:
+            return copies
+        free[j] -= req
+        copies += 1
+    return copies
+
+
+def test_copies_capacity_nonaware_matches_simulation():
+    from crane_scheduler_tpu.topology.batched import copies_capacity
+
+    rng = random.Random(5)
+    GiB = 1024**3
+    for trial in range(20):
+        zone_specs, seed_used, wrappers = [], [], []
+        n_zones = rng.randint(1, 4)
+        specs = [
+            (rng.choice([4000, 8000, 15500]), rng.randint(2, 64) * GiB)
+            for _ in range(n_zones)
+        ]
+        used = [
+            (rng.randint(0, c // 2), rng.randint(0, m // 2)) for c, m in specs
+        ]
+        wrappers.append(make_wrapper(specs, used))
+        req = Resource()
+        req.milli_cpu = rng.choice([500, 1000, 1700])
+        req.memory = rng.randint(1, 8) * GiB
+        got = copies_capacity(wrappers, req, aware=False)
+        want = _sim_copies_nonaware(specs, used, req)
+        assert got[0] == want, f"trial {trial}: got {got[0]}, want {want}"
+
+
+def test_copies_capacity_aware_cpu_matches_simulation():
+    from crane_scheduler_tpu.topology.batched import copies_capacity
+
+    rng = random.Random(6)
+    for trial in range(20):
+        n_zones = rng.randint(1, 4)
+        specs = [(rng.choice([4000, 8000, 16000]), 64 * 1024**3) for _ in range(n_zones)]
+        used = [(rng.randint(0, c // 2), 0) for c, _ in specs]
+        wrappers = [make_wrapper(specs, used)]
+        req = Resource()
+        req.milli_cpu = rng.choice([1000, 1500, 3000])
+        got = copies_capacity(wrappers, req, aware=True)
+        want = _sim_copies_aware_cpu(specs, used, req)
+        assert got[0] == want, f"trial {trial}: got {got[0]}, want {want}"
+
+
+def test_copies_capacity_zero_request_unbounded():
+    from crane_scheduler_tpu.topology.batched import copies_capacity
+
+    wrappers = [make_wrapper([(4000, 1024**3)])]
+    got = copies_capacity(wrappers, Resource(), aware=False)
+    assert got[0] == 2**31 - 1
