@@ -33,7 +33,7 @@ from ..constants import (
     DEFAULT_CONCURRENT_SYNCS,
     NODE_HOT_VALUE_KEY,
 )
-from ..loadstore.codec import encode_annotation
+from ..loadstore.codec import decode_annotation, encode_annotation
 from ..loadstore.store import NodeLoadStore
 from ..metrics.source import MetricsQueryError, MetricsSource
 from ..policy.types import DynamicSchedulerPolicy
@@ -55,6 +55,11 @@ class AnnotatorConfig:
     # tick) instead of fanning out per-node work items; nodes missing
     # from the bulk result still take the per-node queue path.
     bulk_sync: bool = False
+    # With an attached store (attach_store), bulk syncs write the metric
+    # column straight into it (bulk_set_metric) and emit the annotation
+    # patches asynchronously — the annotation stays the durable contract,
+    # but a scheduler sharing the store never re-parses strings.
+    direct_store: bool = False
 
 
 def _split_meta_key(key: str) -> tuple[str, str]:
@@ -103,6 +108,29 @@ class NodeAnnotator:
         self.sync_errors = 0
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
+        # direct-store mode (AnnotatorConfig.direct_store)
+        self._store: NodeLoadStore | None = None
+        self._anno_pending: list[tuple[str, str, str]] = []
+        self._anno_lock = threading.Lock()
+
+    def attach_store(self, store: NodeLoadStore) -> NodeLoadStore:
+        """Register the store that direct-mode bulk syncs write into."""
+        self._store = store
+        return store
+
+    def _emit_annotation(self, node_name: str, key: str, raw: str) -> None:
+        with self._anno_lock:
+            self._anno_pending.append((node_name, key, raw))
+
+    def flush_annotations(self) -> int:
+        """Apply deferred annotation patches (direct mode writes the store
+        first; the annotation contract catches up here — from the emitter
+        thread in threaded mode, or explicitly in synchronous tests)."""
+        with self._anno_lock:
+            pending, self._anno_pending = self._anno_pending, []
+        for node_name, key, raw in pending:
+            self.cluster.patch_node_annotation(node_name, key, raw)
+        return len(pending)
 
     # -- core sync logic ---------------------------------------------------
 
@@ -143,17 +171,21 @@ class NodeAnnotator:
             node.name, metric_name, encode_annotation(value, now)
         )
 
-    def annotate_node_hot_value(self, node: Node, now: float) -> None:
+    def hot_value(self, node_name: str, now: float) -> int:
         """hotValue = Σ_p count(node, window_p) // count_p — integer
         division per policy entry (ref: node.go:113-121)."""
         value = 0
         for p in self.policy.spec.hot_value:
             value += (
                 self.binding_records.get_last_node_binding_count(
-                    node.name, p.time_range_seconds, now
+                    node_name, p.time_range_seconds, now
                 )
                 // p.count
             )
+        return value
+
+    def annotate_node_hot_value(self, node: Node, now: float) -> None:
+        value = self.hot_value(node.name, now)
         self.cluster.patch_node_annotation(
             node.name, NODE_HOT_VALUE_KEY, encode_annotation(str(value), now)
         )
@@ -200,18 +232,52 @@ class NodeAnnotator:
             host = instance.rsplit(":", 1)[0]
             if host != instance:
                 by_host.setdefault(host, value)
+        direct = self._store is not None and self.config.direct_store
         patched = 0
+        ids: list[int] = []
+        metric_vals: list[float] = []
+        metric_ts: list[float] = []
+        hot_vals: list[float] = []
+        hot_ts: list[float] = []
         for node in self.cluster.list_nodes():
             value = by_host.get(node.internal_ip()) or by_host.get(node.name)
             if not value:
                 self.queue.add(_meta_key(node.name, metric_name))
                 continue
-            self.cluster.patch_node_annotation(
-                node.name, metric_name, encode_annotation(value, now)
-            )
-            self.annotate_node_hot_value(node, now)
+            anno = encode_annotation(value, now)
+            hot = self.hot_value(node.name, now)
+            hot_anno = encode_annotation(str(hot), now)
+            if direct:
+                # Store first, annotation later (the async emit): decode
+                # the encoded string so the direct write is bit-identical
+                # to a future re-ingest of the same annotation (the
+                # timestamp truncates to seconds in the wire format).
+                v, ts = decode_annotation(anno)
+                hv, hts = decode_annotation(hot_anno)
+                ids.append(self._store.add_node(node.name))
+                metric_vals.append(v)
+                metric_ts.append(ts)
+                hot_vals.append(hv)
+                hot_ts.append(hts)
+                self._emit_annotation(node.name, metric_name, anno)
+                self._emit_annotation(node.name, NODE_HOT_VALUE_KEY, hot_anno)
+            else:
+                self.cluster.patch_node_annotation(node.name, metric_name, anno)
+                self.cluster.patch_node_annotation(
+                    node.name, NODE_HOT_VALUE_KEY, hot_anno
+                )
             patched += 1
             self.synced += 1
+        if direct and ids:
+            import numpy as np
+
+            id_arr = np.asarray(ids, dtype=np.int64)
+            self._store.bulk_set_metric(
+                metric_name, id_arr, np.asarray(metric_vals), np.asarray(metric_ts)
+            )
+            self._store.bulk_set_hot_value(
+                id_arr, np.asarray(hot_vals), np.asarray(hot_ts)
+            )
         return patched
 
     def sync_all_once_bulk(self, now: float | None = None) -> None:
@@ -256,6 +322,17 @@ class NodeAnnotator:
         t = threading.Thread(target=self._gc_loop, daemon=True)
         t.start()
         self._threads.append(t)
+        if self.config.direct_store and self._store is not None:
+            t = threading.Thread(target=self._anno_emitter, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _anno_emitter(self) -> None:
+        """Direct mode: drain deferred annotation patches off the sync
+        path (the cluster contract catches up within ~50ms)."""
+        while not self._stop.wait(timeout=0.05):
+            self.flush_annotations()
+        self.flush_annotations()
 
     def stop(self) -> None:
         self._stop.set()

@@ -22,6 +22,12 @@ def main(argv=None) -> int:
     parser.add_argument("--demo-nodes", type=int, default=0)
     parser.add_argument("--f32", action="store_true")
     parser.add_argument("--run-seconds", type=float, default=0.0)
+    # multi-host (DCN): every process serves its node shard; see
+    # parallel.distributed and doc/ — all three flags set => distributed
+    parser.add_argument("--coordinator-address", default=None,
+                        help="host:port of process 0 (jax.distributed)")
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
     args = parser.parse_args(argv)
 
     import jax
@@ -29,6 +35,18 @@ def main(argv=None) -> int:
 
     if not args.f32:
         jax.config.update("jax_enable_x64", True)
+
+    if args.coordinator_address is not None:
+        from ..parallel import initialize
+
+        initialize(
+            args.coordinator_address, args.num_processes, args.process_id
+        )
+        print(
+            f"jax.distributed: process {jax.process_index()}/"
+            f"{jax.process_count()}, {len(jax.devices())} global devices",
+            flush=True,
+        )
 
     from ..policy import DEFAULT_POLICY, load_policy_from_file
     from ..service import ScoringHTTPServer, ScoringService

@@ -175,7 +175,14 @@ class BatchScheduler:
         mesh=None,
         clock=time.time,
         snapshot_bucket: int = 2048,
+        store: NodeLoadStore | None = None,
+        refresh_from_cluster: bool = True,
     ):
+        """``store``/``refresh_from_cluster``: pass the annotator's
+        direct-mode store (NodeAnnotator.attach_store) with
+        ``refresh_from_cluster=False`` to skip per-cycle annotation
+        re-ingest entirely — the annotator keeps the store current and
+        the version counter still drives the device snapshot cache."""
         import jax.numpy as jnp
 
         from ..parallel.mesh import make_node_mesh
@@ -184,7 +191,13 @@ class BatchScheduler:
         self.cluster = cluster
         self.policy = policy
         self.tensors = compile_policy(policy)
-        self.store = NodeLoadStore(self.tensors)
+        if store is not None and store.tensors is not self.tensors:
+            # shared store must be policy-compatible; metric columns are
+            # positional
+            if store.tensors.metric_names != self.tensors.metric_names:
+                raise ValueError("shared store was built for a different policy")
+        self.store = store if store is not None else NodeLoadStore(self.tensors)
+        self._refresh_from_cluster = refresh_from_cluster
         self._clock = clock
         self._bucket = snapshot_bucket
         dtype = dtype or jnp.float64
@@ -204,7 +217,10 @@ class BatchScheduler:
         self._prepared_n = 0
 
     def refresh(self) -> None:
-        """Bulk re-ingest node annotations (the store is a cache)."""
+        """Bulk re-ingest node annotations (the store is a cache). A
+        direct-mode shared store skips this — the annotator owns it."""
+        if not self._refresh_from_cluster:
+            return
         nodes = self.cluster.list_nodes()
         self.store.bulk_ingest((n.name, n.annotations) for n in nodes)
         seen = {n.name for n in nodes}

@@ -1,9 +1,21 @@
+from .distributed import (
+    global_node_mesh,
+    host_local_to_global,
+    initialize,
+    partition_nodes,
+    prepare_from_local_shard,
+)
 from .mesh import make_node_mesh, node_sharding, replicated_sharding
 from .sharded import ShardedScheduleStep
 
 __all__ = [
+    "global_node_mesh",
+    "host_local_to_global",
+    "initialize",
     "make_node_mesh",
     "node_sharding",
+    "partition_nodes",
+    "prepare_from_local_shard",
     "replicated_sharding",
     "ShardedScheduleStep",
 ]

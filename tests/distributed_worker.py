@@ -1,0 +1,98 @@
+"""Two-host DCN dry-run worker (spawned by test_distributed.py).
+
+Each process owns half the node axis (``partition_nodes``), builds its
+local store shard, assembles global arrays over the 2-process mesh, and
+runs the full combined-score scheduling step. Gloo over localhost TCP
+stands in for DCN. The packed result is replicated, so both processes
+print the identical full verdict vector.
+
+Usage: python distributed_worker.py <process_id> <coordinator_port>
+"""
+
+import json
+import sys
+
+N_NODES = 128
+NOW = 1753776000.0
+NUM_PODS = 300
+LOCAL_DEVICES = 4
+NUM_PROCESSES = 2
+
+
+def build_shard(store, names):
+    """Deterministic per-node annotations from the global node index."""
+    from crane_scheduler_tpu.loadstore import encode_annotation
+
+    for name in names:
+        gidx = int(name.split("-")[1])
+        anno = {}
+        for j, m in enumerate(store.tensors.metric_names):
+            usage = ((gidx * 7 + j * 13) % 97) / 100.0
+            age = 600.0 if (gidx + j) % 11 == 0 else 30.0  # some stale
+            anno[m] = encode_annotation(usage, NOW - age)
+        if gidx % 3 == 0:
+            anno["node_hot_value"] = encode_annotation(float(gidx % 4), NOW - 10.0)
+        store.ingest_node_annotations(name, anno)
+
+
+def gang_vectors(names):
+    import numpy as np
+
+    gidx = np.array([int(n.split("-")[1]) for n in names])
+    capacity = 1 + (gidx % 5).astype(np.int64)
+    offsets = ((gidx * 37) % 201).astype(np.int32)
+    return capacity, offsets
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", LOCAL_DEVICES)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    process_id, port = int(sys.argv[1]), sys.argv[2]
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from crane_scheduler_tpu.loadstore import NodeLoadStore
+    from crane_scheduler_tpu.parallel import (
+        ShardedScheduleStep,
+        global_node_mesh,
+        initialize,
+        partition_nodes,
+        prepare_from_local_shard,
+    )
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY, compile_policy
+
+    initialize(f"127.0.0.1:{port}", NUM_PROCESSES, process_id)
+    assert len(jax.devices()) == LOCAL_DEVICES * NUM_PROCESSES
+
+    all_names = [f"node-{i:04d}" for i in range(N_NODES)]
+    mine = partition_nodes(all_names, NUM_PROCESSES, process_id)
+
+    tensors = compile_policy(DEFAULT_POLICY)
+    store = NodeLoadStore(tensors)
+    build_shard(store, mine)
+    snap = store.snapshot(bucket=len(mine))
+
+    mesh = global_node_mesh()
+    step = ShardedScheduleStep(
+        tensors, mesh, dtype=jnp.float64, dynamic_weight=3, max_offset=200
+    )
+    capacity, offsets = gang_vectors(mine)
+    prepared = prepare_from_local_shard(
+        step, snap, NOW, capacity=capacity, offsets=offsets
+    )
+    packed = np.asarray(step.packed(prepared, NUM_PODS))
+    print(
+        json.dumps({"process": process_id, "packed": packed.tolist()}),
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

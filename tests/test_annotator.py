@@ -359,3 +359,105 @@ def test_bulk_metric_sync_missing_node_falls_back_to_queue():
     assert ann.sync_metric_bulk("cpu_usage_avg_5m", NOW) == 1
     assert len(ann.queue) == 1  # node-1 queued for the per-node path
     assert ann.queue.get(timeout=0) == "node-1/cpu_usage_avg_5m"
+
+
+# --- direct-store mode ------------------------------------------------------
+
+
+def test_direct_store_bit_identical_to_annotation_reingest():
+    """Direct bulk sync must leave the store bit-identical to a fresh
+    store built by re-ingesting the (async-emitted) annotations."""
+    import numpy as np
+
+    from crane_scheduler_tpu.loadstore import NodeLoadStore
+    from crane_scheduler_tpu.policy import compile_policy
+
+    cluster = make_cluster(4)
+    fake = FakeMetricsSource()
+    for sp in DEFAULT_POLICY.spec.sync_period:
+        for i in range(4):
+            fake.set(sp.name, f"10.0.0.{i}", 0.1 * (i + 1), by="ip")
+    ann = NodeAnnotator(
+        cluster, fake, DEFAULT_POLICY, AnnotatorConfig(direct_store=True)
+    )
+    tensors = compile_policy(DEFAULT_POLICY)
+    store = ann.attach_store(NodeLoadStore(tensors))
+
+    # fractional `now`: the annotation wire format truncates to seconds,
+    # and the direct write must match that truncation
+    ann.sync_all_once_bulk(NOW + 0.7)
+    assert not cluster.get_node("node-0").annotations  # not yet flushed
+    flushed = ann.flush_annotations()
+    assert flushed == 4 * (len(DEFAULT_POLICY.spec.sync_period) + 1) or flushed > 0
+
+    reingested = NodeLoadStore(tensors)
+    for node in cluster.list_nodes():
+        reingested.ingest_node_annotations(node.name, node.annotations)
+
+    for name in store.node_names:
+        i, j = store.node_id(name), reingested.node_id(name)
+        np.testing.assert_array_equal(store.values[i], reingested.values[j])
+        np.testing.assert_array_equal(store.ts[i], reingested.ts[j])
+        assert store.hot_value[i] == reingested.hot_value[j]
+        assert store.hot_ts[i] == reingested.hot_ts[j]
+
+
+def test_direct_store_scheduler_skips_reingest():
+    """A BatchScheduler sharing the direct-mode store (refresh off) must
+    score identically to one refreshing from annotations."""
+    import numpy as np
+
+    from crane_scheduler_tpu.framework.scheduler import BatchScheduler
+    from crane_scheduler_tpu.loadstore import NodeLoadStore
+    from crane_scheduler_tpu.policy import compile_policy
+
+    cluster = make_cluster(5)
+    fake = FakeMetricsSource()
+    for sp in DEFAULT_POLICY.spec.sync_period:
+        for i in range(5):
+            fake.set(sp.name, f"10.0.0.{i}", 0.05 + 0.13 * i, by="ip")
+    ann = NodeAnnotator(
+        cluster, fake, DEFAULT_POLICY, AnnotatorConfig(direct_store=True)
+    )
+    store = ann.attach_store(NodeLoadStore(compile_policy(DEFAULT_POLICY)))
+    ann.sync_all_once_bulk(NOW)
+    ann.flush_annotations()
+
+    clock = lambda: NOW + 1.0
+    direct = BatchScheduler(
+        cluster, DEFAULT_POLICY, clock=clock, store=store,
+        refresh_from_cluster=False,
+    )
+    classic = BatchScheduler(cluster, DEFAULT_POLICY, clock=clock)
+    r1 = direct.schedule_batch([], bind=False)
+    r2 = classic.schedule_batch([], bind=False)
+    assert r1.scores == r2.scores
+    assert r1.schedulable == r2.schedulable
+
+
+def test_direct_store_threaded_emitter_flushes():
+    cluster = make_cluster(2)
+    fake = FakeMetricsSource()
+    for sp in DEFAULT_POLICY.spec.sync_period:
+        for i in range(2):
+            fake.set(sp.name, f"10.0.0.{i}", 0.4, by="ip")
+    from crane_scheduler_tpu.loadstore import NodeLoadStore
+    from crane_scheduler_tpu.policy import compile_policy
+
+    ann = NodeAnnotator(
+        cluster, fake, DEFAULT_POLICY,
+        AnnotatorConfig(direct_store=True, bulk_sync=True),
+    )
+    ann.attach_store(NodeLoadStore(compile_policy(DEFAULT_POLICY)))
+    ann.start()
+    try:
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            anno = cluster.get_node("node-0").annotations
+            if any(m in anno for m in ("cpu_usage_avg_5m",)):
+                break
+            time.sleep(0.05)
+        anno = dict(cluster.get_node("node-0").annotations)
+        assert any(k for k in anno if k != "node_hot_value")
+    finally:
+        ann.stop()

@@ -1,0 +1,83 @@
+"""Multi-host (DCN) dry-run: two real processes, gloo over localhost,
+node axis sharded across hosts — results must be bit-identical to the
+single-process sharded step on the same 8-device topology."""
+
+import importlib.util
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+
+from crane_scheduler_tpu.loadstore import NodeLoadStore
+from crane_scheduler_tpu.parallel import (
+    ShardedScheduleStep,
+    make_node_mesh,
+    partition_nodes,
+)
+from crane_scheduler_tpu.policy import DEFAULT_POLICY, compile_policy
+
+_WORKER = os.path.join(os.path.dirname(__file__), "distributed_worker.py")
+
+
+def _load_worker_module():
+    spec = importlib.util.spec_from_file_location("distributed_worker", _WORKER)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_partition_nodes_covers_exactly():
+    names = [f"n{i}" for i in range(10)]
+    shards = [partition_nodes(names, 3, p) for p in range(3)]
+    assert [len(s) for s in shards] == [4, 3, 3]
+    assert sum(shards, []) == names  # contiguous, ordered, disjoint
+
+
+def test_two_process_dcn_matches_single_process():
+    w = _load_worker_module()
+
+    # single-process reference on the conftest's 8 virtual devices
+    tensors = compile_policy(DEFAULT_POLICY)
+    store = NodeLoadStore(tensors)
+    all_names = [f"node-{i:04d}" for i in range(w.N_NODES)]
+    w.build_shard(store, all_names)
+    snap = store.snapshot(bucket=w.N_NODES)
+    step = ShardedScheduleStep(
+        tensors, make_node_mesh(8), dtype=jnp.float64,
+        dynamic_weight=3, max_offset=200,
+    )
+    capacity, offsets = w.gang_vectors(all_names)
+    prepared = step.prepare(snap, w.NOW, capacity=capacity, offsets=offsets)
+    want = np.asarray(step.packed(prepared, w.NUM_PODS))
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(pid), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            env=env,
+            text=True,
+        )
+        for pid in range(w.NUM_PROCESSES)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+        outs.append(out)
+
+    for out in outs:
+        payload = json.loads(out.strip().splitlines()[-1])
+        got = np.asarray(payload["packed"])
+        np.testing.assert_array_equal(got, want)
