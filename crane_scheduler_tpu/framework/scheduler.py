@@ -177,12 +177,18 @@ class BatchScheduler:
         snapshot_bucket: int = 2048,
         store: NodeLoadStore | None = None,
         refresh_from_cluster: bool = True,
+        hybrid: bool | None = None,
     ):
         """``store``/``refresh_from_cluster``: pass the annotator's
         direct-mode store (NodeAnnotator.attach_store) with
         ``refresh_from_cluster=False`` to skip per-cycle annotation
         re-ingest entirely — the annotator keeps the store current and
-        the version counter still drives the device snapshot cache."""
+        the version counter still drives the device snapshot cache.
+
+        ``hybrid``: f64 rescue rows on top of the f32 fast path
+        (scorer.hybrid) so batch placements are bit-identical to the
+        f64/Go semantics. Default: on whenever dtype is not float64
+        (float64 is already the parity mode)."""
         import jax.numpy as jnp
 
         from ..parallel.mesh import make_node_mesh
@@ -205,14 +211,22 @@ class BatchScheduler:
             mesh = make_node_mesh(1)
         self._mesh = mesh
         self._dtype = dtype
-        self._sharded = ShardedScheduleStep(self.tensors, mesh, dtype=dtype)
+        if hybrid is None:
+            hybrid = True
+        # f64 is already the parity mode; hybrid only means something for
+        # narrower dtypes (ShardedScheduleStep applies the same rule)
+        self._hybrid = bool(hybrid) and jnp.dtype(dtype) != jnp.dtype(jnp.float64)
+        self._sharded = ShardedScheduleStep(
+            self.tensors, mesh, dtype=dtype, hybrid=self._hybrid
+        )
         self.scorer = self._sharded.scorer
         self.gang = self._sharded.gang
-        self._combined = None  # lazy: combined-score step for schedule_gang
+        self._combined = {}  # (dyn_w, topo_w) -> combined-score step
         # device-resident snapshot cache: (store version, padded N) it was
         # built from; an unchanged store re-dispatches with zero uploads
         self._prepared = None
         self._prepared_key = None
+        self._prepared_snap = None  # host snapshot behind self._prepared
         self._prepared_names: tuple[str, ...] = ()
         self._prepared_n = 0
 
@@ -228,14 +242,26 @@ class BatchScheduler:
             self.store.remove_node(name)
 
     def _prepare(self, now: float):
-        """Upload (or reuse) the device snapshot for the current store."""
+        """Upload (or reuse) the device snapshot for the current store.
+
+        In hybrid mode a cache hit still refreshes the f64 rescue vectors
+        when ``now`` moved (three [N] uploads; the load matrices stay
+        resident) — staleness-boundary risk depends on the scoring time.
+        """
         key = self.store.version
         if self._prepared is None or self._prepared_key != key:
             snap = self.store.snapshot(bucket=self._bucket)
             self._prepared = self._sharded.prepare(snap, now)
             self._prepared_key = key
+            # only hybrid override refreshes re-read the host snapshot;
+            # don't pin tens of MB per 50k nodes in non-hybrid mode
+            self._prepared_snap = snap if self._hybrid else None
             self._prepared_names = snap.node_names
             self._prepared_n = snap.n_nodes
+        elif self._hybrid:
+            self._prepared = self._sharded.with_overrides(
+                self._prepared, self._prepared_snap, now
+            )
         return self._prepared
 
     def schedule_batch(self, pods: list[Pod], bind: bool = True) -> BatchResult:
@@ -283,16 +309,24 @@ class BatchScheduler:
         from ..parallel.sharded import ShardedScheduleStep
 
         key = (dynamic_weight, topology_weight)
-        if self._combined is None or self._combined[0] != key:
+        step = self._combined.get(key)
+        if step is None:
             step = ShardedScheduleStep(
                 self.tensors,
                 self._mesh,
                 dtype=self._dtype,
                 dynamic_weight=dynamic_weight,
                 max_offset=MAX_NODE_SCORE * topology_weight,
+                hybrid=self._hybrid,
             )
-            self._combined = (key, step)
-        return self._combined[1]
+            # bounded LRU: each entry holds two jitted executables; a
+            # caller cycling many weight pairs must not grow this forever
+            while len(self._combined) >= 8:
+                self._combined.pop(next(iter(self._combined)))
+        else:
+            self._combined.pop(key)  # refresh recency
+        self._combined[key] = step
+        return step
 
     def _numa_vectors(self, template, topology, topology_weight: int, names, n):
         """Per-node combined-score offsets (+ copy capacity) for a burst

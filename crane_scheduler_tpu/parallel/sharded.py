@@ -53,6 +53,13 @@ class PreparedSnapshot:
     capacity: Any  # [N] int64
     offsets: Any = None  # [N] int32 combined-score offsets (see scorer.topk)
     epoch: float = 0.0  # host-side rebase origin (0 in float64 mode)
+    # hybrid-mode f64 rescue vectors (None when the step is not hybrid):
+    # rows whose f32 verdict could diverge from Go/f64 semantics carry
+    # their exact f64 verdicts, substituted on device (scorer.hybrid).
+    ovr_mask: Any = None  # [N] bool
+    ovr_sched: Any = None  # [N] bool
+    ovr_score: Any = None  # [N] int32
+    ovr_now: float | None = None  # wall-clock the overrides were computed at
 
 
 @dataclass
@@ -74,8 +81,15 @@ class ShardedScheduleStep:
         dtype=jnp.float32,
         dynamic_weight: int = 1,
         max_offset: int = 0,
+        hybrid: bool = False,
     ):
+        """``hybrid=True`` (f32 dtype only): every prepared snapshot
+        carries host-computed f64 rescue rows (scorer.hybrid) that the
+        device step substitutes, giving bit-for-bit Go/f64 placement
+        parity at f32 throughput."""
         self.mesh = mesh
+        self.tensors = tensors
+        self.hybrid = bool(hybrid) and jnp.dtype(dtype) != jnp.dtype(jnp.float64)
         self.scorer = BatchedScorer(tensors, dtype=dtype)
         self.gang = GangScheduler(
             tensors.hv_count, dynamic_weight=dynamic_weight, max_offset=max_offset
@@ -84,9 +98,12 @@ class ShardedScheduleStep:
         vec = node_sharding(mesh, 1)
         rep = replicated_sharding(mesh)
         self._row, self._vec, self._rep = row, vec, rep
+        in_vecs = (row, row, vec, vec, vec, rep, vec, vec)
+        if self.hybrid:
+            in_vecs = in_vecs + (vec, vec, vec)
         self._jit = jax.jit(
             self._step,
-            in_shardings=((row, row, vec, vec, vec, rep, vec, vec), rep),
+            in_shardings=(in_vecs, rep),
             out_shardings=(vec, vec, vec, rep, rep),
         )
         # Packed variant: one int32 output so the host needs exactly one
@@ -94,15 +111,24 @@ class ShardedScheduleStep:
         # runtime round-trip; five of them dominated the batch path).
         self._jit_packed = jax.jit(
             self._step_packed,
-            in_shardings=((row, row, vec, vec, vec, rep, vec, vec), rep),
+            in_shardings=(in_vecs, rep),
             out_shardings=rep,
         )
 
     def _step(self, prepared, num_pods):
-        values, ts, hot_value, hot_ts, node_valid, now, capacity, offsets = prepared
+        if self.hybrid:
+            (values, ts, hot_value, hot_ts, node_valid, now, capacity, offsets,
+             ovr_mask, ovr_sched, ovr_score) = prepared
+        else:
+            values, ts, hot_value, hot_ts, node_valid, now, capacity, offsets = (
+                prepared
+            )
         schedulable, scores = self.scorer._score_impl(
             values, ts, hot_value, hot_ts, node_valid, now
         )
+        if self.hybrid:
+            schedulable = jnp.where(ovr_mask, ovr_sched & node_valid, schedulable)
+            scores = jnp.where(ovr_mask & node_valid, ovr_score, scores)
         counts, unassigned, waterline = self.gang._assign_impl(
             scores, schedulable, num_pods, capacity, offsets
         )
@@ -147,6 +173,9 @@ class ShardedScheduleStep:
             capacity = np.full((n,), 1 << 30, dtype=np.int64)
         if offsets is None:
             offsets = np.zeros((n,), dtype=np.int32)
+        ovr = {}
+        if self.hybrid:
+            ovr = self._override_vectors(snapshot, float(now))
         return PreparedSnapshot(
             values=jax.device_put(jnp.asarray(snapshot.values, dtype), self._row),
             ts=jax.device_put(jnp.asarray(ts, dtype), self._row),
@@ -159,6 +188,65 @@ class ShardedScheduleStep:
             capacity=jax.device_put(jnp.asarray(capacity), self._vec),
             offsets=jax.device_put(jnp.asarray(offsets, jnp.int32), self._vec),
             epoch=epoch,
+            **ovr,
+        )
+
+    def _override_vectors(self, snapshot, now: float, rebase_age: float = 0.0) -> dict:
+        """Device-put the hybrid f64 rescue vectors for (snapshot, now)."""
+        from ..scorer.hybrid import compute_overrides
+
+        ovr_mask, ovr_sched, ovr_score, _ = compute_overrides(
+            self.tensors,
+            snapshot.values,
+            snapshot.ts,
+            snapshot.hot_value,
+            snapshot.hot_ts,
+            snapshot.node_valid,
+            now,
+            rebase_age=rebase_age,
+        )
+        return {
+            "ovr_mask": jax.device_put(jnp.asarray(ovr_mask), self._vec),
+            "ovr_sched": jax.device_put(jnp.asarray(ovr_sched), self._vec),
+            "ovr_score": jax.device_put(jnp.asarray(ovr_score, jnp.int32), self._vec),
+            "ovr_now": now,
+        }
+
+    def with_overrides(
+        self, prepared: PreparedSnapshot, snapshot, now: float
+    ) -> PreparedSnapshot:
+        """Refresh the hybrid rescue vectors for a new wall time against
+        the same (cached) snapshot — only three [N] vectors re-upload; the
+        resident load matrices are reused. No-op for non-hybrid steps or
+        when the overrides are already current for ``now``.
+
+        The f32 rounding of the rebased timestamps grows with
+        ``now - epoch`` (the cached snapshot's age); the risk scan widens
+        its tolerance to match, and past ~6h the whole snapshot is
+        re-prepared with a fresh epoch to keep the rescue fraction small.
+        """
+        import dataclasses
+
+        if not self.hybrid or prepared.ovr_now == float(now):
+            return prepared
+        age = abs(float(now) - prepared.epoch)
+        if age > 6 * 3600.0 and self.scorer.dtype != jnp.dtype(jnp.float64):
+            # re-rebase the resident matrices around the current time
+            # (capacity/offsets are age-independent; carry them over)
+            dtype = self.scorer.dtype
+            ts = np.asarray(snapshot.ts, np.float64) - float(now)
+            hot_ts = np.asarray(snapshot.hot_ts, np.float64) - float(now)
+            return dataclasses.replace(
+                prepared,
+                ts=jax.device_put(jnp.asarray(ts, dtype), self._row),
+                hot_ts=jax.device_put(jnp.asarray(hot_ts, dtype), self._vec),
+                now=jnp.asarray(0.0, dtype),
+                epoch=float(now),
+                **self._override_vectors(snapshot, float(now), rebase_age=0.0),
+            )
+        return dataclasses.replace(
+            prepared,
+            **self._override_vectors(snapshot, float(now), rebase_age=age),
         )
 
     def with_vectors(
@@ -185,19 +273,29 @@ class ShardedScheduleStep:
             if now is None
             else jnp.asarray(float(now) - prepared.epoch, self.scorer.dtype)
         )
-        return (
-            (
-                prepared.values,
-                prepared.ts,
-                prepared.hot_value,
-                prepared.hot_ts,
-                prepared.node_valid,
-                now_arr,
-                prepared.capacity,
-                prepared.offsets,
-            ),
-            jnp.asarray(num_pods),
+        vecs = (
+            prepared.values,
+            prepared.ts,
+            prepared.hot_value,
+            prepared.hot_ts,
+            prepared.node_valid,
+            now_arr,
+            prepared.capacity,
+            prepared.offsets,
         )
+        if self.hybrid:
+            if prepared.ovr_mask is None:
+                raise ValueError(
+                    "hybrid step requires a snapshot prepared with overrides "
+                    "(use prepare()/with_overrides of a hybrid step)"
+                )
+            if now is not None and prepared.ovr_now != float(now):
+                raise ValueError(
+                    "hybrid overrides are stale for this `now`; call "
+                    "with_overrides(prepared, snapshot, now) first"
+                )
+            vecs = vecs + (prepared.ovr_mask, prepared.ovr_sched, prepared.ovr_score)
+        return vecs, jnp.asarray(num_pods)
 
     def __call__(
         self, prepared: PreparedSnapshot, num_pods, now: float | None = None

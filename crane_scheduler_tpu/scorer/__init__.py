@@ -9,8 +9,16 @@ from .oracle import (
     score_node,
 )
 from .batched import BatchedScorer, ScoreResult
+from .hybrid import HybridScorer, compute_overrides, score_rows_f64
+from .topk import GangScheduler, gang_assign_host, gang_assign_oracle
 
 __all__ = [
+    "HybridScorer",
+    "compute_overrides",
+    "score_rows_f64",
+    "GangScheduler",
+    "gang_assign_host",
+    "gang_assign_oracle",
     "UsageError",
     "get_resource_usage",
     "get_active_duration",

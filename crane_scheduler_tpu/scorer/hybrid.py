@@ -115,6 +115,142 @@ class HybridResult:
     rescored: int  # rows that took the f64 path
 
 
+def risk_mask_f64(
+    tensors: PolicyTensors, values, ts, hot_value, hot_ts, now,
+    rebase_age: float = 0.0,
+) -> np.ndarray:
+    """Host-side exact risk detection (vectorized numpy float64).
+
+    A node is risky when an f32 evaluation *could* flip a decision:
+    the exact f64 quantity sits within the f32 rounding band of a
+    boundary. Exactly-on-boundary counts as risky too (an f32
+    accumulation can land microscopically on the other side), but a
+    hot value that is a clean integer or a usage far from its
+    threshold is provably safe — which is what keeps the rescore
+    fraction tiny on real annotator data.
+
+    ``rebase_age``: |now - epoch| of the device arrays when timestamps
+    were rebased at an *earlier* prepare time (parallel.sharded keeps a
+    cached snapshot resident and re-scores it at later wall times). The
+    device's f32 freshness test then computes fl32(ts-epoch) and
+    fl32(now-epoch), whose rounding grows with the age — widen the
+    staleness tolerance accordingly or boundary flips go unflagged.
+    """
+    t = tensors
+    n = values.shape[0]
+    risk = np.zeros((n,), dtype=bool)
+    # eps32 ~ 1.2e-7 per rounding; ts-epoch and now-epoch each carry one.
+    # 1e-6 per second of age gives ~4x margin over the two roundings.
+    age_tol = 1e-6 * 2.0 * abs(float(rebase_age))
+
+    def sign_flip(u):
+        # The f32 downcast can flush a tiny negative (e.g. -1e-310) to
+        # -0.0, flipping the `u < 0` validity test between the f64 and
+        # f32 paths — whole w*100 contributions appear/vanish, far from
+        # any truncation boundary. Flag any row where the sign test
+        # itself disagrees across precisions.
+        return (u < 0) != (u.astype(np.float32) < 0)
+
+    def stale_tol(tstamp, active):
+        # The f32 freshness error scales with the operand magnitudes
+        # (fl32(ts-now) + fl32(active) carries ~eps32*(|ts-now|+active)
+        # of rounding), so an absolute tolerance under-flags long
+        # windows (>~2h). eps32 ~ 1.2e-7; 1e-6 gives ~4x margin over
+        # the two roundings involved. A missing timestamp (-inf) is
+        # exactly stale in both precisions — no risk, tol 0 (a naive
+        # formula would yield tol=inf and flag every sparse node,
+        # forcing the whole cluster onto the slow f64 path).
+        with np.errstate(invalid="ignore"):
+            tol = 1e-3 + 1e-6 * (np.abs(tstamp - now) + np.abs(active)) + age_tol
+            return np.where(np.isfinite(tstamp), tol, 0.0)
+
+    with np.errstate(invalid="ignore"):
+        if len(t.pred_idx):
+            u = values[:, t.pred_idx]
+            expiry = ts[:, t.pred_idx] + t.pred_active
+            fresh = now < expiry
+            near = np.abs(u - t.pred_threshold) <= _CMP_TOL
+            risk |= np.any(fresh & near & (t.pred_active > 0), axis=1)
+            risk |= np.any(sign_flip(u) & fresh & (t.pred_active > 0), axis=1)
+            tol = stale_tol(ts[:, t.pred_idx], t.pred_active)
+            risk |= np.any(
+                (np.abs(expiry - now) <= tol) & (t.pred_active > 0), axis=1
+            )
+        if len(t.prio_idx) and t.weight_sum != 0.0:
+            u = values[:, t.prio_idx]
+            expiry = ts[:, t.prio_idx] + t.prio_active
+            fresh = now < expiry
+            valid = fresh & ~(u < 0) & (t.prio_active > 0)
+            risk |= np.any(sign_flip(u) & fresh & (t.prio_active > 0), axis=1)
+            tol = stale_tol(ts[:, t.prio_idx], t.prio_active)
+            risk |= np.any(
+                (np.abs(expiry - now) <= tol) & (t.prio_active > 0), axis=1
+            )
+            contrib = (1.0 - u) * t.prio_weight * float(MAX_NODE_SCORE)
+            masked = np.where(valid, contrib, 0.0)
+            acc = masked.sum(axis=1)
+            q = acc / t.weight_sum
+            finite = np.isfinite(q)
+            dist = np.abs(q - np.round(q))
+            # f32 accumulation error is bounded by K*eps32 times the
+            # magnitude of the partial sums; 1e-5 gives ~25x margin.
+            abs_sum = np.abs(masked).sum(axis=1)
+            tol = _TRUNC_TOL * 0.1 + 1e-5 * abs_sum / abs(t.weight_sum)
+            risk |= finite & (dist <= tol)
+            risk |= ~finite  # NaN/Inf: let f64 decide the indefinite
+        hot_expiry = hot_ts + HOT_VALUE_ACTIVE_PERIOD_SECONDS
+        risk |= np.abs(hot_expiry - now) <= stale_tol(
+            hot_ts, HOT_VALUE_ACTIVE_PERIOD_SECONDS
+        )
+        hot_fresh = now < hot_expiry
+        hv = np.where(hot_fresh & ~(hot_value < 0), hot_value, 0.0)
+        hp = hv * 10.0
+        dist = np.abs(hp - np.round(hp))
+        # a clean multiple of 10 (integral hot value) converts to f32
+        # exactly and truncates identically: safe. Near-misses aren't.
+        risk |= np.isfinite(hp) & (dist > 0) & (dist <= _CMP_TOL * 10)
+        risk |= ~np.isfinite(hp)
+    return risk
+
+
+def compute_overrides(
+    tensors: PolicyTensors, values, ts, hot_value, hot_ts, node_valid, now,
+    rebase_age: float = 0.0,
+):
+    """Per-node f64 rescue vectors for the hybrid device step.
+
+    Returns ``(ovr_mask, ovr_sched, ovr_score, n_rescored)``: boolean mask
+    of rows whose f32 verdict is at risk of diverging from the Go/f64
+    semantics at this ``now``, plus their exact f64 verdicts. The device
+    step substitutes these rows, making the f32 fast path bit-identical
+    to the f64 oracle everywhere (ref: pkg/plugins/dynamic/stats.go:114-138
+    for the semantics being preserved).
+    """
+    now_f = float(now)
+    values64 = np.asarray(values, dtype=np.float64)
+    ts64 = np.asarray(ts, dtype=np.float64)
+    hot64 = np.asarray(hot_value, dtype=np.float64)
+    hot_ts64 = np.asarray(hot_ts, dtype=np.float64)
+    valid = np.asarray(node_valid, dtype=bool)
+    n = values64.shape[0]
+    risk = risk_mask_f64(
+        tensors, values64, ts64, hot64, hot_ts64, now_f, rebase_age=rebase_age
+    )
+    risky = np.nonzero(risk & valid)[0]
+    ovr_mask = np.zeros((n,), dtype=bool)
+    ovr_sched = np.zeros((n,), dtype=bool)
+    ovr_score = np.zeros((n,), dtype=np.int32)
+    if len(risky):
+        sched64, score64 = score_rows_f64(
+            values64[risky], ts64[risky], hot64[risky], hot_ts64[risky],
+            now_f, tensors,
+        )
+        ovr_mask[risky] = True
+        ovr_sched[risky] = sched64
+        ovr_score[risky] = score64
+    return ovr_mask, ovr_sched, ovr_score, len(risky)
+
+
 class HybridScorer:
     """f32 batched pass + risk mask + exact f64 host re-score."""
 
@@ -123,88 +259,7 @@ class HybridScorer:
         self._f32 = BatchedScorer(tensors, dtype=jnp.float32)
 
     def _risk_mask_f64(self, values, ts, hot_value, hot_ts, now) -> np.ndarray:
-        """Host-side exact risk detection (vectorized numpy float64).
-
-        A node is risky when an f32 evaluation *could* flip a decision:
-        the exact f64 quantity sits within the f32 rounding band of a
-        boundary. Exactly-on-boundary counts as risky too (an f32
-        accumulation can land microscopically on the other side), but a
-        hot value that is a clean integer or a usage far from its
-        threshold is provably safe — which is what keeps the rescore
-        fraction tiny on real annotator data.
-        """
-        t = self.tensors
-        n = values.shape[0]
-        risk = np.zeros((n,), dtype=bool)
-
-        def sign_flip(u):
-            # The f32 downcast can flush a tiny negative (e.g. -1e-310) to
-            # -0.0, flipping the `u < 0` validity test between the f64 and
-            # f32 paths — whole w*100 contributions appear/vanish, far from
-            # any truncation boundary. Flag any row where the sign test
-            # itself disagrees across precisions.
-            return (u < 0) != (u.astype(np.float32) < 0)
-
-        def stale_tol(tstamp, active):
-            # The f32 freshness error scales with the operand magnitudes
-            # (fl32(ts-now) + fl32(active) carries ~eps32*(|ts-now|+active)
-            # of rounding), so an absolute tolerance under-flags long
-            # windows (>~2h). eps32 ~ 1.2e-7; 1e-6 gives ~4x margin over
-            # the two roundings involved. A missing timestamp (-inf) is
-            # exactly stale in both precisions — no risk, tol 0 (a naive
-            # formula would yield tol=inf and flag every sparse node,
-            # forcing the whole cluster onto the slow f64 path).
-            with np.errstate(invalid="ignore"):
-                tol = 1e-3 + 1e-6 * (np.abs(tstamp - now) + np.abs(active))
-                return np.where(np.isfinite(tstamp), tol, 0.0)
-
-        with np.errstate(invalid="ignore"):
-            if len(t.pred_idx):
-                u = values[:, t.pred_idx]
-                expiry = ts[:, t.pred_idx] + t.pred_active
-                fresh = now < expiry
-                near = np.abs(u - t.pred_threshold) <= _CMP_TOL
-                risk |= np.any(fresh & near & (t.pred_active > 0), axis=1)
-                risk |= np.any(sign_flip(u) & fresh & (t.pred_active > 0), axis=1)
-                tol = stale_tol(ts[:, t.pred_idx], t.pred_active)
-                risk |= np.any(
-                    (np.abs(expiry - now) <= tol) & (t.pred_active > 0), axis=1
-                )
-            if len(t.prio_idx) and t.weight_sum != 0.0:
-                u = values[:, t.prio_idx]
-                expiry = ts[:, t.prio_idx] + t.prio_active
-                fresh = now < expiry
-                valid = fresh & ~(u < 0) & (t.prio_active > 0)
-                risk |= np.any(sign_flip(u) & fresh & (t.prio_active > 0), axis=1)
-                tol = stale_tol(ts[:, t.prio_idx], t.prio_active)
-                risk |= np.any(
-                    (np.abs(expiry - now) <= tol) & (t.prio_active > 0), axis=1
-                )
-                contrib = (1.0 - u) * t.prio_weight * float(MAX_NODE_SCORE)
-                masked = np.where(valid, contrib, 0.0)
-                acc = masked.sum(axis=1)
-                q = acc / t.weight_sum
-                finite = np.isfinite(q)
-                dist = np.abs(q - np.round(q))
-                # f32 accumulation error is bounded by K*eps32 times the
-                # magnitude of the partial sums; 1e-5 gives ~25x margin.
-                abs_sum = np.abs(masked).sum(axis=1)
-                tol = _TRUNC_TOL * 0.1 + 1e-5 * abs_sum / abs(t.weight_sum)
-                risk |= finite & (dist <= tol)
-                risk |= ~finite  # NaN/Inf: let f64 decide the indefinite
-            hot_expiry = hot_ts + HOT_VALUE_ACTIVE_PERIOD_SECONDS
-            risk |= np.abs(hot_expiry - now) <= stale_tol(
-                hot_ts, HOT_VALUE_ACTIVE_PERIOD_SECONDS
-            )
-            hot_fresh = now < hot_expiry
-            hv = np.where(hot_fresh & ~(hot_value < 0), hot_value, 0.0)
-            hp = hv * 10.0
-            dist = np.abs(hp - np.round(hp))
-            # a clean multiple of 10 (integral hot value) converts to f32
-            # exactly and truncates identically: safe. Near-misses aren't.
-            risk |= np.isfinite(hp) & (dist > 0) & (dist <= _CMP_TOL * 10)
-            risk |= ~np.isfinite(hp)
-        return risk
+        return risk_mask_f64(self.tensors, values, ts, hot_value, hot_ts, now)
 
     def __call__(self, values, ts, hot_value, hot_ts, node_valid, now) -> HybridResult:
         now_f = float(now)

