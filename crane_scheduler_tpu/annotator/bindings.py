@@ -17,6 +17,8 @@ import threading
 import time
 from dataclasses import dataclass
 
+import numpy as np
+
 
 @dataclass(frozen=True)
 class Binding:
@@ -61,6 +63,39 @@ class BindingRecords:
                 1
                 for _, _, b in self._heap
                 if b.timestamp > timeline and b.node == node
+            )
+
+    def counts_batch(
+        self, windows_seconds, now: float | None = None
+    ) -> tuple[list[str], np.ndarray]:
+        """(node_names, counts[window, node]) for every node present in the
+        heap, in ONE pass — vs the reference's per-(node, window) rescans
+        (ref: binding.go:81-97). Same strict ``timestamp > timeline``
+        window semantics as ``get_last_node_binding_count``."""
+        if now is None:
+            now = time.time()
+        # plain-int timelines: the inner loop runs |heap|·|windows| times,
+        # and boxed numpy scalar comparisons would dominate it
+        timelines = [int(now) - int(w) for w in windows_seconds]
+        nw = len(timelines)
+        with self._lock:
+            ids: dict[str, int] = {}
+            names: list[str] = []
+            per_window: list[list[int]] = [[] for _ in range(nw)]
+            for _, _, b in self._heap:
+                node_id = ids.get(b.node)
+                if node_id is None:
+                    node_id = len(names)
+                    ids[b.node] = node_id
+                    names.append(b.node)
+                    for col in per_window:
+                        col.append(0)
+                ts = b.timestamp
+                for i in range(nw):
+                    if ts > timelines[i]:
+                        per_window[i][node_id] += 1
+            return names, np.asarray(per_window, dtype=np.int64).reshape(
+                nw, len(names)
             )
 
     def bindings_gc(self, now: float | None = None) -> None:

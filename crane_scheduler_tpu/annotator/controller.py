@@ -33,7 +33,10 @@ from ..constants import (
     DEFAULT_CONCURRENT_SYNCS,
     NODE_HOT_VALUE_KEY,
 )
-from ..loadstore.codec import decode_annotation, encode_annotation
+from ..loadstore.codec import (
+    decode_annotation_or_missing,
+    encode_annotation,
+)
 from ..loadstore.store import NodeLoadStore
 from ..metrics.source import MetricsQueryError, MetricsSource
 from ..policy.types import DynamicSchedulerPolicy
@@ -155,9 +158,9 @@ class NodeAnnotator:
         self.synced += 1
         return True
 
-    def annotate_node_load(self, node: Node, metric_name: str, now: float) -> None:
-        """Query by IP, fall back to name, patch annotation
-        (ref: node.go:101-111)."""
+    def annotate_node_load(self, node: Node, metric_name: str, now: float) -> str:
+        """Query by IP, fall back to name, patch annotation; returns the
+        encoded annotation (ref: node.go:101-111)."""
         value = None
         try:
             value = self.metrics.query_by_node_ip(metric_name, node.internal_ip())
@@ -167,9 +170,18 @@ class NodeAnnotator:
             value = self.metrics.query_by_node_name(metric_name, node.name)
         if not value:
             raise MetricsQueryError(f"failed to get data {metric_name} for {node.name}")
-        self.cluster.patch_node_annotation(
-            node.name, metric_name, encode_annotation(value, now)
-        )
+        anno = encode_annotation(value, now)
+        self.cluster.patch_node_annotation(node.name, metric_name, anno)
+        if self._store is not None and self.config.direct_store:
+            # Direct mode pairs with a scheduler that never re-reads
+            # cluster annotations (refresh_from_cluster=False), so the
+            # queue path must land in the store too or fallback nodes'
+            # rows stay NaN forever. Targeted write of just this metric —
+            # a full re-ingest of the cluster map would wipe store values
+            # whose deferred annotation patches haven't flushed yet.
+            v, ts = decode_annotation_or_missing(anno)
+            self._store.set_metric(node.name, metric_name, v, ts)
+        return anno
 
     def hot_value(self, node_name: str, now: float) -> int:
         """hotValue = Σ_p count(node, window_p) // count_p — integer
@@ -184,11 +196,45 @@ class NodeAnnotator:
             )
         return value
 
-    def annotate_node_hot_value(self, node: Node, now: float) -> None:
-        value = self.hot_value(node.name, now)
-        self.cluster.patch_node_annotation(
-            node.name, NODE_HOT_VALUE_KEY, encode_annotation(str(value), now)
+    def hot_values_batch(self, now: float) -> dict[str, int] | None:
+        """Hot values for every node with bindings, in ONE heap pass.
+
+        Same per-entry integer division as ``hot_value`` (ref:
+        node.go:113-121), but the windowed counts come from the backend's
+        ``counts_batch`` (one O(|heap|·|windows|) sweep) instead of a
+        per-(node, window) heap rescan. Nodes absent from the result have
+        hot value 0. Returns None when the backend lacks the batch API.
+        """
+        counts_batch = getattr(self.binding_records, "counts_batch", None)
+        if counts_batch is None:
+            return None
+        policies = self.policy.spec.hot_value
+        if not policies:
+            return {}
+        for p in policies:
+            if p.count == 0:
+                # match the per-node path (and Go's integer divide panic,
+                # ref: node.go:117) instead of numpy's silent 0
+                raise ZeroDivisionError("hotValue policy count is 0")
+        import numpy as np
+
+        names, counts = counts_batch(
+            [p.time_range_seconds for p in policies], now
         )
+        if not names:
+            return {}
+        divisors = np.asarray([p.count for p in policies], dtype=np.int64)
+        hot = (counts // divisors[:, None]).sum(axis=0)
+        return dict(zip(names, (int(v) for v in hot)))
+
+    def annotate_node_hot_value(self, node: Node, now: float) -> str:
+        value = self.hot_value(node.name, now)
+        anno = encode_annotation(str(value), now)
+        self.cluster.patch_node_annotation(node.name, NODE_HOT_VALUE_KEY, anno)
+        if self._store is not None and self.config.direct_store:
+            v, ts = decode_annotation_or_missing(anno)
+            self._store.set_hot_value(node.name, v, ts)
+        return anno
 
     def enqueue_metric(self, metric_name: str) -> None:
         """One tick: fan out a work item per node
@@ -233,8 +279,9 @@ class NodeAnnotator:
             if host != instance:
                 by_host.setdefault(host, value)
         direct = self._store is not None and self.config.direct_store
+        hot_by_node = self.hot_values_batch(now)
         patched = 0
-        ids: list[int] = []
+        names: list[str] = []
         metric_vals: list[float] = []
         metric_ts: list[float] = []
         hot_vals: list[float] = []
@@ -245,16 +292,19 @@ class NodeAnnotator:
                 self.queue.add(_meta_key(node.name, metric_name))
                 continue
             anno = encode_annotation(value, now)
-            hot = self.hot_value(node.name, now)
+            if hot_by_node is not None:
+                hot = hot_by_node.get(node.name, 0)
+            else:
+                hot = self.hot_value(node.name, now)
             hot_anno = encode_annotation(str(hot), now)
             if direct:
                 # Store first, annotation later (the async emit): decode
                 # the encoded string so the direct write is bit-identical
                 # to a future re-ingest of the same annotation (the
                 # timestamp truncates to seconds in the wire format).
-                v, ts = decode_annotation(anno)
-                hv, hts = decode_annotation(hot_anno)
-                ids.append(self._store.add_node(node.name))
+                v, ts = decode_annotation_or_missing(anno)
+                hv, hts = decode_annotation_or_missing(hot_anno)
+                names.append(node.name)
                 metric_vals.append(v)
                 metric_ts.append(ts)
                 hot_vals.append(hv)
@@ -268,16 +318,24 @@ class NodeAnnotator:
                 )
             patched += 1
             self.synced += 1
-        if direct and ids:
+        if direct and names:
             import numpy as np
 
-            id_arr = np.asarray(ids, dtype=np.int64)
-            self._store.bulk_set_metric(
-                metric_name, id_arr, np.asarray(metric_vals), np.asarray(metric_ts)
+            # One lock hold resolves name->row AND writes, so a
+            # concurrent prune's swap-removes can't redirect stale ids.
+            self._store.bulk_set_by_name(
+                metric_name,
+                names,
+                np.asarray(metric_vals),
+                np.asarray(metric_ts),
+                np.asarray(hot_vals),
+                np.asarray(hot_ts),
             )
-            self._store.bulk_set_hot_value(
-                id_arr, np.asarray(hot_vals), np.asarray(hot_ts)
-            )
+        if direct:
+            # Direct mode is the only reader path for the shared store
+            # (the scheduler's refresh() returns early), so deleted
+            # cluster nodes must be pruned here or they stay schedulable.
+            self._store.prune_absent(self.cluster.node_names())
         return patched
 
     def sync_all_once_bulk(self, now: float | None = None) -> None:

@@ -1,6 +1,7 @@
 from .codec import (
     encode_annotation,
     decode_annotation,
+    decode_annotation_or_missing,
     go_parse_float,
     format_metric_value,
 )
@@ -9,6 +10,7 @@ from .store import NodeLoadStore, DeviceSnapshot
 __all__ = [
     "encode_annotation",
     "decode_annotation",
+    "decode_annotation_or_missing",
     "go_parse_float",
     "format_metric_value",
     "NodeLoadStore",

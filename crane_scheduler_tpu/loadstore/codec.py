@@ -83,3 +83,15 @@ def decode_annotation(raw: str) -> tuple[float | None, float | None]:
     value = go_parse_float(parts[0])
     ts = parse_local_time(parts[1])
     return value, ts
+
+
+def decode_annotation_or_missing(raw: str) -> tuple[float, float]:
+    """Decode with the store's fail-open sentinel: a structurally invalid
+    annotation becomes ``(nan, -inf)`` — never fresh, so every reader
+    takes the fail-open path exactly like a parse error
+    (ref: stats.go:96-99). The single source of the missing-value
+    sentinels for both the re-ingest and direct-write paths."""
+    value, ts = decode_annotation(raw)
+    if value is None or ts is None:
+        return float("nan"), float("-inf")
+    return value, ts

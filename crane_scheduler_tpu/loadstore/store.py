@@ -20,6 +20,8 @@ nodes); padded rows carry ``node_valid = False``.
 
 from __future__ import annotations
 
+import functools
+import threading
 from dataclasses import dataclass
 from typing import Iterable, Mapping
 
@@ -27,9 +29,20 @@ import numpy as np
 
 from ..constants import NODE_HOT_VALUE_KEY
 from ..policy.compile import PolicyTensors
-from .codec import decode_annotation
+from .codec import decode_annotation_or_missing
 
 _NEG_INF = float("-inf")
+
+
+def _locked(fn):
+    """Run the method under the store's reentrant mutation lock."""
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._lock:
+            return fn(self, *args, **kwargs)
+
+    return wrapper
 
 
 def _pad_bucket(n: int, bucket: int) -> int:
@@ -56,6 +69,11 @@ class NodeLoadStore:
 
     def __init__(self, tensors: PolicyTensors, initial_capacity: int = 64):
         self.tensors = tensors
+        # Guards every mutation and snapshot(): in threaded direct mode
+        # annotator workers mutate (add_node may swap-grow the arrays)
+        # while the scheduler thread snapshots. Reentrant because
+        # ingest_* call set_* internally.
+        self._lock = threading.RLock()
         m = tensors.num_metrics
         cap = max(initial_capacity, 1)
         self._cap = cap
@@ -89,6 +107,7 @@ class NodeLoadStore:
     def node_id(self, name: str) -> int:
         return self._index[name]
 
+    @_locked
     def add_node(self, name: str) -> int:
         if name in self._index:
             return self._index[name]
@@ -105,6 +124,7 @@ class NodeLoadStore:
         self._version += 1
         return i
 
+    @_locked
     def remove_node(self, name: str) -> None:
         """Swap-remove; row order is not part of the contract."""
         i = self._index.pop(name, None)
@@ -140,6 +160,7 @@ class NodeLoadStore:
 
     # -- writes ------------------------------------------------------------
 
+    @_locked
     def set_metric(self, node: str, metric: str, value: float, ts: float) -> None:
         i = self._index.get(node)
         if i is None:
@@ -152,6 +173,7 @@ class NodeLoadStore:
         self.ts[i, col] = ts
         self._version += 1
 
+    @_locked
     def set_hot_value(self, node: str, value: float, ts: float) -> None:
         i = self._index.get(node)
         if i is None:
@@ -161,17 +183,16 @@ class NodeLoadStore:
         self.hot_ts[i] = ts
         self._version += 1
 
+    @_locked
     def ingest_annotation(self, node: str, key: str, raw: str) -> None:
         """Decode one ``"value,timestamp"`` annotation into the store."""
-        value, ts = decode_annotation(raw)
-        if ts is None or value is None:
-            # Structurally invalid == missing: readers fail open.
-            value, ts = np.nan, _NEG_INF
+        value, ts = decode_annotation_or_missing(raw)
         if key == NODE_HOT_VALUE_KEY:
             self.set_hot_value(node, value, ts)
         else:
             self.set_metric(node, key, value, ts)
 
+    @_locked
     def ingest_node_annotations(self, node: str, anno: Mapping[str, str] | None) -> None:
         """Bulk-ingest a node's full annotation map (the parity read path).
 
@@ -191,6 +212,7 @@ class NodeLoadStore:
             if key == NODE_HOT_VALUE_KEY or key in self.tensors.metric_index:
                 self.ingest_annotation(node, key, raw)
 
+    @_locked
     def bulk_set_metric(
         self,
         metric: str,
@@ -207,6 +229,7 @@ class NodeLoadStore:
         self.ts[ids, col] = ts
         self._version += 1
 
+    @_locked
     def bulk_set_hot_value(
         self,
         node_ids: np.ndarray | Iterable[int],
@@ -218,6 +241,41 @@ class NodeLoadStore:
         self.hot_ts[ids] = ts
         self._version += 1
 
+    @_locked
+    def bulk_set_by_name(
+        self,
+        metric: str,
+        names: list[str],
+        values: np.ndarray,
+        ts: float | np.ndarray,
+        hot_values: np.ndarray | None = None,
+        hot_ts: float | np.ndarray | None = None,
+    ) -> None:
+        """Atomic by-name column write: name->row resolution (adding
+        missing nodes) and the metric/hot writes happen under one lock
+        hold, so a concurrent ``prune_absent`` (which swap-removes rows)
+        can never redirect a pre-resolved id to another node's row."""
+        ids = np.asarray([self.add_node(n) for n in names], dtype=np.int64)
+        col = self.tensors.metric_index.get(metric)
+        if col is not None and len(ids):
+            self.values[ids, col] = values
+            self.ts[ids, col] = ts
+            self._version += 1
+        if hot_values is not None and len(ids):
+            self.hot_value[ids] = hot_values
+            self.hot_ts[ids] = hot_ts
+            self._version += 1
+
+    @_locked
+    def prune_absent(self, live_names) -> int:
+        """Remove rows for nodes not in ``live_names``; returns count."""
+        live = set(live_names)
+        stale = [n for n in self._names if n not in live]
+        for name in stale:
+            self.remove_node(name)
+        return len(stale)
+
+    @_locked
     def bulk_ingest(self, items, skip_unchanged: bool = True) -> None:
         """Ingest many (node_name, annotation_map) pairs with one native
         parse call (falls back to the Python codec transparently).
@@ -271,6 +329,7 @@ class NodeLoadStore:
 
     # -- snapshot ----------------------------------------------------------
 
+    @_locked
     def snapshot(self, bucket: int = 2048) -> DeviceSnapshot:
         n = self._n
         npad = _pad_bucket(n, bucket)

@@ -230,7 +230,7 @@ class ShardedScheduleStep:
         if not self.hybrid or prepared.ovr_now == float(now):
             return prepared
         age = abs(float(now) - prepared.epoch)
-        if age > 6 * 3600.0 and self.scorer.dtype != jnp.dtype(jnp.float64):
+        if age > 6 * 3600.0:  # hybrid is always non-f64 (see __init__)
             # re-rebase the resident matrices around the current time
             # (capacity/offsets are age-independent; carry them over)
             dtype = self.scorer.dtype

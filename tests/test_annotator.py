@@ -461,3 +461,172 @@ def test_direct_store_threaded_emitter_flushes():
         assert any(k for k in anno if k != "node_hot_value")
     finally:
         ann.stop()
+
+
+# --- batch hot values (one heap pass) ---------------------------------------
+
+
+def _records_backends(size=1024, gc_range=300.0):
+    backends = [BindingRecords(size, gc_range)]
+    try:
+        from crane_scheduler_tpu.native.bindings import NativeBindingRecords
+
+        backends.append(NativeBindingRecords(size, gc_range))
+    except Exception:
+        pass
+    return backends
+
+
+def test_counts_batch_matches_per_node():
+    """counts_batch (one heap pass) must equal the reference-shaped
+    per-(node, window) rescan for both heap backends."""
+    import random
+
+    rng = random.Random(7)
+    windows = [60.0, 300.0, 900.0]
+    for records in _records_backends():
+        nodes = [f"n{i}" for i in range(17)]
+        for k in range(400):
+            records.add_binding(
+                Binding(
+                    rng.choice(nodes), "ns", f"p{k}",
+                    int(NOW) - rng.randint(0, 1200),
+                )
+            )
+        names, counts = records.counts_batch(windows, NOW)
+        assert counts.shape == (len(windows), len(names))
+        for j, name in enumerate(names):
+            for i, w in enumerate(windows):
+                assert counts[i, j] == records.get_last_node_binding_count(
+                    name, w, NOW
+                ), (type(records).__name__, name, w)
+        # nodes never bound simply don't appear
+        assert set(names) <= set(nodes)
+
+
+def test_hot_values_batch_matches_per_node_hot_value():
+    cluster = make_cluster(6)
+    fake = FakeMetricsSource()
+    ann = NodeAnnotator(cluster, fake, DEFAULT_POLICY)
+    for i in range(6):
+        for k in range(i * 3):  # node-i gets 3i bindings in-window
+            ann.binding_records.add_binding(
+                Binding(f"node-{i}", "ns", f"p{i}-{k}", int(NOW) - 10)
+            )
+    batch = ann.hot_values_batch(NOW)
+    assert batch is not None
+    for i in range(6):
+        assert batch.get(f"node-{i}", 0) == ann.hot_value(f"node-{i}", NOW)
+
+
+def test_bulk_sync_hot_values_use_batch_path():
+    """sync_metric_bulk's hot-value annotations must be identical with the
+    batch heap sweep to what the per-node formula produces."""
+    cluster = make_cluster(3)
+    fake = FakeMetricsSource()
+    for i in range(3):
+        fake.set("cpu_usage_avg_5m", f"10.0.0.{i}", 0.2, by="ip")
+    ann = NodeAnnotator(cluster, fake, DEFAULT_POLICY)
+    for k in range(7):
+        ann.binding_records.add_binding(Binding("node-1", "ns", f"p{k}", int(NOW) - 5))
+    assert ann.sync_metric_bulk("cpu_usage_avg_5m", NOW) == 3
+    # default policy: 7//5 + 7//2 = 4 on node-1, 0 elsewhere
+    assert cluster.get_node("node-1").annotations["node_hot_value"].startswith("4,")
+    assert cluster.get_node("node-0").annotations["node_hot_value"].startswith("0,")
+
+
+# --- direct-store mode: advisor regressions ---------------------------------
+
+
+def _direct_annotator(n=2, bulk_metric_nodes=None):
+    from crane_scheduler_tpu.loadstore import NodeLoadStore
+    from crane_scheduler_tpu.policy import compile_policy
+
+    cluster = make_cluster(n)
+    fake = FakeMetricsSource()
+    ann = NodeAnnotator(
+        cluster, fake, DEFAULT_POLICY, AnnotatorConfig(direct_store=True)
+    )
+    store = ann.attach_store(NodeLoadStore(compile_policy(DEFAULT_POLICY)))
+    return cluster, fake, ann, store
+
+
+def test_direct_store_queue_fallback_reaches_store():
+    """A node missing from the bulk result takes the per-node queue path;
+    in direct mode that path must still land in the attached store
+    (advisor finding: rows stayed NaN forever)."""
+    import numpy as np
+
+    cluster, fake, ann, store = _direct_annotator(2)
+    fake.set("cpu_usage_avg_5m", "10.0.0.0", 0.3, by="ip")
+    fake.set("cpu_usage_avg_5m", "node-1", 0.7, by="name")  # invisible to bulk
+    assert ann.sync_metric_bulk("cpu_usage_avg_5m", NOW) == 1
+    item = ann.queue.get(timeout=0)
+    assert item == "node-1/cpu_usage_avg_5m"
+    assert ann.sync_node(item, NOW)
+    col = store.tensors.metric_index["cpu_usage_avg_5m"]
+    row = store.node_id("node-1")
+    assert store.values[row, col] == 0.7
+    assert np.isfinite(store.ts[row, col])
+
+
+def test_direct_store_prunes_deleted_nodes():
+    """Direct mode must GC store rows for deleted cluster nodes (advisor
+    finding: removed nodes stayed schedulable forever)."""
+    cluster, fake, ann, store = _direct_annotator(3)
+    for i in range(3):
+        fake.set("cpu_usage_avg_5m", f"10.0.0.{i}", 0.2, by="ip")
+    ann.sync_metric_bulk("cpu_usage_avg_5m", NOW)
+    assert set(store.node_names) == {"node-0", "node-1", "node-2"}
+    cluster.delete_node("node-2")
+    ann.sync_metric_bulk("cpu_usage_avg_5m", NOW + 60)
+    assert set(store.node_names) == {"node-0", "node-1"}
+
+
+def test_direct_store_non_numeric_value_fails_open():
+    """A non-numeric bulk sample must become NaN/-inf in the store (the
+    fail-open 'structurally invalid == missing' semantics), not an object
+    array or TypeError."""
+    import numpy as np
+
+    from crane_scheduler_tpu.metrics.source import MetricsQueryError
+
+    cluster, fake, ann, store = _direct_annotator(1)
+
+    class Junk:
+        def query_all_by_metric(self, metric_name):
+            return {"10.0.0.0": "not-a-number"}
+
+        def query_by_node_ip(self, m, ip):
+            raise MetricsQueryError("no")
+
+        def query_by_node_name(self, m, n):
+            raise MetricsQueryError("no")
+
+    ann.metrics = Junk()
+    assert ann.sync_metric_bulk("cpu_usage_avg_5m", NOW) == 1
+    col = store.tensors.metric_index["cpu_usage_avg_5m"]
+    row = store.node_id("node-0")
+    assert np.isnan(store.values[row, col])
+    assert store.ts[row, col] == float("-inf")
+
+
+def test_direct_store_queue_path_preserves_unflushed_values():
+    """The queue-path direct write must be targeted: re-ingesting the
+    (lagging) cluster annotation map would wipe store values whose
+    deferred annotation patches haven't flushed yet (review finding)."""
+    import numpy as np
+
+    cluster, fake, ann, store = _direct_annotator(1)
+    # bulk sync metric B straight into the store; annotations deferred
+    fake.set("mem_usage_avg_5m", "10.0.0.0", 0.55, by="ip")
+    assert ann.sync_metric_bulk("mem_usage_avg_5m", NOW) == 1
+    # metric A only reachable via the per-node path
+    fake.set("cpu_usage_avg_5m", "node-0", 0.25, by="name")
+    assert ann.sync_node("node-0/cpu_usage_avg_5m", NOW)
+    row = store.node_id("node-0")
+    col_a = store.tensors.metric_index["cpu_usage_avg_5m"]
+    col_b = store.tensors.metric_index["mem_usage_avg_5m"]
+    assert store.values[row, col_a] == 0.25
+    assert store.values[row, col_b] == 0.55  # B survived, never flushed
+    assert np.isfinite(store.ts[row, col_b])
