@@ -59,7 +59,7 @@ class AnnotatorConfig:
     # from the bulk result still take the per-node queue path.
     bulk_sync: bool = False
     # With an attached store (attach_store), bulk syncs write the metric
-    # column straight into it (bulk_set_metric) and emit the annotation
+    # column straight into it (bulk_set_by_name) and emit the annotation
     # patches asynchronously — the annotation stays the durable contract,
     # but a scheduler sharing the store never re-parses strings.
     direct_store: bool = False
@@ -179,8 +179,13 @@ class NodeAnnotator:
             # rows stay NaN forever. Targeted write of just this metric —
             # a full re-ingest of the cluster map would wipe store values
             # whose deferred annotation patches haven't flushed yet.
-            v, ts = decode_annotation_or_missing(anno)
-            self._store.set_metric(node.name, metric_name, v, ts)
+            # Re-check liveness AFTER the (blocking) metrics query: a node
+            # deleted mid-query must not have its pruned row resurrected.
+            # The residual race window is lock-free microseconds, and any
+            # loser row is re-pruned on the next bulk tick.
+            if self.cluster.get_node(node.name) is not None:
+                v, ts = decode_annotation_or_missing(anno)
+                self._store.set_metric(node.name, metric_name, v, ts)
         return anno
 
     def hot_value(self, node_name: str, now: float) -> int:
@@ -233,7 +238,7 @@ class NodeAnnotator:
         self.cluster.patch_node_annotation(node.name, NODE_HOT_VALUE_KEY, anno)
         if self._store is not None and self.config.direct_store:
             v, ts = decode_annotation_or_missing(anno)
-            self._store.set_hot_value(node.name, v, ts)
+            self._store.set_hot_value(node.name, v, ts, create=False)
         return anno
 
     def enqueue_metric(self, metric_name: str) -> None:
@@ -261,6 +266,7 @@ class NodeAnnotator:
         """
         if now is None:
             now = time.time()
+        self._prune_direct_store()
         query_all = getattr(self.metrics, "query_all_by_metric", None)
         if query_all is None:
             # source has no bulk support: per-node path for everyone
@@ -331,12 +337,16 @@ class NodeAnnotator:
                 np.asarray(hot_vals),
                 np.asarray(hot_ts),
             )
-        if direct:
-            # Direct mode is the only reader path for the shared store
-            # (the scheduler's refresh() returns early), so deleted
-            # cluster nodes must be pruned here or they stay schedulable.
-            self._store.prune_absent(self.cluster.node_names())
         return patched
+
+    def _prune_direct_store(self) -> None:
+        """Direct mode is the only reader path for the shared store (the
+        scheduler's refresh() returns early), so every bulk tick must
+        prune deleted cluster nodes or they stay schedulable — including
+        ticks that fall back to the per-node queue (no bulk query support
+        or a failing metrics source)."""
+        if self._store is not None and self.config.direct_store:
+            self._store.prune_absent(self.cluster.node_names())
 
     def sync_all_once_bulk(self, now: float | None = None) -> None:
         """Deterministic bulk pass over syncPolicy metrics."""
@@ -353,9 +363,9 @@ class NodeAnnotator:
         of truth — SURVEY §5)."""
         nodes = self.cluster.list_nodes()
         store.bulk_ingest((n.name, n.annotations) for n in nodes)
-        seen = {n.name for n in nodes}
-        for name in set(store.node_names) - seen:
-            store.remove_node(name)
+        # one lock hold for the whole prune: a concurrent snapshot() never
+        # observes a half-pruned store
+        store.prune_absent(n.name for n in nodes)
 
     # -- threaded mode -----------------------------------------------------
 

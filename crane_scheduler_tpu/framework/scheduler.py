@@ -237,9 +237,7 @@ class BatchScheduler:
             return
         nodes = self.cluster.list_nodes()
         self.store.bulk_ingest((n.name, n.annotations) for n in nodes)
-        seen = {n.name for n in nodes}
-        for name in set(self.store.node_names) - seen:
-            self.store.remove_node(name)
+        self.store.prune_absent(n.name for n in nodes)
 
     def _prepare(self, now: float):
         """Upload (or reuse) the device snapshot for the current store.
@@ -281,20 +279,29 @@ class BatchScheduler:
                 self.cluster.bind_pod(pod_key, node_name, now)
         return result
 
-    def _build_result(self, packed, keys) -> BatchResult:
-        """Expand per-node counts into the sequential pod-key order (pods
-        are interchangeable within a batch; see scorer.topk docstring)."""
+    @staticmethod
+    def _expand_counts(scores, counts, names, keys):
+        """Expand per-node counts into pod-key assignments (pods are
+        interchangeable within a batch): nodes in stable score-descending
+        order, keys in sequence; keys beyond the total count are
+        unassigned. The single-shot and recovery paths MUST share this so
+        re-solved placements stay bit-identical to a one-pass solve."""
         import numpy as np
 
-        n = self._prepared_n
-        names = self._prepared_names
-        schedulable, scores, counts, _unassigned, _ = self._sharded.unpack(packed, n)
-        by_score = np.argsort(-scores, kind="stable")
+        by_score = np.argsort(-np.asarray(scores), kind="stable")
+        counts = np.asarray(counts)
         order = np.repeat(by_score, counts[by_score])
         assignments = {
             key: names[node_idx] for key, node_idx in zip(keys, order)
         }
         unassigned = list(keys[len(order):])
+        return assignments, unassigned
+
+    def _build_result(self, packed, keys) -> BatchResult:
+        n = self._prepared_n
+        names = self._prepared_names
+        schedulable, scores, counts, _unassigned, _ = self._sharded.unpack(packed, n)
+        assignments, unassigned = self._expand_counts(scores, counts, names, keys)
         return BatchResult(
             assignments=assignments,
             unassigned=unassigned,
@@ -456,20 +463,29 @@ class BatchScheduler:
         result = self._build_result(packed, keys)
 
         if bind:
-            self._bind_gang(template, result.assignments, topology, now)
+            result = self._bind_gang_with_recovery(
+                template, result, topology, now, dynamic_weight, topology_weight
+            )
         return result
 
-    def _bind_gang(self, template, assignments, topology, now: float) -> None:
-        """Create + bind each assigned copy; run the topology plugin's
+    def _bind_gang(self, template, assignments, topology, now: float):
+        """Create + bind each assigned copy, running the topology plugin's
         per-pod extension points so zone usage is durably recorded
         (ref: reserver.go, binder.go). A copy the plugin's Filter rejects
-        (the copies-capacity estimate over-admitted) still binds — the
-        gang owns placement — but without a zone annotation."""
+        (the copies-capacity estimate over-admitted) is NOT bound — blind
+        binding would silently violate the NUMA contract the plugin
+        enforces (ref: filter.go:45-86). Returns
+        ``(bound: {key: node}, rejected: [key], rejecting: {node})`` so
+        the caller can re-run the waterline with corrected capacity.
+        """
         from dataclasses import replace
 
         from ..framework.types import CycleState, NodeInfo
 
         nodes_by_name = {node.name: node for node in self.cluster.list_nodes()}
+        bound: dict[str, str] = {}
+        rejected: list[str] = []
+        rejecting: set[str] = set()
         for pod_key, node_name in assignments.items():
             pod = replace(
                 template,
@@ -477,7 +493,6 @@ class BatchScheduler:
                 annotations=dict(template.annotations),
                 node_name="",
             )
-            self.cluster.add_pod(pod)
             if topology is not None and node_name in nodes_by_name:
                 state = CycleState()
                 topology.pre_filter(state, pod)
@@ -485,7 +500,100 @@ class BatchScheduler:
                     node=nodes_by_name[node_name],
                     pods=self.cluster.list_pods(node_name),
                 )
-                if topology.filter(state, pod, node_info).ok():
-                    if topology.reserve(state, pod, node_name).ok():
-                        topology.pre_bind(state, pod, node_name)
+                if not topology.filter(state, pod, node_info).ok():
+                    rejected.append(pod_key)
+                    rejecting.add(node_name)
+                    continue
+                self.cluster.add_pod(pod)
+                if topology.reserve(state, pod, node_name).ok():
+                    topology.pre_bind(state, pod, node_name)
+            else:
+                self.cluster.add_pod(pod)
             self.cluster.bind_pod(pod_key, node_name, now)
+            bound[pod_key] = node_name
+        return bound, rejected, rejecting
+
+    def _bind_gang_with_recovery(
+        self,
+        template,
+        result: BatchResult,
+        topology,
+        now: float,
+        dynamic_weight: int,
+        topology_weight: int,
+        max_passes: int = 4,
+    ) -> BatchResult:
+        """Bind the gang; when the plugin's Filter rejects over-admitted
+        copies (copies-capacity estimated more than truly fit), re-run the
+        waterline for just the rejected copies with corrected capacity:
+        rejecting nodes drop to zero remaining (copies are identical — a
+        node that rejected one rejects all at its current state), other
+        nodes' capacity is re-derived from the now-updated NUMA usage, and
+        the hot-penalty staircase continues past the copies already bound
+        (``prior``). Copies that still find no home end up unassigned —
+        never bound zone-less."""
+        import numpy as np
+
+        from ..constants import MAX_NODE_SCORE
+        from ..scorer.topk import gang_assign_host
+
+        bound, rejected, rejecting = self._bind_gang(
+            template, result.assignments, topology, now
+        )
+        if not rejected:
+            return result
+
+        n = self._prepared_n
+        names = self._prepared_names
+        idx = {name: i for i, name in enumerate(names[:n])}
+        scores = np.array([result.scores[names[i]] for i in range(n)], np.int64)
+        schedulable = np.array(
+            [result.schedulable[names[i]] for i in range(n)], bool
+        )
+        prior = np.zeros((n,), np.int64)
+        for node_name in bound.values():
+            prior[idx[node_name]] += 1
+
+        assignments = dict(bound)
+        unassigned = list(result.unassigned)
+        banned: set[str] = set()
+        for _ in range(max_passes):
+            if not rejected:
+                break
+            banned |= rejecting
+            offsets, capacity = self._numa_vectors(
+                template, topology, topology_weight, names, n
+            )
+            for node_name in banned:
+                capacity[idx[node_name]] = 0
+            retry = gang_assign_host(
+                scores,
+                schedulable,
+                len(rejected),
+                self.tensors.hv_count,
+                capacity=capacity,
+                offsets=offsets,
+                dynamic_weight=dynamic_weight,
+                max_offset=MAX_NODE_SCORE * topology_weight,
+                prior=prior,
+            )
+            new_assign, leftover = self._expand_counts(
+                scores, retry.counts, names, rejected
+            )
+            unassigned.extend(leftover)
+            if not new_assign:
+                rejected = []
+                break
+            bound, rejected, rejecting = self._bind_gang(
+                template, new_assign, topology, now
+            )
+            for key, node_name in bound.items():
+                assignments[key] = node_name
+                prior[idx[node_name]] += 1
+        unassigned.extend(rejected)  # passes exhausted
+        return BatchResult(
+            assignments=assignments,
+            unassigned=unassigned,
+            scores=result.scores,
+            schedulable=result.schedulable,
+        )

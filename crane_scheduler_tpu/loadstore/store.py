@@ -23,7 +23,7 @@ from __future__ import annotations
 import functools
 import threading
 from dataclasses import dataclass
-from typing import Iterable, Mapping
+from typing import Mapping
 
 import numpy as np
 
@@ -161,9 +161,18 @@ class NodeLoadStore:
     # -- writes ------------------------------------------------------------
 
     @_locked
-    def set_metric(self, node: str, metric: str, value: float, ts: float) -> None:
+    def set_metric(
+        self, node: str, metric: str, value: float, ts: float,
+        create: bool = True,
+    ) -> None:
+        """``create=False`` drops the write when the node has no row —
+        for writers racing a concurrent ``prune_absent`` (a deleted
+        node's in-flight sync must not resurrect its row; a genuinely
+        new node just waits for the next bulk tick to add it)."""
         i = self._index.get(node)
         if i is None:
+            if not create:
+                return
             i = self.add_node(node)
         self._last_anno.pop(node, None)
         col = self.tensors.metric_index.get(metric)
@@ -174,9 +183,13 @@ class NodeLoadStore:
         self._version += 1
 
     @_locked
-    def set_hot_value(self, node: str, value: float, ts: float) -> None:
+    def set_hot_value(
+        self, node: str, value: float, ts: float, create: bool = True
+    ) -> None:
         i = self._index.get(node)
         if i is None:
+            if not create:
+                return
             i = self.add_node(node)
         self._last_anno.pop(node, None)
         self.hot_value[i] = value
@@ -211,35 +224,6 @@ class NodeLoadStore:
         for key, raw in anno.items():
             if key == NODE_HOT_VALUE_KEY or key in self.tensors.metric_index:
                 self.ingest_annotation(node, key, raw)
-
-    @_locked
-    def bulk_set_metric(
-        self,
-        metric: str,
-        node_ids: np.ndarray | Iterable[int],
-        values: np.ndarray,
-        ts: float | np.ndarray,
-    ) -> None:
-        """Whole-column refresh: the TPU-native annotator write path."""
-        col = self.tensors.metric_index.get(metric)
-        if col is None:
-            return
-        ids = np.asarray(node_ids, dtype=np.int64)
-        self.values[ids, col] = values
-        self.ts[ids, col] = ts
-        self._version += 1
-
-    @_locked
-    def bulk_set_hot_value(
-        self,
-        node_ids: np.ndarray | Iterable[int],
-        values: np.ndarray,
-        ts: float | np.ndarray,
-    ) -> None:
-        ids = np.asarray(node_ids, dtype=np.int64)
-        self.hot_value[ids] = values
-        self.hot_ts[ids] = ts
-        self._version += 1
 
     @_locked
     def bulk_set_by_name(

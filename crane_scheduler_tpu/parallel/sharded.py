@@ -130,7 +130,8 @@ class ShardedScheduleStep:
             schedulable = jnp.where(ovr_mask, ovr_sched & node_valid, schedulable)
             scores = jnp.where(ovr_mask & node_valid, ovr_score, scores)
         counts, unassigned, waterline = self.gang._assign_impl(
-            scores, schedulable, num_pods, capacity, offsets
+            scores, schedulable, num_pods, capacity, offsets,
+            jnp.zeros_like(capacity),
         )
         return schedulable, scores, counts, unassigned, waterline
 

@@ -112,6 +112,7 @@ def gang_assign_oracle(
     offsets: Sequence[int] | None = None,
     dynamic_weight: int = 1,
     max_offset: int | None = None,
+    prior: Sequence[int] | None = None,
 ) -> GangResult:
     """Sequential greedy reference implementation (slow; parity oracle).
 
@@ -121,11 +122,16 @@ def gang_assign_oracle(
     runs short; the grid top (``100*w + max_offset + 1``) when no pod was
     requested. ``max_offset`` should match the solver's static bound
     (defaults to max(offsets)).
+
+    ``prior`` (per node) counts in-batch assignments made by an earlier
+    pass: the hot-penalty staircase continues at h(prior + t) while
+    ``capacity`` still bounds only this pass's assignments.
     """
     n = len(scores)
     counts = [int(c) for c in hv_counts if int(c) > 0]
     cap = [num_pods] * n if capacity is None else [int(c) for c in capacity]
     offs = [0] * n if offsets is None else [int(o) for o in offsets]
+    base = [0] * n if prior is None else [int(p) for p in prior]
     w = int(dynamic_weight)
     if max_offset is None:
         max_offset = max(offs, default=0)
@@ -142,7 +148,7 @@ def gang_assign_oracle(
             if not schedulable[i] or assigned[i] >= cap[i]:
                 continue
             dyn = normalize_score(
-                int(scores[i]) - 10 * h(assigned[i]), MAX_NODE_SCORE, 0
+                int(scores[i]) - 10 * h(base[i] + assigned[i]), MAX_NODE_SCORE, 0
             )
             eff = w * dyn + offs[i]
             if eff > best_eff:
@@ -170,6 +176,7 @@ def gang_assign_host(
     offsets=None,
     dynamic_weight: int = 1,
     max_offset: int = 0,
+    prior=None,
 ) -> GangResult:
     """Vectorized numpy twin of ``GangScheduler._assign_impl``.
 
@@ -177,6 +184,10 @@ def gang_assign_host(
     prefix split) with the same int32-range clipping, so results are
     bit-identical to the device solver — fast enough to verify placements
     at benchmark scale (O(levels*N) numpy) without a device round-trip.
+
+    ``prior`` shifts each node's hot-penalty staircase past assignments
+    an earlier pass already made (token t is valued at h(prior + t));
+    ``capacity`` bounds this pass only.
     """
     s = np.asarray(scores, np.int64)
     n = s.shape[0]
@@ -189,6 +200,9 @@ def gang_assign_host(
     if offsets is None:
         offsets = np.zeros((n,), dtype=np.int64)
     offs = np.clip(np.asarray(offsets, np.int64), 0, int(max_offset))
+    if prior is None:
+        prior = np.zeros((n,), dtype=np.int64)
+    prior = np.clip(np.asarray(prior, np.int64), 0, 2**31 - 1)
     n_levels = MAX_NODE_SCORE * w + int(max_offset) + 2
 
     k_cap = np.where(np.asarray(schedulable, bool), capacity, 0)
@@ -201,6 +215,7 @@ def gang_assign_host(
         q = (qnum + (w - 1)) // w
         xq = np.clip((s - q) // 10, 0, 10)
         unlocked = np.where((q <= MAX_NODE_SCORE) & (s >= q), g[xq], 0)
+        unlocked = np.maximum(unlocked - prior, 0)  # tokens an earlier pass took
         unlocked = np.where(qnum <= 0, k_cap, unlocked)
         return np.minimum(k_cap, unlocked)
 
@@ -269,7 +284,8 @@ class GangScheduler:
         return out
 
     def __call__(
-        self, scores, schedulable, num_pods, capacity=None, offsets=None
+        self, scores, schedulable, num_pods, capacity=None, offsets=None,
+        prior=None,
     ) -> GangResult:
         scores = jnp.asarray(scores, dtype=jnp.int32)
         n = scores.shape[0]
@@ -279,28 +295,36 @@ class GangScheduler:
         capacity = np.minimum(np.asarray(capacity, dtype=np.int64), 2**31 - 1)
         if offsets is None:
             offsets = np.zeros((n,), dtype=np.int32)
+        if prior is None:
+            prior = np.zeros((n,), dtype=np.int32)
         out = self._jit(
             scores,
             jnp.asarray(schedulable, dtype=jnp.bool_),
             jnp.asarray(num_pods, dtype=jnp.int32),
             jnp.asarray(capacity, dtype=jnp.int32),
             jnp.asarray(offsets, dtype=jnp.int32),
+            jnp.asarray(prior, dtype=jnp.int32),
         )
         return GangResult(*out)
 
-    def _a_table(self, s, offsets, k_cap, lv):
+    def _a_table(self, s, offsets, k_cap, prior, lv):
         """A_n(L): tokens of node n valued >= level L, for L broadcast
         against the node axis. Level 0 (and any L <= offset) is always
-        the full k_cap: token values never drop below the offset."""
+        the full k_cap: token values never drop below the offset.
+        ``prior`` tokens per node were consumed by an earlier pass and
+        come off the unlocked count (but not off k_cap, which already
+        bounds only this pass)."""
         qnum = lv - offsets  # may broadcast [L, N] or [N]
         w = self._weight
         q = (qnum + (w - 1)) // w  # ceil; only meaningful when qnum > 0
         xq = jnp.clip((s - q) // 10, 0, 10)
         unlocked = jnp.where((q <= MAX_NODE_SCORE) & (s >= q), self._g_lookup(xq), 0)
+        unlocked = jnp.maximum(unlocked - prior, 0)
         unlocked = jnp.where(qnum <= 0, k_cap, unlocked)
         return jnp.minimum(k_cap, unlocked)
 
-    def _assign_impl(self, scores, schedulable, num_pods, capacity, offsets):
+    def _assign_impl(self, scores, schedulable, num_pods, capacity, offsets,
+                     prior):
         # All internal arithmetic is int32: int64 cumsum/reductions lower
         # to u32-pair reduce-windows that blow TPU vmem at 50k nodes. This
         # is exact because per-node tokens are clipped to (2^31-1)/N (so
@@ -318,6 +342,7 @@ class GangScheduler:
 
         s = scores.astype(jnp.int32)
         offs = jnp.clip(offsets.astype(jnp.int32), 0, self._max_offset)
+        pri = jnp.clip(prior.astype(jnp.int32), 0, 2**31 - 1)
         levels = jnp.arange(n_levels, dtype=jnp.int32)
 
         # totals[L] = Σ_n A_n(L), the number of tokens valued >= L.
@@ -328,7 +353,7 @@ class GangScheduler:
         # emitter can abort in fusion: scatter_emitter.cc operand check),
         # so the dense table is both faster and safer here.
         a_table = self._a_table(s[None, :], offs[None, :], k_cap[None, :],
-                                levels[:, None])
+                                pri[None, :], levels[:, None])
         totals = a_table.sum(axis=1, dtype=jnp.int32)  # [n_levels]
 
         meets = totals >= num_pods  # True for L <= L*
@@ -341,9 +366,11 @@ class GangScheduler:
 
         def waterline(l_star):
             upper = jnp.where(
-                l_star + 1 >= n_levels, 0, self._a_table(s, offs, k_cap, l_star + 1)
+                l_star + 1 >= n_levels,
+                0,
+                self._a_table(s, offs, k_cap, pri, l_star + 1),
             )
-            at_or_above = self._a_table(s, offs, k_cap, l_star)
+            at_or_above = self._a_table(s, offs, k_cap, pri, l_star)
             exact = at_or_above - upper  # tokens exactly at L*
             remainder = num_pods - jnp.take(
                 totals, jnp.minimum(l_star + 1, n_levels - 1)

@@ -484,3 +484,51 @@ def test_schedule_gang_bind_creates_pods_and_consumes_numa():
     r2 = batch.schedule_gang(template, 2, topology=topology, bind=False)
     assert len(r2.assignments) == 0
     assert len(r2.unassigned) == 2
+
+
+def test_schedule_gang_over_admission_recovers(monkeypatch):
+    """When copies-capacity over-estimates (forced here by inflating the
+    estimate on the first pass), the copies the plugin's Filter rejects
+    must NOT bind zone-less (ref: filter.go:45-86 is the contract being
+    enforced): the waterline re-runs with corrected capacity and the
+    truly-unplaceable copy ends up unassigned."""
+    import crane_scheduler_tpu.topology.batched as tb
+    from crane_scheduler_tpu.topology import TopologyMatch
+    from crane_scheduler_tpu.topology.helper import get_pod_numa_node_result
+    from crane_scheduler_tpu.topology.types import ANNOTATION_POD_TOPOLOGY_AWARENESS
+
+    real = tb.copies_capacity
+    calls = {"n": 0}
+
+    def inflated(wrappers, request, aware):
+        caps = real(wrappers, request, aware)
+        calls["n"] += 1
+        if calls["n"] == 1:  # only the initial admission estimate lies
+            caps = caps + 1
+        return caps
+
+    monkeypatch.setattr(tb, "copies_capacity", inflated)
+
+    sim = make_sim(2, seed=24)
+    batch = sim.build_batch_scheduler()
+    # each node: one 4-core zone -> truly one aware 3-core copy per node,
+    # but the inflated estimate admits two per node
+    lister = _nrt_fixture(sim, [[4000], [4000]])
+    topology = TopologyMatch(lister, cluster=sim.cluster)
+    template = sim.make_pod(cpu_milli=3000, mem=1 << 30)
+    sim.cluster.delete_pod(template.key())
+    template.annotations[ANNOTATION_POD_TOPOLOGY_AWARENESS] = "true"
+
+    result = batch.schedule_gang(template, 3, topology=topology, bind=True)
+    assert calls["n"] >= 2  # the recovery pass re-derived capacity
+    assert len(result.assignments) == 2  # the true NUMA capacity
+    assert len(result.unassigned) == 1
+    assert set(result.assignments) | set(result.unassigned) == {
+        f"{template.namespace}/{template.name}-{i}" for i in range(3)
+    }
+    for key, node_name in result.assignments.items():
+        pod = sim.cluster.get_pod(key)
+        assert pod is not None and pod.node_name == node_name
+        assert len(get_pod_numa_node_result(pod)) == 1  # never zone-less
+    for key in result.unassigned:
+        assert sim.cluster.get_pod(key) is None  # rejected copy not bound

@@ -181,6 +181,74 @@ def test_combined_defaults_match_plain():
     )
 
 
+def run_both_prior(scores, schedulable, p, hv, capacity, offsets, weight,
+                   max_offset, prior):
+    want = gang_assign_oracle(
+        scores, schedulable, p, hv, capacity,
+        offsets=offsets, dynamic_weight=weight, max_offset=max_offset,
+        prior=prior,
+    )
+    got = GangScheduler(hv, dynamic_weight=weight, max_offset=max_offset)(
+        scores, schedulable, p, capacity, offsets=offsets, prior=prior
+    )
+    host = gang_assign_host(
+        scores, schedulable, p, hv, capacity,
+        offsets=offsets, dynamic_weight=weight, max_offset=max_offset,
+        prior=prior,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.counts), want.counts,
+        err_msg=f"scores={scores} p={p} prior={prior}",
+    )
+    assert int(got.unassigned) == want.unassigned
+    assert int(got.waterline) == want.waterline
+    np.testing.assert_array_equal(host.counts, want.counts)
+    assert host.unassigned == want.unassigned
+    assert host.waterline == want.waterline
+    return got
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_prior_random_parity(seed):
+    rng = random.Random(4000 + seed)
+    n = rng.randint(1, 25)
+    weight = rng.choice([1, 3])
+    max_offset = rng.choice([0, 200])
+    scores = [rng.randint(0, 100) for _ in range(n)]
+    schedulable = [rng.random() > 0.2 for _ in range(n)]
+    p = rng.randint(0, 60)
+    hv = rng.choice([DEFAULT_HV, [1], [3, 7], []])
+    capacity = [rng.randint(0, 12) for _ in range(n)]
+    offsets = [rng.randint(0, max_offset) for _ in range(n)]
+    prior = [rng.randint(0, 6) for _ in range(n)]
+    run_both_prior(
+        scores, schedulable, p, hv, capacity, offsets, weight, max_offset,
+        prior,
+    )
+
+
+def test_prior_continuation_matches_single_shot():
+    """Solving P pods in one pass equals solving P1 then P2 with the
+    first pass's counts as prior and its consumption off the capacity —
+    the property the over-admission recovery relies on."""
+    rng = random.Random(7)
+    n = 20
+    scores = [rng.randint(0, 100) for _ in range(n)]
+    sched = [True] * n
+    capacity = [rng.randint(1, 10) for _ in range(n)]
+    total = 40
+    full = gang_assign_host(scores, sched, total, DEFAULT_HV, list(capacity))
+    first = gang_assign_host(scores, sched, 25, DEFAULT_HV, list(capacity))
+    c1 = np.asarray(first.counts, np.int64)
+    second = gang_assign_host(
+        scores, sched, total - 25, DEFAULT_HV,
+        list(np.asarray(capacity) - c1), prior=c1,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(full.counts), c1 + np.asarray(second.counts)
+    )
+
+
 @pytest.mark.parametrize("seed", range(10))
 def test_combined_random_parity(seed):
     rng = random.Random(1000 + seed)
