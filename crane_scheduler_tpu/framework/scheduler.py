@@ -53,6 +53,8 @@ class Scheduler:
         self.cluster = cluster
         self._clock = clock
         self._plugins: list[_WeightedPlugin] = []
+        self._snap: list[NodeInfo] | None = None
+        self._snap_version = -1
 
     def register(self, plugin, weight: int = 1) -> None:
         """Order matters like the scheduler-config plugin list
@@ -60,14 +62,46 @@ class Scheduler:
         self._plugins.append(_WeightedPlugin(plugin, weight))
 
     def snapshot(self) -> list[NodeInfo]:
+        """Informer-style snapshot, cached on ``cluster.sched_version``:
+        drip scheduling reuses it across schedule_one calls (our own
+        binds fold in incrementally via ``_note_bind``) instead of
+        rebuilding the O(nodes + pods) view per pod."""
+        v = self.cluster.sched_version
+        if self._snap is not None and v == self._snap_version:
+            return self._snap
         pods_by_node: dict[str, list[Pod]] = {}
         for pod in self.cluster.list_pods():
             if pod.node_name:
                 pods_by_node.setdefault(pod.node_name, []).append(pod)
-        return [
+        self._snap = [
             NodeInfo(node=node, pods=pods_by_node.get(node.name, []))
             for node in self.cluster.list_nodes()
         ]
+        self._snap_version = v
+        return self._snap
+
+    def _note_bind(self, pod_key: str, node_name: str, pre_version: int) -> None:
+        """Fold our own bind into the cached snapshot. ``pre_version`` is
+        the sched_version read immediately before binding: folding is
+        only valid when it still matches the version the cache was built
+        at — a concurrent writer's interleaved bump means the cached view
+        missed a change, so drop the cache instead of stamping over it.
+        On a clean fold the cache is stamped ``pre_version + 1`` (our
+        bind's own bump) — fail-safe without holding the cluster lock
+        across the cycle."""
+        if self._snap is None:
+            return
+        if pre_version != self._snap_version:
+            self._snap = None  # cluster moved under us: force rebuild
+            return
+        bound = self.cluster.get_pod(pod_key)
+        if bound is None:
+            return
+        for node_info in self._snap:
+            if node_info.node is not None and node_info.node.name == node_name:
+                node_info.pods.append(bound)
+                break
+        self._snap_version = pre_version + 1
 
     def schedule_one(self, pod: Pod) -> ScheduleResult:
         state = CycleState()
@@ -140,7 +174,9 @@ class Scheduler:
                     self._unreserve(state, pod, best_name)
                     return ScheduleResult(pod.key(), None, len(feasible), status.reason)
 
+        pre_version = self.cluster.sched_version
         self.cluster.bind_pod(pod.key(), best_name, self._clock())
+        self._note_bind(pod.key(), best_name, pre_version)
         return ScheduleResult(pod.key(), best_name, len(feasible), scores=totals)
 
     def _unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
@@ -468,31 +504,33 @@ class BatchScheduler:
             )
         return result
 
-    def _bind_gang(self, template, assignments, topology, now: float):
-        """Create + bind each assigned copy, running the topology plugin's
-        per-pod extension points so zone usage is durably recorded
-        (ref: reserver.go, binder.go). A copy the plugin's Filter rejects
+    def _bind_assignments(self, pods_for, assignments, topology, now: float):
+        """Shared bind loop for gang copies and pending pods: drive the
+        topology plugin's Filter -> Reserve -> PreBind per pod, then bind
+        (ref: reserver.go, binder.go). A pod the plugin's Filter rejects
         (the copies-capacity estimate over-admitted) is NOT bound — blind
         binding would silently violate the NUMA contract the plugin
-        enforces (ref: filter.go:45-86). Returns
-        ``(bound: {key: node}, rejected: [key], rejecting: {node})`` so
-        the caller can re-run the waterline with corrected capacity.
-        """
-        from dataclasses import replace
+        enforces (ref: filter.go:45-86).
 
+        ``pods_for(key) -> (pod | None, create)`` resolves each key;
+        ``create`` means the pod must be added to the cluster before
+        binding (the gang path creates copies from a template). Returns
+        ``(bound, rejected, rejecting, dropped)``: ``rejected`` keys were
+        Filter-rejected on their node and can re-solve elsewhere;
+        ``dropped`` keys cannot bind at all (pod missing from the
+        resolver or the cluster) and go straight to unassigned."""
         from ..framework.types import CycleState, NodeInfo
 
         nodes_by_name = {node.name: node for node in self.cluster.list_nodes()}
         bound: dict[str, str] = {}
         rejected: list[str] = []
         rejecting: set[str] = set()
+        dropped: list[str] = []
         for pod_key, node_name in assignments.items():
-            pod = replace(
-                template,
-                name=pod_key.split("/", 1)[1],
-                annotations=dict(template.annotations),
-                node_name="",
-            )
+            pod, create = pods_for(pod_key)
+            if pod is None:
+                dropped.append(pod_key)
+                continue
             if topology is not None and node_name in nodes_by_name:
                 state = CycleState()
                 topology.pre_filter(state, pod)
@@ -504,14 +542,34 @@ class BatchScheduler:
                     rejected.append(pod_key)
                     rejecting.add(node_name)
                     continue
-                self.cluster.add_pod(pod)
+                if create:
+                    self.cluster.add_pod(pod)
                 if topology.reserve(state, pod, node_name).ok():
                     topology.pre_bind(state, pod, node_name)
-            else:
+            elif create:
                 self.cluster.add_pod(pod)
-            self.cluster.bind_pod(pod_key, node_name, now)
+            if not self.cluster.bind_pod(pod_key, node_name, now):
+                dropped.append(pod_key)
+                continue
             bound[pod_key] = node_name
-        return bound, rejected, rejecting
+        return bound, rejected, rejecting, dropped
+
+    def _bind_gang(self, template, assignments, topology, now: float):
+        """Create + bind each assigned copy of ``template``."""
+        from dataclasses import replace
+
+        def pods_for(pod_key):
+            return (
+                replace(
+                    template,
+                    name=pod_key.split("/", 1)[1],
+                    annotations=dict(template.annotations),
+                    node_name="",
+                ),
+                True,
+            )
+
+        return self._bind_assignments(pods_for, assignments, topology, now)
 
     def _bind_gang_with_recovery(
         self,
@@ -534,29 +592,68 @@ class BatchScheduler:
         never bound zone-less."""
         import numpy as np
 
-        from ..constants import MAX_NODE_SCORE
-        from ..scorer.topk import gang_assign_host
-
-        bound, rejected, rejecting = self._bind_gang(
-            template, result.assignments, topology, now
-        )
-        if not rejected:
-            return result
-
         n = self._prepared_n
         names = self._prepared_names
-        idx = {name: i for i, name in enumerate(names[:n])}
         scores = np.array([result.scores[names[i]] for i in range(n)], np.int64)
         schedulable = np.array(
             [result.schedulable[names[i]] for i in range(n)], bool
         )
         prior = np.zeros((n,), np.int64)
+        assignments, unplaced = self._bind_recover_loop(
+            lambda a: self._bind_gang(template, a, topology, now),
+            result.assignments,
+            template,
+            topology,
+            scores,
+            schedulable,
+            prior,
+            dynamic_weight,
+            topology_weight,
+            max_passes,
+        )
+        return BatchResult(
+            assignments=assignments,
+            unassigned=list(result.unassigned) + unplaced,
+            scores=result.scores,
+            schedulable=result.schedulable,
+        )
+
+    def _bind_recover_loop(
+        self,
+        bind_fn,
+        assignments,
+        template,
+        topology,
+        scores,
+        schedulable,
+        prior,
+        dynamic_weight: int,
+        topology_weight: int,
+        max_passes: int = 4,
+    ):
+        """Run ``bind_fn`` (returning ``(bound, rejected, rejecting)``),
+        re-solving rejected pods with corrected capacity up to
+        ``max_passes`` times. ``prior`` is updated in place with every
+        successful bind, so a caller chaining several classes through one
+        cycle keeps the hot-penalty staircase continuous. Returns
+        ``(bound: {key: node}, unplaced: [key])``."""
+        import numpy as np
+
+        from ..constants import MAX_NODE_SCORE
+        from ..scorer.topk import gang_assign_host
+
+        n = self._prepared_n
+        names = self._prepared_names
+        idx = {name: i for i, name in enumerate(names[:n])}
+        bound_all: dict[str, str] = {}
+        unplaced: list[str] = []
+        banned: set[str] = set()
+
+        bound, rejected, rejecting, dropped = bind_fn(assignments)
+        unplaced.extend(dropped)
         for node_name in bound.values():
             prior[idx[node_name]] += 1
-
-        assignments = dict(bound)
-        unassigned = list(result.unassigned)
-        banned: set[str] = set()
+        bound_all.update(bound)
         for _ in range(max_passes):
             if not rejected:
                 break
@@ -580,20 +677,159 @@ class BatchScheduler:
             new_assign, leftover = self._expand_counts(
                 scores, retry.counts, names, rejected
             )
-            unassigned.extend(leftover)
+            unplaced.extend(leftover)
             if not new_assign:
                 rejected = []
                 break
-            bound, rejected, rejecting = self._bind_gang(
-                template, new_assign, topology, now
-            )
+            bound, rejected, rejecting, dropped = bind_fn(new_assign)
+            unplaced.extend(dropped)
             for key, node_name in bound.items():
-                assignments[key] = node_name
+                bound_all[key] = node_name
                 prior[idx[node_name]] += 1
-        unassigned.extend(rejected)  # passes exhausted
+        unplaced.extend(rejected)  # passes exhausted
+        return bound_all, unplaced
+
+    # -- heterogeneous (mixed) batches -------------------------------------
+
+    def _bind_existing(self, pods_by_key, assignments, topology, now: float):
+        """Bind already-pending pods (the mixed-batch path); same
+        rejection contract as ``_bind_gang``."""
+        return self._bind_assignments(
+            lambda key: (pods_by_key.get(key), False), assignments, topology, now
+        )
+
+    def _class_key(self, pod, topology):
+        """Scheduling-equivalence class for one cycle: the Dynamic score
+        is pod-independent, so pods differ only in how TopologyMatch
+        treats them — daemonset-ness (Filter bypass, plugin no-op; ref:
+        plugins.go:41-43, filter.go:60-62), topology awareness, and the
+        guaranteed-CPU request the plugin packs (ref: filter.go:20-37)."""
+        is_ds = bool(pod.is_daemonset_pod())
+        if topology is None:
+            return ("plain", is_ds)
+        from ..framework.types import CycleState
+
+        state = CycleState()
+        topology.pre_filter(state, pod)
+        s = topology._get_state(state)
+        if is_ds or s is None or not s.target_container_indices:
+            return ("noop", is_ds)
+        r = s.target_container_resource
+        return ("numa", s.aware, r.milli_cpu, r.memory, r.ephemeral_storage)
+
+    def schedule_batch_mixed(
+        self,
+        pods: list[Pod],
+        topology=None,
+        bind: bool = True,
+        dynamic_weight: int = 3,
+        topology_weight: int = 2,
+    ) -> BatchResult:
+        """Heterogeneous burst: group pending pods by scheduling-
+        equivalence class and water-fill class by class against shared
+        evolving capacity (ref: scheduleOne handles arbitrary pods,
+        pkg/plugins/dynamic/plugins.go:39-98 — this is the batched
+        equivalent).
+
+        Classes run in first-appearance order; each class solves with the
+        same water-filling as ``schedule_gang``, and the hot-penalty
+        staircase continues across classes (``prior``), so a
+        class-grouped queue schedules exactly like sequential per-pod
+        scheduleOne under the in-batch penalty model — and bit-identically
+        to ``Scheduler.schedule_one`` when the policy has no hotValue
+        entries (scores are then static within the cycle). DaemonSet pods
+        bypass Filter (ref: plugins.go:41-43) and form an
+        always-schedulable class.
+
+        NUMA capacity consumed by earlier classes reaches later ones
+        through bound pods' zone annotations, so cross-class capacity
+        evolution requires ``bind=True``; ``bind=False`` previews each
+        class against the pre-batch NUMA state (hot-penalty continuation
+        still applies). Filter-rejected over-admissions recover per class
+        via the corrected-capacity re-solve."""
+        import numpy as np
+
+        from ..constants import MAX_NODE_SCORE
+        from ..scorer.topk import gang_assign_host
+
+        now = self._clock()
+        self.refresh()
+        prepared = self._prepare(now)
+        n = self._prepared_n
+        names = self._prepared_names
+        idx = {name: i for i, name in enumerate(names[:n])}
+
+        # one packed fetch for the cycle's shared verdicts (hybrid rescue
+        # rows included — class solves on host stay bit-identical)
+        packed = np.asarray(self._sharded.packed(prepared, 0, now=now))
+        schedulable, scores, _counts, _un, _ = self._sharded.unpack(packed, n)
+        scores = np.asarray(scores, np.int64)
+        sched = np.asarray(schedulable, bool)
+
+        classes: dict = {}
+        order: list = []
+        for pod in pods:
+            key = self._class_key(pod, topology)
+            if key not in classes:
+                classes[key] = []
+                order.append(key)
+            classes[key].append(pod)
+
+        prior = np.zeros((n,), np.int64)
+        assignments: dict[str, str] = {}
+        unassigned: list[str] = []
+        for key in order:
+            members = classes[key]
+            template = members[0]
+            # DaemonSet pods always pass Filter (ref: plugins.go:41-43)
+            cls_sched = np.ones((n,), bool) if template.is_daemonset_pod() else sched
+            if key[0] == "numa":
+                offsets, capacity = self._numa_vectors(
+                    template, topology, topology_weight, names, n
+                )
+            else:
+                offsets = np.zeros((n,), np.int32)
+                capacity = np.full((n,), 1 << 30, np.int64)
+            solved = gang_assign_host(
+                scores,
+                cls_sched,
+                len(members),
+                self.tensors.hv_count,
+                capacity=capacity,
+                offsets=offsets,
+                dynamic_weight=dynamic_weight,
+                max_offset=MAX_NODE_SCORE * topology_weight,
+                prior=prior,
+            )
+            keys_c = [p.key() for p in members]
+            assign_c, un_c = self._expand_counts(
+                scores, solved.counts, names, keys_c
+            )
+            unassigned.extend(un_c)
+            if bind:
+                pods_by_key = {p.key(): p for p in members}
+                bound, unplaced = self._bind_recover_loop(
+                    lambda a, pbk=pods_by_key: self._bind_existing(
+                        pbk, a, topology, now
+                    ),
+                    assign_c,
+                    template,
+                    topology,
+                    scores,
+                    cls_sched,
+                    prior,
+                    dynamic_weight,
+                    topology_weight,
+                )
+                assignments.update(bound)
+                unassigned.extend(unplaced)
+            else:
+                assignments.update(assign_c)
+                for node_name in assign_c.values():
+                    prior[idx[node_name]] += 1
         return BatchResult(
             assignments=assignments,
             unassigned=unassigned,
-            scores=result.scores,
-            schedulable=result.schedulable,
+            scores={names[i]: int(scores[i]) for i in range(n)},
+            schedulable={names[i]: bool(sched[i]) for i in range(n)},
         )
