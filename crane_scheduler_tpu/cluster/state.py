@@ -108,24 +108,16 @@ class ClusterState:
         self._event_index: dict[str, Event] = {}
         self._event_handlers: list[EventHandler] = []
         self._rv = itertools.count(1)
-        self._version = 0
         self._sched_version = 0
 
     @property
-    def version(self) -> int:
-        """Monotonic mutation counter (an informer resourceVersion
-        stand-in): bumps on any node/pod change, so readers can cache
-        derived views until it moves."""
-        with self._lock:
-            return self._version
-
-    @property
     def sched_version(self) -> int:
-        """Like ``version`` but bumps only on changes a scheduling
-        snapshot can observe: node add/delete/annotation, bound-pod
-        add/delete/annotation, and binds. Adding or annotating a pending
-        (unbound) pod does NOT bump it, so drip scheduling (add pod,
-        schedule, repeat) can reuse a cached snapshot."""
+        """Monotonic mutation counter (an informer resourceVersion
+        stand-in) over changes a scheduling snapshot can observe: node
+        add/delete/annotation, bound-pod add/delete/annotation, and
+        binds. Adding or annotating a pending (unbound) pod does NOT
+        bump it, so drip scheduling (add pod, schedule, repeat) can
+        reuse a cached snapshot."""
         with self._lock:
             return self._sched_version
 
@@ -134,13 +126,11 @@ class ClusterState:
     def add_node(self, node: Node) -> None:
         with self._lock:
             self._nodes[node.name] = node
-            self._version += 1
             self._sched_version += 1
 
     def delete_node(self, name: str) -> None:
         with self._lock:
             self._nodes.pop(name, None)
-            self._version += 1
             self._sched_version += 1
 
     def get_node(self, name: str) -> Node | None:
@@ -164,7 +154,6 @@ class ClusterState:
             anno = dict(node.annotations)
             anno[key] = value
             self._nodes[name] = replace(node, annotations=anno)
-            self._version += 1
             self._sched_version += 1
             return True
 
@@ -174,7 +163,6 @@ class ClusterState:
         with self._lock:
             prev = self._pods.get(pod.key())
             self._pods[pod.key()] = pod
-            self._version += 1
             # replacing a bound pod is a bound-pod delete for snapshots
             if pod.node_name or (prev is not None and prev.node_name):
                 self._sched_version += 1
@@ -182,7 +170,6 @@ class ClusterState:
     def delete_pod(self, key: str) -> None:
         with self._lock:
             pod = self._pods.pop(key, None)
-            self._version += 1
             if pod is not None and pod.node_name:
                 self._sched_version += 1
 
@@ -206,7 +193,6 @@ class ClusterState:
             anno = dict(pod.annotations)
             anno[anno_key] = value
             self._pods[key] = replace(pod, annotations=anno)
-            self._version += 1
             if pod.node_name:
                 self._sched_version += 1
             return True
@@ -221,7 +207,6 @@ class ClusterState:
             if pod is None:
                 return False
             self._pods[pod_key] = replace(pod, node_name=node_name)
-            self._version += 1
             self._sched_version += 1
         self.emit_event(
             Event(

@@ -611,6 +611,33 @@ def test_mixed_batch_daemonset_bypasses_filter():
     assert sim.cluster.get_pod(ds.key()).node_name == result.assignments[ds.key()]
 
 
+def test_pipelined_batches_match_sequential():
+    """Double-buffered scheduling must produce the same per-batch results
+    as sequential schedule_batch when scores are static within the sync
+    window (the reference's invariant: scores only move when annotations
+    change), and all assigned pods really bind."""
+    sim_a = make_sim(4, seed=35)
+    batch_a = sim_a.build_batch_scheduler()
+    batches_a = [[sim_a.make_pod() for _ in range(10)] for _ in range(3)]
+    seq = [batch_a.schedule_batch(b, bind=True) for b in batches_a]
+
+    sim_b = make_sim(4, seed=35)
+    batch_b = sim_b.build_batch_scheduler()
+    batches_b = [[sim_b.make_pod() for _ in range(10)] for _ in range(3)]
+    pipe = list(batch_b.schedule_batches_pipelined(batches_b, bind=True))
+
+    assert len(pipe) == 3
+    for r_seq, r_pipe in zip(seq, pipe):
+        assert r_seq.assignments.keys() == r_pipe.assignments.keys()
+        assert sorted(r_seq.assignments.values()) == sorted(
+            r_pipe.assignments.values()
+        )
+        assert r_seq.unassigned == r_pipe.unassigned
+    for r in pipe:
+        for key, node in r.assignments.items():
+            assert sim_b.cluster.get_pod(key).node_name == node
+
+
 def test_schedule_one_snapshot_cache_reuse_and_invalidation():
     """Drip scheduling must not rebuild the O(nodes+pods) snapshot per
     pod: one build serves consecutive schedule_one calls (our own binds
