@@ -216,25 +216,20 @@ def main() -> int:
     # --- bit-for-bit parity gate (BASELINE north star) -----------------
     # The device verdicts and placements must equal the exact f64/Go
     # semantics on this 50k-node snapshot — computed, not assumed.
-    from crane_scheduler_tpu.scorer.hybrid import score_rows_f64
-    from crane_scheduler_tpu.scorer.topk import gang_assign_host
+    from crane_scheduler_tpu.scorer.parity import ParityError, check_placement_parity
 
     t0 = time.perf_counter()
-    sched64, score64 = score_rows_f64(values, ts, hot_value, hot_ts, now, tensors)
-    sched64 &= node_valid
-    score64 = np.where(node_valid, score64, 0)
-    dev_sched = np.asarray(result.schedulable)
-    dev_scores = np.asarray(result.scores)
-    if not (dev_sched == sched64).all():
-        raise SystemExit("PARITY FAIL: device filter verdicts != f64 oracle")
-    if not (dev_scores == score64).all():
-        diff = int((dev_scores != score64).sum())
-        raise SystemExit(f"PARITY FAIL: {diff} device scores != f64 oracle")
-    want = gang_assign_host(
-        score64, sched64, N_PODS, tensors.hv_count, capacity=capacity
-    )
-    if not (counts == want.counts).all() or unassigned != want.unassigned:
-        raise SystemExit("PARITY FAIL: device placements != f64 water-filling")
+    try:
+        check_placement_parity(
+            values=values, ts=ts, hot_value=hot_value, hot_ts=hot_ts,
+            node_valid=node_valid, now=now, tensors=tensors,
+            schedulable=np.asarray(result.schedulable),
+            scores=np.asarray(result.scores),
+            counts=counts, num_pods=N_PODS, capacity=capacity,
+            unassigned=unassigned,
+        )
+    except ParityError as e:
+        raise SystemExit(f"PARITY FAIL: {e}")
     log(
         f"parity: ok (scores, filter verdicts, and all {assigned} placements "
         f"bit-identical to f64/Go semantics; checked in "

@@ -60,15 +60,27 @@ def _sim(n_nodes, policy=None, seed=0):
     return sim
 
 
-def config1():
+def config1(dtype):
+    """BASELINE config 1 as a parity run: the reference-shaped plugin
+    scheduler and the TPU batch path must pick the same node for the
+    canonical cpu-stress pod (ref: examples/cpu_stress.yaml e2e check,
+    README.md:155-197)."""
     sim = _sim(3)
     sched = sim.build_scheduler()
     pod = sim.make_pod(cpu_milli=1000, mem=1 << 30)
     t0 = time.perf_counter()
     result = sched.schedule_one(pod)
     ms = (time.perf_counter() - t0) * 1e3
+    # TPU path on an identical twin cluster: same placement, bit-for-bit
+    twin = _sim(3)
+    batch = twin.build_batch_scheduler(dtype=dtype, bucket=8)
+    twin_pod = twin.make_pod(cpu_milli=1000, mem=1 << 30)
+    batch_result = batch.schedule_batch([twin_pod], bind=True)
+    batch_node = batch_result.assignments.get(twin_pod.key())
     emit({"config": 1, "desc": "1 cpu-stress pod, 3 nodes, default policy",
-          "node": result.node, "latency_ms": round(ms, 3)})
+          "node": result.node, "latency_ms": round(ms, 3),
+          "tpu_batch_node": batch_node,
+          "parity": "ok" if batch_node == result.node else "FAIL"})
 
 
 def _policy_cpu_mem_5m():
@@ -96,25 +108,59 @@ def _run_batch(sim, n_pods, dtype, rtt, bucket=2048):
         result = batch.schedule_batch(pods, bind=False)
         lat.append((time.perf_counter() - t0) * 1e3)
     steady = float(np.median(lat))
+    parity = _batch_parity(batch, result, n_pods)
     # schedule_batch performs exactly one device fetch; on the tunneled dev
     # runtime that sync costs `rtt` ms that no local deployment pays
-    return result, warm_ms, steady, max(steady - rtt, 0.0)
+    return result, warm_ms, steady, max(steady - rtt, 0.0), parity
+
+
+def _batch_parity(batch, result, n_pods) -> str:
+    """Device verdicts + per-node placement counts vs the exact f64/Go
+    host path on the same store snapshot (computed, not assumed; shared
+    gate: crane_scheduler_tpu.scorer.parity)."""
+    from crane_scheduler_tpu.scorer.parity import ParityError, check_placement_parity
+
+    snap = batch.store.snapshot()
+    now = batch._clock()
+    names = snap.node_names
+    n = snap.n_nodes
+    index = {name: i for i, name in enumerate(names)}
+    got = np.zeros(n, np.int64)
+    for node in result.assignments.values():
+        got[index[node]] += 1
+    try:
+        check_placement_parity(
+            values=snap.values[:n], ts=snap.ts[:n],
+            hot_value=snap.hot_value[:n], hot_ts=snap.hot_ts[:n],
+            node_valid=snap.node_valid[:n], now=now, tensors=batch.tensors,
+            schedulable=np.asarray([result.schedulable[m] for m in names]),
+            scores=np.asarray([result.scores[m] for m in names]),
+            counts=got, num_pods=n_pods,
+            unassigned=len(result.unassigned),
+        )
+    except ParityError as e:
+        return f"FAIL: {e}"
+    return "ok"
 
 
 def config2(dtype, rtt):
     sim = _sim(1000, policy=_policy_cpu_mem_5m(), seed=2)
-    result, warm, steady, exec_ms = _run_batch(sim, 1000, dtype, rtt)
+    result, warm, steady, exec_ms, parity = _run_batch(sim, 1000, dtype, rtt)
     emit({"config": 2, "desc": "1k pods / 1k nodes, cpu+mem avg_5m weights",
           "assigned": len(result.assignments), "first_ms": round(warm, 1),
-          "steady_ms": round(steady, 2), "minus_rtt_ms": round(exec_ms, 2)})
+          "steady_ms": round(steady, 2), "minus_rtt_ms": round(exec_ms, 2),
+          "parity": parity})
 
 
 def config3(dtype, rtt):
     sim = _sim(10_000, seed=3)
-    result, warm, steady, exec_ms = _run_batch(sim, 10_000, dtype, rtt, bucket=16384)
+    result, warm, steady, exec_ms, parity = _run_batch(
+        sim, 10_000, dtype, rtt, bucket=16384
+    )
     emit({"config": 3, "desc": "10k pods / 10k nodes, full policy",
           "assigned": len(result.assignments), "first_ms": round(warm, 1),
-          "steady_ms": round(steady, 2), "minus_rtt_ms": round(exec_ms, 2)})
+          "steady_ms": round(steady, 2), "minus_rtt_ms": round(exec_ms, 2),
+          "parity": parity})
 
 
 def _amortized_step_ms(step, prepared, num_pods, rtt, batches=8, k=20):
@@ -232,7 +278,7 @@ def main(argv=None) -> int:
     log(f"devices: {jax.devices()}, dtype: {dtype}, sync rtt: {rtt:.2f} ms")
     todo = {int(c) for c in args.configs.split(",")}
     if 1 in todo:
-        config1()
+        config1(dtype)
     if 2 in todo:
         config2(dtype, rtt)
     if 3 in todo:

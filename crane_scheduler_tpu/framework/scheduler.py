@@ -339,12 +339,15 @@ class BatchScheduler:
         buffering; gains saturate around depth 4).
 
         ``batches`` is an iterable of pod lists; yields one BatchResult
-        per batch, in order. Trade-off vs sequential ``schedule_batch``:
-        a cycle's snapshot cannot see the previous ``depth - 1`` cycles'
-        binds (bounded lag in the event->hot-value feedback); within one
-        annotator sync window node scores are static (ref: SURVEY §3.4 —
-        scores only move when annotations change), so results are
-        otherwise identical."""
+        per batch, in order. NOTE: this is a generator — nothing is
+        dispatched or bound until it is iterated; consume it fully
+        (``for result in ...`` or ``list(...)``) or the batches are
+        silently never scheduled. Trade-off vs sequential
+        ``schedule_batch``: a cycle's snapshot cannot see the previous
+        ``depth - 1`` cycles' binds (bounded lag in the event->hot-value
+        feedback); within one annotator sync window node scores are
+        static (ref: SURVEY §3.4 — scores only move when annotations
+        change), so results are otherwise identical."""
         from collections import deque
 
         if depth < 1:
