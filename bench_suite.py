@@ -121,7 +121,7 @@ def _batch_parity(batch, result, n_pods) -> str:
     from crane_scheduler_tpu.scorer.parity import ParityError, check_placement_parity
 
     snap = batch.store.snapshot()
-    now = batch._clock()
+    now = result.now  # the time the device actually scored at
     names = snap.node_names
     n = snap.n_nodes
     index = {name: i for i, name in enumerate(names)}

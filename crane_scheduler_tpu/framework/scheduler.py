@@ -203,6 +203,8 @@ class BatchResult:
     unassigned: list  # pod keys with no capacity
     scores: dict  # node name -> int score
     schedulable: dict  # node name -> bool
+    now: float = 0.0  # scheduling time the device scored at (parity gates
+    # must oracle at THIS time, not a later clock read)
 
 
 class BatchScheduler:
@@ -320,6 +322,7 @@ class BatchScheduler:
             self._sharded.packed(prepared, len(pods), now=now)
         )  # the cycle's single device->host fetch
         result = self._build_result(packed, [pod.key() for pod in pods])
+        result.now = now
 
         if bind:
             for pod_key, node_name in result.assignments.items():
@@ -372,6 +375,7 @@ class BatchScheduler:
         dev, keys, now, names, n = pending
         packed = np.asarray(dev)  # the only synchronization point
         result = self._build_result(packed, keys, names=names, n=n)
+        result.now = now
         if bind:
             for pod_key, node_name in result.assignments.items():
                 self.cluster.bind_pod(pod_key, node_name, now)
@@ -563,6 +567,7 @@ class BatchScheduler:
         packed = np.asarray(step.packed(gang_prepared, count, now=now))
         keys = [f"{template.namespace}/{template.name}-{i}" for i in range(count)]
         result = self._build_result(packed, keys)
+        result.now = now
 
         if bind:
             result = self._bind_gang_with_recovery(
@@ -682,6 +687,7 @@ class BatchScheduler:
             unassigned=list(result.unassigned) + unplaced,
             scores=result.scores,
             schedulable=result.schedulable,
+            now=result.now,
         )
 
     def _bind_recover_loop(
@@ -899,4 +905,5 @@ class BatchScheduler:
             unassigned=unassigned,
             scores={names[i]: int(scores[i]) for i in range(n)},
             schedulable={names[i]: bool(sched[i]) for i in range(n)},
+            now=now,
         )

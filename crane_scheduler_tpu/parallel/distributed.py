@@ -96,6 +96,12 @@ def prepare_from_local_shard(
 
     The local shard length must be equal across hosts (pad each host's
     store snapshot to the same bucket multiple).
+
+    For a hybrid step (f32 with f64 rescue rows — scorer.hybrid), each
+    host computes the exact f64 rescue vectors for ITS shard only and
+    they assemble globally like every other node-axis vector, so
+    multi-host f32 placements keep bit-for-bit Go/f64 parity without any
+    host ever seeing the full load matrix.
     """
     import jax.numpy as jnp
 
@@ -118,6 +124,27 @@ def prepare_from_local_shard(
         offsets = np.zeros((n,), dtype=np.int32)
     mesh = step.mesh
     np_dtype = np.dtype(dtype)
+    ovr = {}
+    if getattr(step, "hybrid", False):
+        from ..scorer.hybrid import compute_overrides
+
+        ovr_mask, ovr_sched, ovr_score, _ = compute_overrides(
+            step.tensors,
+            snapshot.values,
+            snapshot.ts,
+            snapshot.hot_value,
+            snapshot.hot_ts,
+            snapshot.node_valid,
+            float(now),
+        )
+        ovr = {
+            "ovr_mask": host_local_to_global(np.asarray(ovr_mask, bool), mesh),
+            "ovr_sched": host_local_to_global(np.asarray(ovr_sched, bool), mesh),
+            "ovr_score": host_local_to_global(
+                np.asarray(ovr_score, np.int32), mesh
+            ),
+            "ovr_now": float(now),
+        }
     return PreparedSnapshot(
         values=host_local_to_global(
             np.asarray(snapshot.values, np_dtype), mesh
@@ -134,4 +161,5 @@ def prepare_from_local_shard(
         capacity=host_local_to_global(np.asarray(capacity, np.int64), mesh),
         offsets=host_local_to_global(np.asarray(offsets, np.int32), mesh),
         epoch=epoch,
+        **ovr,
     )

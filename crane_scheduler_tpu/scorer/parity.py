@@ -45,10 +45,17 @@ def check_placement_parity(
     num_pods,
     capacity=None,
     unassigned=None,
+    offsets=None,
+    dynamic_weight: int = 1,
+    max_offset: int = 0,
+    prior=None,
 ):
     """Raise ``ParityError`` unless the device verdicts, scores, and
     per-node placement counts equal the exact f64 scoring + host
-    water-filling on the same inputs. Returns the oracle
+    water-filling on the same inputs. ``offsets``/``dynamic_weight``/
+    ``max_offset``/``prior`` must mirror the gang parameters the device
+    step solved with (combined-score mode); the defaults are the plain
+    Dynamic-score domain. Returns the oracle
     ``(sched64, score64, gang_result)`` for further inspection."""
     sched64, score64 = f64_verdicts(
         values, ts, hot_value, hot_ts, node_valid, now, tensors
@@ -59,7 +66,9 @@ def check_placement_parity(
     if not (dev_scores == score64).all():
         raise ParityError(f"{int((dev_scores != score64).sum())} device scores != f64 oracle")
     want = gang_assign_host(
-        score64, sched64, num_pods, tensors.hv_count, capacity=capacity
+        score64, sched64, num_pods, tensors.hv_count, capacity=capacity,
+        offsets=offsets, dynamic_weight=dynamic_weight,
+        max_offset=max_offset, prior=prior,
     )
     if not (np.asarray(counts) == np.asarray(want.counts)).all():
         raise ParityError("device placements != f64 water-filling")

@@ -87,8 +87,26 @@ def main() -> int:
         step, snap, NOW, capacity=capacity, offsets=offsets
     )
     packed = np.asarray(step.packed(prepared, NUM_PODS))
+
+    # hybrid f32 across hosts: per-shard f64 rescue vectors assemble
+    # globally; the packed result must equal the f64 run bit-for-bit
+    step_h = ShardedScheduleStep(
+        tensors, mesh, dtype=jnp.float32, dynamic_weight=3, max_offset=200,
+        hybrid=True,
+    )
+    prepared_h = prepare_from_local_shard(
+        step_h, snap, NOW, capacity=capacity, offsets=offsets
+    )
+    packed_h = np.asarray(step_h.packed(prepared_h, NUM_PODS))
+
     print(
-        json.dumps({"process": process_id, "packed": packed.tolist()}),
+        json.dumps(
+            {
+                "process": process_id,
+                "packed": packed.tolist(),
+                "packed_hybrid": packed_h.tolist(),
+            }
+        ),
         flush=True,
     )
     return 0

@@ -81,3 +81,6 @@ def test_two_process_dcn_matches_single_process():
         payload = json.loads(out.strip().splitlines()[-1])
         got = np.asarray(payload["packed"])
         np.testing.assert_array_equal(got, want)
+        # multi-host hybrid f32 (per-shard f64 rescue rows) == f64 run
+        got_hybrid = np.asarray(payload["packed_hybrid"])
+        np.testing.assert_array_equal(got_hybrid, want)
