@@ -321,8 +321,7 @@ class BatchScheduler:
         packed = np.asarray(
             self._sharded.packed(prepared, len(pods), now=now)
         )  # the cycle's single device->host fetch
-        result = self._build_result(packed, [pod.key() for pod in pods])
-        result.now = now
+        result = self._build_result(packed, [pod.key() for pod in pods], now=now)
 
         if bind:
             for pod_key, node_name in result.assignments.items():
@@ -374,8 +373,7 @@ class BatchScheduler:
 
         dev, keys, now, names, n = pending
         packed = np.asarray(dev)  # the only synchronization point
-        result = self._build_result(packed, keys, names=names, n=n)
-        result.now = now
+        result = self._build_result(packed, keys, now=now, names=names, n=n)
         if bind:
             for pod_key, node_name in result.assignments.items():
                 self.cluster.bind_pod(pod_key, node_name, now)
@@ -399,9 +397,10 @@ class BatchScheduler:
         unassigned = list(keys[len(order):])
         return assignments, unassigned
 
-    def _build_result(self, packed, keys, names=None, n=None) -> BatchResult:
+    def _build_result(self, packed, keys, now=0.0, names=None, n=None) -> BatchResult:
         """``names``/``n`` default to the current prepared snapshot; the
-        pipelined path passes the values captured at dispatch time."""
+        pipelined path passes the values captured at dispatch time.
+        ``now`` is the scheduling time the device scored at."""
         if names is None:
             names = self._prepared_names
         if n is None:
@@ -413,6 +412,7 @@ class BatchScheduler:
             unassigned=unassigned,
             scores={names[i]: int(scores[i]) for i in range(n)},
             schedulable={names[i]: bool(schedulable[i]) for i in range(n)},
+            now=now,
         )
 
     # -- combined-score gang mode (Dynamic + NodeResourceTopology) ---------
@@ -566,8 +566,7 @@ class BatchScheduler:
 
         packed = np.asarray(step.packed(gang_prepared, count, now=now))
         keys = [f"{template.namespace}/{template.name}-{i}" for i in range(count)]
-        result = self._build_result(packed, keys)
-        result.now = now
+        result = self._build_result(packed, keys, now=now)
 
         if bind:
             result = self._bind_gang_with_recovery(

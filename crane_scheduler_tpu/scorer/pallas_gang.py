@@ -1,0 +1,170 @@
+"""Fused Pallas TPU kernel for the gang solver's level-table totals.
+
+The water-filling gang solver's dominant cost is ``totals[L] = Σ_n
+A_n(L)`` over the [n_levels, N] token table (see ``topk.GangScheduler``,
+ref semantics: pkg/plugins/dynamic/plugins.go:89-91 applied in-batch).
+The XLA path materializes that table through HBM; this kernel streams
+node blocks through VMEM instead — per block it builds the (L, BN) table
+in registers/VMEM, reduces over the lane (node) axis, and accumulates
+the per-level partial totals into a single resident output across the
+sequential TPU grid. The hotValue penalty staircase g, the combined
+weight, and the level count are kernel constants unrolled at trace time
+(the g lookup is the same 11-way select chain the XLA path uses — a
+dynamic gather of a tiny table is pathological on TPU).
+
+Waterline selection and the node-order prefix split stay on the XLA path
+(O(n_levels) + O(N) elementwise — nothing left to fuse); results are
+bit-identical to ``GangScheduler`` and the sequential oracle, tested in
+interpret mode on CPU and compiled on TPU.
+
+**Measured outcome (v5e, 50k nodes, 100k pods): XLA wins.** The fused
+XLA totals run ~0.04ms/step vs ~0.12ms for this kernel (combined mode
+wider) — XLA already streams the level table through fusion without an
+HBM round-trip, exactly as the pallas guide warns ("don't hand-schedule
+what the compiler already does"). The kernel is kept as a parity-tested
+alternative backend (guards against future XLA fusion regressions and
+exercises the Mosaic int-op quirks documented below), NOT as a default:
+``GangScheduler`` remains the production solver everywhere. A
+pallas_call is also opaque to GSPMD partitioning, so the mesh-sharded
+``ShardedScheduleStep`` could never use it.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from ..constants import MAX_NODE_SCORE
+from .topk import GangScheduler
+
+_LANE = 128  # f32/i32 lane tile; node blocks are multiples of this
+
+
+class PallasGangScheduler(GangScheduler):
+    """``GangScheduler`` with the O(n_levels · N) totals fused in Pallas.
+
+    Same constructor and ``__call__`` contract (scores, schedulable,
+    num_pods, capacity, offsets, prior); only ``_totals`` differs. The
+    node axis is padded to a lane multiple inside the jitted step with
+    zero-capacity lanes, which contribute no tokens.
+    """
+
+    def __init__(
+        self,
+        hv_counts: Sequence[int],
+        dynamic_weight: int = 1,
+        max_offset: int = 0,
+        interpret: bool = False,
+    ):
+        self.interpret = interpret
+        # (L, BN) int32 temporaries must fit VMEM comfortably: cap each
+        # at ~2MB so plain mode (L=104) blocks 2048 lanes and combined
+        # mode (L~504) drops to 1024.
+        super().__init__(hv_counts, dynamic_weight, max_offset)
+        self._n_levels_pad = max(8, math.ceil(self._n_levels / 8) * 8)
+        budget_lanes = (1 << 21) // (4 * self._n_levels_pad)
+        self._bn = int(max(_LANE, min(2048, budget_lanes // _LANE * _LANE)))
+        self._kernel = self._make_kernel()
+
+    def _make_kernel(self):
+        w = int(self._weight)
+        n_levels = int(self._n_levels)
+        l_pad = int(self._n_levels_pad)
+        g = [int(v) for v in self._g_host]  # 11 static table entries
+
+        # Every scalar below is explicitly typed: under x64 a bare python
+        # int/float becomes a weak int64/f64 constant, and Mosaic's
+        # convert-element-type lowering recurses forever on 64-bit types.
+        def i32(v):
+            return jnp.asarray(v, jnp.int32)
+
+        def floordiv_pos(d, c):
+            """Exact ``d // c`` for small non-negative int32 ``d`` and a
+            static positive int ``c``. Mosaic cannot lower integer
+            floordiv (and under x64 the jnp implementation routes through
+            64-bit), so divide in f32: (d + 0.5)/c sits strictly between
+            d//c and d//c + 1 at distance >= 0.5/c from either integer —
+            far beyond f32 rounding error for d < 2^20 — so floor is
+            exact."""
+            q = jnp.floor(
+                (d.astype(jnp.float32) + jnp.float32(0.5)) / jnp.float32(c)
+            )
+            return q.astype(jnp.int32)
+
+        def kernel(s_ref, offs_ref, cap_ref, pri_ref, out_ref):
+            i = pl.program_id(0)
+            bn = s_ref.shape[1]
+            s = s_ref[0, :][None, :]  # (1, BN) int32
+            offs = offs_ref[0, :][None, :]
+            cap = cap_ref[0, :][None, :]
+            pri = pri_ref[0, :][None, :]
+            zero = i32(0)
+
+            lv = jax.lax.broadcasted_iota(jnp.int32, (l_pad, bn), 0)
+            qnum = lv - offs
+            # q only matters where qnum > 0 (else the cap override wins),
+            # so a non-negative clamp keeps floordiv_pos's domain valid
+            q = (
+                floordiv_pos(jnp.maximum(qnum, zero) + i32(w - 1), w)
+                if w != 1
+                else qnum
+            )
+            xq = jnp.clip(floordiv_pos(jnp.maximum(s - q, zero), 10), zero, i32(10))
+            unlocked = jnp.full((l_pad, bn), g[10], dtype=jnp.int32)
+            for x in range(9, -1, -1):  # 11-way select chain (see topk)
+                unlocked = jnp.where(xq <= i32(x), i32(g[x]), unlocked)
+            unlocked = jnp.where(
+                (q <= i32(MAX_NODE_SCORE)) & (s >= q), unlocked, zero
+            )
+            unlocked = jnp.maximum(unlocked - pri, zero)
+            unlocked = jnp.where(qnum <= zero, cap, unlocked)
+            a = jnp.minimum(cap, unlocked)
+            a = jnp.where(lv < i32(n_levels), a, zero)  # padded levels: none
+            # dtype pinned: under x64 an unconstrained sum accumulates
+            # int64, which Mosaic cannot lower
+            part = a.sum(axis=1, dtype=jnp.int32)  # (L_pad,)
+
+            @pl.when(i == 0)
+            def _init():
+                out_ref[...] = jnp.zeros_like(out_ref)
+
+            # TPU grids run sequentially, so accumulating into the same
+            # resident output block across steps is well-defined.
+            out_ref[...] += jnp.broadcast_to(part[:, None], out_ref.shape)
+
+        return kernel
+
+    def _totals(self, s, offs, k_cap, pri):
+        n = s.shape[0]
+        bn = self._bn if n >= self._bn else max(_LANE, math.ceil(n / _LANE) * _LANE)
+        n_pad = math.ceil(n / bn) * bn
+
+        def row(vec, fill):
+            padded = jnp.pad(vec, (0, n_pad - n), constant_values=fill)
+            return jnp.broadcast_to(padded[None, :], (8, n_pad))
+
+        l_pad = self._n_levels_pad
+        # index maps return typed zeros: under x64 a bare python 0 turns
+        # into an i64 index and Mosaic rejects the mixed-type index tuple
+        _z = lambda: jnp.asarray(0, jnp.int32)  # noqa: E731
+        vec_spec = pl.BlockSpec((8, bn), lambda i: (_z(), i))
+        out_spec = pl.BlockSpec((l_pad, _LANE), lambda i: (_z(), _z()))
+        out = pl.pallas_call(
+            self._kernel,
+            grid=(n_pad // bn,),
+            in_specs=[vec_spec, vec_spec, vec_spec, vec_spec],
+            out_specs=out_spec,
+            out_shape=jax.ShapeDtypeStruct((l_pad, _LANE), jnp.int32),
+            interpret=self.interpret,
+        )(
+            row(s, 0),
+            row(offs, 0),
+            row(k_cap, 0),  # zero-capacity pad lanes contribute nothing
+            row(pri, 0),
+        )
+        return out[: self._n_levels, 0]

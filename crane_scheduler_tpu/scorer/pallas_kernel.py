@@ -43,8 +43,8 @@ _LIMIT_F32 = np.float32(2.0**31)
 
 def _go_trunc_i32(q):
     ok = jnp.isfinite(q) & (q > -_LIMIT_F32) & (q < _LIMIT_F32)
-    safe = jnp.where(ok, jnp.trunc(q), 0.0)
-    return jnp.where(ok, safe.astype(jnp.int32), _MIN_I32)
+    safe = jnp.where(ok, jnp.trunc(q), jnp.float32(0.0))
+    return jnp.where(ok, safe.astype(jnp.int32), jnp.int32(_MIN_I32))
 
 
 def _make_kernel(tensors: PolicyTensors):
@@ -61,7 +61,11 @@ def _make_kernel(tensors: PolicyTensors):
 
     def kernel(values_ref, ts_ref, hot_ref, hot_ts_ref, valid_ref, sched_ref, score_ref):
         # refs: values/ts [M_pad, BN]; hot/hot_ts/valid [8, BN]; outputs [8, BN]
+        # All scalars below are explicitly typed: under x64 a bare python
+        # int/float becomes a weak 64-bit constant and Mosaic's
+        # convert-element-type lowering recurses forever on it.
         zero = jnp.float32(0.0)
+        izero = jnp.int32(0)
 
         over = None
         for idx, threshold, active in pred:
@@ -69,7 +73,7 @@ def _make_kernel(tensors: PolicyTensors):
                 continue
             u = values_ref[idx, :]
             t = ts_ref[idx, :]
-            ok = (zero < t + jnp.float32(active)) & ~(u < 0)
+            ok = (zero < t + jnp.float32(active)) & ~(u < zero)
             if threshold != 0.0:  # zero threshold disables the entry
                 o = ok & (u > jnp.float32(threshold))
                 over = o if over is None else (over | o)
@@ -83,12 +87,12 @@ def _make_kernel(tensors: PolicyTensors):
                 if active > 0.0:
                     u = values_ref[idx, :]
                     t = ts_ref[idx, :]
-                    ok = (zero < t + jnp.float32(active)) & ~(u < 0)
-                    contrib = (1.0 - u) * jnp.float32(weight) * jnp.float32(MAX_NODE_SCORE)
+                    ok = (zero < t + jnp.float32(active)) & ~(u < zero)
+                    contrib = (jnp.float32(1.0) - u) * jnp.float32(weight) * jnp.float32(MAX_NODE_SCORE)
                     acc = acc + jnp.where(ok, contrib, zero)
                 # inactive entries contribute 0 (weight is in weight_sum)
             if weight_sum == 0.0:
-                q = jnp.where(acc == 0.0, jnp.float32(jnp.nan), jnp.sign(acc) * jnp.float32(jnp.inf))
+                q = jnp.where(acc == zero, jnp.float32(jnp.nan), jnp.sign(acc) * jnp.float32(jnp.inf))
             else:
                 q = acc / jnp.float32(weight_sum)
             base = _go_trunc_i32(q)
@@ -97,13 +101,15 @@ def _make_kernel(tensors: PolicyTensors):
 
         hot = hot_ref[0, :]
         hot_t = hot_ts_ref[0, :]
-        hot_ok = (zero < hot_t + jnp.float32(HOT_VALUE_ACTIVE_PERIOD_SECONDS)) & ~(hot < 0)
+        hot_ok = (zero < hot_t + jnp.float32(HOT_VALUE_ACTIVE_PERIOD_SECONDS)) & ~(hot < zero)
         hv = jnp.where(hot_ok, hot, zero)
-        penalty = _go_trunc_i32(hv * 10.0)
-        score = jnp.clip(base - penalty, MIN_NODE_SCORE, MAX_NODE_SCORE)
+        penalty = _go_trunc_i32(hv * jnp.float32(10.0))
+        score = jnp.clip(
+            base - penalty, jnp.int32(MIN_NODE_SCORE), jnp.int32(MAX_NODE_SCORE)
+        )
 
-        valid = valid_ref[0, :] != 0
-        score = jnp.where(valid, score, 0)
+        valid = valid_ref[0, :] != izero
+        score = jnp.where(valid, score, izero)
         sched = (~over) & valid
 
         # broadcast payload across the 8 sublanes of the output tile
@@ -135,8 +141,11 @@ class PallasScorer:
         m_pad, n = values_t.shape
         bn = min(self.block, n)
         grid = (n // bn,)
-        row_specs = pl.BlockSpec((m_pad, bn), lambda i: (0, i))
-        vec_specs = pl.BlockSpec((8, bn), lambda i: (0, i))
+        # typed zero: a bare python 0 becomes an i64 index under x64 and
+        # Mosaic rejects the mixed-type index tuple
+        _z = lambda: jnp.asarray(0, jnp.int32)  # noqa: E731
+        row_specs = pl.BlockSpec((m_pad, bn), lambda i: (_z(), i))
+        vec_specs = pl.BlockSpec((8, bn), lambda i: (_z(), i))
         out = pl.pallas_call(
             self._kernel,
             grid=grid,

@@ -323,6 +323,23 @@ class GangScheduler:
         unlocked = jnp.where(qnum <= 0, k_cap, unlocked)
         return jnp.minimum(k_cap, unlocked)
 
+    def _totals(self, s, offs, k_cap, pri):
+        """totals[L] = Σ_n A_n(L), the number of tokens valued >= L.
+
+        Materialize the [n_levels, N] level table directly (elementwise
+        ops + one reduction over N — int32 lanes, trivial for the VPU).
+        An earlier formulation scattered breakpoint deltas into a
+        histogram; TPU lowers 1D scatter-adds poorly (and the scatter
+        emitter can abort in fusion: scatter_emitter.cc operand check),
+        so the dense table is both faster and safer here. Overridden by
+        ``pallas_gang.PallasGangScheduler`` with a fused kernel that
+        never round-trips the table through HBM.
+        """
+        levels = jnp.arange(self._n_levels, dtype=jnp.int32)
+        a_table = self._a_table(s[None, :], offs[None, :], k_cap[None, :],
+                                pri[None, :], levels[:, None])
+        return a_table.sum(axis=1, dtype=jnp.int32)
+
     def _assign_impl(self, scores, schedulable, num_pods, capacity, offsets,
                      prior):
         # All internal arithmetic is int32: int64 cumsum/reductions lower
@@ -345,16 +362,7 @@ class GangScheduler:
         pri = jnp.clip(prior.astype(jnp.int32), 0, 2**31 - 1)
         levels = jnp.arange(n_levels, dtype=jnp.int32)
 
-        # totals[L] = Σ_n A_n(L), the number of tokens valued >= L.
-        # Materialize the [n_levels, N] level table directly (elementwise
-        # ops + one reduction over N — int32 lanes, trivial for the VPU).
-        # An earlier formulation scattered breakpoint deltas into a
-        # histogram; TPU lowers 1D scatter-adds poorly (and the scatter
-        # emitter can abort in fusion: scatter_emitter.cc operand check),
-        # so the dense table is both faster and safer here.
-        a_table = self._a_table(s[None, :], offs[None, :], k_cap[None, :],
-                                pri[None, :], levels[:, None])
-        totals = a_table.sum(axis=1, dtype=jnp.int32)  # [n_levels]
+        totals = self._totals(s, offs, k_cap, pri)  # [n_levels]
 
         meets = totals >= num_pods  # True for L <= L*
         l_star = jnp.max(jnp.where(meets, levels, -1))  # -1 => capacity short

@@ -249,6 +249,36 @@ def test_prior_continuation_matches_single_shot():
     )
 
 
+@pytest.mark.parametrize("seed", range(6))
+def test_pallas_gang_random_parity(seed):
+    """The Pallas totals backend (scorer.pallas_gang, interpret mode on
+    CPU; compiled parity is exercised on TPU hardware) must match the
+    sequential oracle across plain/combined/prior configurations."""
+    from crane_scheduler_tpu.scorer.pallas_gang import PallasGangScheduler
+
+    rng = random.Random(6000 + seed)
+    n = rng.randint(1, 200)
+    weight = rng.choice([1, 3])
+    max_offset = rng.choice([0, 200])
+    scores = [rng.randint(0, 100) for _ in range(n)]
+    schedulable = [rng.random() > 0.2 for _ in range(n)]
+    p = rng.randint(0, 150)
+    hv = rng.choice([DEFAULT_HV, [1], [3, 7], []])
+    capacity = [rng.randint(0, 12) for _ in range(n)]
+    offsets = [rng.randint(0, max_offset) for _ in range(n)]
+    prior = [rng.randint(0, 4) for _ in range(n)]
+    want = gang_assign_oracle(
+        scores, schedulable, p, hv, capacity, offsets=offsets,
+        dynamic_weight=weight, max_offset=max_offset, prior=prior,
+    )
+    got = PallasGangScheduler(
+        hv, dynamic_weight=weight, max_offset=max_offset, interpret=True
+    )(scores, schedulable, p, capacity, offsets=offsets, prior=prior)
+    np.testing.assert_array_equal(np.asarray(got.counts), want.counts)
+    assert int(got.unassigned) == want.unassigned
+    assert int(got.waterline) == want.waterline
+
+
 @pytest.mark.parametrize("seed", range(10))
 def test_combined_random_parity(seed):
     rng = random.Random(1000 + seed)
