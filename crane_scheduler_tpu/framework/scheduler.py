@@ -271,6 +271,10 @@ class BatchScheduler:
         self.scorer = self._sharded.scorer
         self.gang = self._sharded.gang
         self._combined = {}  # (dyn_w, topo_w) -> combined-score step
+        # (class sig, versions) -> (offsets, capacity): _numa_vectors is
+        # O(N) Python wrapper building — at 50k nodes ~1s — so repeated
+        # gang cycles against an unchanged cluster must not re-pay it
+        self._numa_cache = {}
         # device-resident snapshot cache: (store version, padded N) it was
         # built from; an unchanged store re-dispatches with zero uploads
         self._prepared = None
@@ -455,6 +459,48 @@ class BatchScheduler:
         - non-aware: offset weight*(100 // greedy zones used), capacity
           from the pooled copies bound (see topology.batched).
         """
+        import weakref
+
+        # cache on the exact inputs the vectors derive from: the CR set
+        # (lister version), assumed pods (cache version), bound pods +
+        # node set (sched_version), the snapshot row order (store version
+        # key), and the request class. Building wrappers is O(N) Python
+        # (~1s at 50k nodes); repeated cycles against an unchanged
+        # cluster must not re-pay it.
+        lister_version = getattr(topology.lister, "version", None)
+        cache_key = None
+        if lister_version is not None:
+            cache_key = (
+                id(topology),
+                lister_version,
+                topology.cache.version,  # assumed pods feed NUMA usage
+                self.cluster.sched_version,
+                self._prepared_key,
+                n,
+                topology_weight,
+                self._class_key(template, topology),
+            )
+            hit = self._numa_cache.get(cache_key)
+            # the weakref identity check defeats id() recycling: a new
+            # TopologyMatch allocated at a freed one's address (with a
+            # fresh lister also starting at version 0) must not hit
+            if hit is not None and hit[0]() is topology:
+                return hit[1].copy(), hit[2].copy()
+
+        offsets, capacity = self._numa_vectors_uncached(
+            template, topology, topology_weight, names, n
+        )
+        if cache_key is not None:
+            while len(self._numa_cache) >= 8:
+                self._numa_cache.pop(next(iter(self._numa_cache)))
+            self._numa_cache[cache_key] = (
+                weakref.ref(topology),
+                offsets.copy(),
+                capacity.copy(),
+            )
+        return offsets, capacity
+
+    def _numa_vectors_uncached(self, template, topology, topology_weight, names, n):
         import numpy as np
 
         from ..framework.types import CycleState, NodeInfo
@@ -787,7 +833,18 @@ class BatchScheduler:
         if is_ds or s is None or not s.target_container_indices:
             return ("noop", is_ds)
         r = s.target_container_resource
-        return ("numa", s.aware, r.milli_cpu, r.memory, r.ephemeral_storage)
+        return (
+            "numa",
+            s.aware,
+            r.milli_cpu,
+            r.memory,
+            r.ephemeral_storage,
+            r.allowed_pod_number,
+            # scalar (device/extended) resources feed the NUMA fit check
+            # (helper fits/assign) — templates differing only here must
+            # not alias
+            tuple(sorted(r.scalar_resources.items())),
+        )
 
     def schedule_batch_mixed(
         self,

@@ -27,6 +27,15 @@ class PodTopologyCache:
         self._deadline: dict[str, float] = {}
         self._cleaner: threading.Thread | None = None
         self._stop = threading.Event()
+        self._version = 0
+
+    @property
+    def version(self) -> int:
+        """Monotonic mutation counter: the assumed set feeds NUMA usage
+        reconstruction, so derived views (e.g. gang capacity vectors)
+        cache against it."""
+        with self._lock:
+            return self._version
 
     def assume_pod(self, pod: Pod, zones: list[Zone], now: float | None = None) -> None:
         """ref: cache.go:53-69 — double-assume is an error."""
@@ -38,11 +47,13 @@ class PodTopologyCache:
                 raise KeyError(f"pod {key} is already assumed")
             self._topology[key] = list(zones)
             self._deadline[key] = now + self._ttl
+            self._version += 1
 
     def forget_pod(self, pod: Pod) -> None:
         """Idempotent removal (ref: cache.go:72-83)."""
         with self._lock:
-            self._topology.pop(pod.key(), None)
+            if self._topology.pop(pod.key(), None) is not None:
+                self._version += 1
             self._deadline.pop(pod.key(), None)
 
     def pod_count(self) -> int:
@@ -63,6 +74,8 @@ class PodTopologyCache:
             for k in expired:
                 self._topology.pop(k, None)
                 self._deadline.pop(k, None)
+            if expired:
+                self._version += 1
 
     def start_cleaner(self) -> None:
         if self._cleaner is not None:

@@ -126,9 +126,21 @@ class InMemoryNRTLister:
 
     def __init__(self):
         self._items: dict[str, NodeResourceTopology] = {}
+        self._version = 0
+
+    @property
+    def version(self) -> int:
+        """Monotonic CR mutation counter (informer resourceVersion
+        stand-in); lets readers cache views derived from the CR set."""
+        return self._version
 
     def upsert(self, nrt: NodeResourceTopology) -> None:
         self._items[nrt.name] = nrt
+        self._version += 1
+
+    def delete(self, name: str) -> None:
+        self._items.pop(name, None)
+        self._version += 1
 
     def get(self, name: str) -> NodeResourceTopology:
         return self._items[name]

@@ -680,6 +680,50 @@ def test_schedule_one_snapshot_cache_reuse_and_invalidation():
     assert builds["n"] == 2
 
 
+def test_numa_vectors_cache_reuse_and_invalidation(monkeypatch):
+    """Repeated gang cycles against an unchanged cluster must not re-pay
+    the O(N) wrapper build; any relevant change (a bind, a CR upsert, an
+    assume) invalidates. Cached vectors are equal to fresh ones."""
+    import numpy as np
+
+    from crane_scheduler_tpu.topology import TopologyMatch
+    from crane_scheduler_tpu.topology.types import ANNOTATION_POD_TOPOLOGY_AWARENESS
+
+    sim = make_sim(3, seed=36)
+    batch = sim.build_batch_scheduler()
+    lister = _nrt_fixture(sim, [[8000], [8000], [8000]])
+    topology = TopologyMatch(lister, cluster=sim.cluster)
+    template = sim.make_pod(cpu_milli=2000, mem=1 << 30)
+    sim.cluster.delete_pod(template.key())
+    template.annotations[ANNOTATION_POD_TOPOLOGY_AWARENESS] = "true"
+
+    builds = {"n": 0}
+    real = batch._numa_vectors_uncached
+
+    def counting(*args, **kwargs):
+        builds["n"] += 1
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(batch, "_numa_vectors_uncached", counting)
+
+    r1 = batch.schedule_gang(template, 2, topology=topology, bind=False)
+    assert builds["n"] == 1
+    r2 = batch.schedule_gang(template, 2, topology=topology, bind=False)
+    assert builds["n"] == 1  # unchanged cluster: cache hit
+    assert r1.assignments == r2.assignments
+    # the solve inside a bind=True cycle still hits (binds land after),
+    # but the NEXT cycle sees the moved sched_version and rebuilds
+    batch.schedule_gang(template, 1, topology=topology, bind=True)
+    assert builds["n"] == 1
+    batch.schedule_gang(template, 1, topology=topology, bind=False)
+    builds_after_bind = builds["n"]
+    assert builds_after_bind == 2
+    # a CR change invalidates
+    lister.upsert(lister.get(sim.cluster.list_nodes()[0].name))
+    batch.schedule_gang(template, 1, topology=topology, bind=False)
+    assert builds["n"] == builds_after_bind + 1
+
+
 def test_schedule_gang_over_admission_recovers(monkeypatch):
     """When copies-capacity over-estimates (forced here by inflating the
     estimate on the first pass), the copies the plugin's Filter rejects
