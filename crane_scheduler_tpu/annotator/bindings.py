@@ -44,11 +44,19 @@ class BindingRecords:
 
     def add_binding(self, binding: Binding) -> None:
         """Push; evict the oldest first when full (ref: binding.go:69-78)."""
+        self.add_binding_batch((binding,))
+
+    def add_binding_batch(self, bindings) -> None:
+        """Push a burst under one lock hold; the evict+push invariant
+        lives only here (``add_binding`` delegates)."""
         with self._lock:
-            if len(self._heap) == self._size:
-                heapq.heappop(self._heap)
-            self._seq += 1
-            heapq.heappush(self._heap, (binding.timestamp, self._seq, binding))
+            for binding in bindings:
+                if len(self._heap) == self._size:
+                    heapq.heappop(self._heap)
+                self._seq += 1
+                heapq.heappush(
+                    self._heap, (binding.timestamp, self._seq, binding)
+                )
 
     def get_last_node_binding_count(
         self, node: str, time_range_seconds: float, now: float | None = None

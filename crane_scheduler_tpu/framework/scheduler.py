@@ -328,8 +328,7 @@ class BatchScheduler:
         result = self._build_result(packed, [pod.key() for pod in pods], now=now)
 
         if bind:
-            for pod_key, node_name in result.assignments.items():
-                self.cluster.bind_pod(pod_key, node_name, now)
+            self.cluster.bind_pods(result.assignments, now)
         return result
 
     def schedule_batches_pipelined(self, batches, bind: bool = True,
@@ -379,8 +378,7 @@ class BatchScheduler:
         packed = np.asarray(dev)  # the only synchronization point
         result = self._build_result(packed, keys, now=now, names=names, n=n)
         if bind:
-            for pod_key, node_name in result.assignments.items():
-                self.cluster.bind_pod(pod_key, node_name, now)
+            self.cluster.bind_pods(result.assignments, now)
         return result
 
     @staticmethod

@@ -54,6 +54,30 @@ class NativeBindingRecords:
                 self._handle, self._intern(binding.node), int(binding.timestamp)
             )
 
+    def add_binding_batch(self, bindings) -> None:
+        """Push a burst in one FFI crossing (identical semantics and
+        order to per-binding ``add_binding``)."""
+        bindings = list(bindings)  # iterables OK, like the Python backend
+        if not bindings:
+            return
+        with self._lock:
+            ids = np.fromiter(
+                (self._intern(b.node) for b in bindings),
+                dtype=np.int32,
+                count=len(bindings),
+            )
+            ts = np.fromiter(
+                (int(b.timestamp) for b in bindings),
+                dtype=np.int64,
+                count=len(bindings),
+            )
+            self._lib.crane_bindings_add_batch(
+                self._handle,
+                ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                ts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                len(bindings),
+            )
+
     def get_last_node_binding_count(
         self, node: str, time_range_seconds: float, now: float | None = None
     ) -> int:

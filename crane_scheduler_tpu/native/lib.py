@@ -32,6 +32,9 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.crane_bindings_len.argtypes = [ctypes.c_void_p]
     lib.crane_bindings_len.restype = i64
     lib.crane_bindings_add.argtypes = [ctypes.c_void_p, i32, i64]
+    lib.crane_bindings_add_batch.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), p_i64, i64,
+    ]
     lib.crane_bindings_count.argtypes = [ctypes.c_void_p, i32, i64, i64]
     lib.crane_bindings_count.restype = i64
     lib.crane_bindings_counts_batch.argtypes = [

@@ -75,6 +75,15 @@ void crane_bindings_add(void* handle, int32_t node_id, int64_t timestamp) {
   std::push_heap(h->heap.begin(), h->heap.end(), binding_greater);
 }
 
+// Batch push (event-burst ingestion): one FFI crossing per burst; the
+// evict+push invariant lives only in crane_bindings_add.
+void crane_bindings_add_batch(void* handle, const int32_t* node_ids,
+                              const int64_t* timestamps, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    crane_bindings_add(handle, node_ids[i], timestamps[i]);
+  }
+}
+
 // Count bindings for one node strictly newer than now - window
 // (ref: binding.go:81-97).
 int64_t crane_bindings_count(void* handle, int32_t node_id,

@@ -146,6 +146,43 @@ def test_bind_pod_emits_parseable_event():
     assert cluster.get_pod("prod/web-1").node_name == "node-3"
 
 
+def test_bind_pods_batch_matches_sequential():
+    """bind_pods must be observationally identical to per-pod bind_pod:
+    same placements, same parseable events in bind order (hot-value
+    feedback included), missing pods skipped."""
+    from crane_scheduler_tpu.cluster import Pod
+
+    def build():
+        cluster = ClusterState()
+        br = BindingRecords(64, 300.0)
+        ing = EventIngestor(cluster, br)
+        ing.start()
+        for i in range(5):
+            cluster.add_pod(Pod(name=f"w-{i}", namespace="prod"))
+        return cluster, br, ing
+
+    assignments = {f"prod/w-{i}": f"node-{i % 2}" for i in range(5)}
+    assignments["prod/missing"] = "node-9"
+
+    c_seq, br_seq, _ = build()
+    for key, node in assignments.items():
+        c_seq.bind_pod(key, node, NOW)
+    c_bat, br_bat, ing_bat = build()
+    bound = c_bat.bind_pods(assignments, NOW)
+
+    assert bound == [f"prod/w-{i}" for i in range(5)]  # bind order kept
+    assert ing_bat.translated == 5 and ing_bat.rejected == 0
+    for node in ("node-0", "node-1", "node-9"):
+        assert br_bat.get_last_node_binding_count(node, 300.0, NOW) == (
+            br_seq.get_last_node_binding_count(node, 300.0, NOW)
+        )
+    for i in range(5):
+        assert c_bat.get_pod(f"prod/w-{i}").node_name == f"node-{i % 2}"
+    assert [e.message for e in c_bat.list_events()] == [
+        e.message for e in c_seq.list_events()
+    ]
+
+
 # --- Work queue -------------------------------------------------------------
 
 
