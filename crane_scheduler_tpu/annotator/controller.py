@@ -36,6 +36,7 @@ from ..constants import (
 from ..loadstore.codec import (
     decode_annotation_or_missing,
     encode_annotation,
+    go_parse_float,
 )
 from ..loadstore.store import NodeLoadStore
 from ..metrics.source import MetricsQueryError, MetricsSource
@@ -292,6 +293,15 @@ class NodeAnnotator:
         metric_ts: list[float] = []
         hot_vals: list[float] = []
         hot_ts: list[float] = []
+        # The direct-store write must be bit-identical to a future
+        # re-ingest of the emitted annotation string (the timestamp
+        # truncates to seconds in the wire format). Every row in this
+        # sweep shares ONE encoded timestamp, so decode it once instead
+        # of round-tripping "value,ts" through the full codec per node —
+        # decode of our own encode reduces to go_parse_float(value) +
+        # this shared parsed ts (values are float-formatted, comma-free).
+        _, shared_ts = decode_annotation_or_missing(encode_annotation("0", now))
+        nan, neg_inf = float("nan"), float("-inf")
         for node in self.cluster.list_nodes():
             value = by_host.get(node.internal_ip()) or by_host.get(node.name)
             if not value:
@@ -304,17 +314,16 @@ class NodeAnnotator:
                 hot = self.hot_value(node.name, now)
             hot_anno = encode_annotation(str(hot), now)
             if direct:
-                # Store first, annotation later (the async emit): decode
-                # the encoded string so the direct write is bit-identical
-                # to a future re-ingest of the same annotation (the
-                # timestamp truncates to seconds in the wire format).
-                v, ts = decode_annotation_or_missing(anno)
-                hv, hts = decode_annotation_or_missing(hot_anno)
+                v = go_parse_float(value)
+                if v is None or shared_ts == neg_inf:
+                    v, ts = nan, neg_inf
+                else:
+                    ts = shared_ts
                 names.append(node.name)
                 metric_vals.append(v)
                 metric_ts.append(ts)
-                hot_vals.append(hv)
-                hot_ts.append(hts)
+                hot_vals.append(float(hot) if shared_ts != neg_inf else nan)
+                hot_ts.append(shared_ts)
                 self._emit_annotation(node.name, metric_name, anno)
                 self._emit_annotation(node.name, NODE_HOT_VALUE_KEY, hot_anno)
             else:

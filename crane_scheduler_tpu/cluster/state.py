@@ -104,6 +104,10 @@ class ClusterState:
         self._lock = threading.RLock()
         self._nodes: dict[str, Node] = {}
         self._pods: dict[str, Pod] = {}
+        # per-node bound-pod key index (insertion-ordered) so
+        # list_pods(node) is O(pods on node), not O(all pods) — metric
+        # streams and per-pod bind filters call it per node
+        self._pods_by_node: dict[str, dict[str, None]] = {}
         self._events: deque[Event] = deque(maxlen=max_events)
         self._event_index: dict[str, Event] = {}
         self._event_handlers: list[EventHandler] = []
@@ -160,10 +164,25 @@ class ClusterState:
 
     # -- pods --------------------------------------------------------------
 
+    def _index_remove(self, pod: Pod) -> None:
+        if pod.node_name:
+            keys = self._pods_by_node.get(pod.node_name)
+            if keys is not None:
+                keys.pop(pod.key(), None)
+                if not keys:
+                    del self._pods_by_node[pod.node_name]
+
+    def _index_add(self, pod: Pod) -> None:
+        if pod.node_name:
+            self._pods_by_node.setdefault(pod.node_name, {})[pod.key()] = None
+
     def add_pod(self, pod: Pod) -> None:
         with self._lock:
             prev = self._pods.get(pod.key())
+            if prev is not None:
+                self._index_remove(prev)
             self._pods[pod.key()] = pod
+            self._index_add(pod)
             # replacing a bound pod is a bound-pod delete for snapshots
             if pod.node_name or (prev is not None and prev.node_name):
                 self._sched_version += 1
@@ -171,6 +190,8 @@ class ClusterState:
     def delete_pod(self, key: str) -> None:
         with self._lock:
             pod = self._pods.pop(key, None)
+            if pod is not None:
+                self._index_remove(pod)
             if pod is not None and pod.node_name:
                 self._sched_version += 1
 
@@ -180,10 +201,18 @@ class ClusterState:
 
     def list_pods(self, node_name: str | None = None) -> list[Pod]:
         with self._lock:
-            pods = list(self._pods.values())
-        if node_name is not None:
-            pods = [p for p in pods if p.node_name == node_name]
-        return pods
+            if node_name is None:
+                return list(self._pods.values())
+            keys = self._pods_by_node.get(node_name)
+            if not keys:
+                return []
+            return [self._pods[k] for k in keys]
+
+    def count_pods(self, node_name: str) -> int:
+        """Bound pods on ``node_name`` — O(1) via the per-node index."""
+        with self._lock:
+            keys = self._pods_by_node.get(node_name)
+            return len(keys) if keys else 0
 
     def patch_pod_annotation(self, key: str, anno_key: str, value: str) -> bool:
         """PreBind's write primitive (ref: noderesourcetopology/binder.go:19-65)."""
@@ -223,7 +252,10 @@ class ClusterState:
                 pod = self._pods.get(pod_key)
                 if pod is None:
                     continue
-                self._pods[pod_key] = replace(pod, node_name=node_name)
+                self._index_remove(pod)
+                new_pod = replace(pod, node_name=node_name)
+                self._pods[pod_key] = new_pod
+                self._index_add(new_pod)
                 self._sched_version += 1
                 bound.append(pod_key)
                 event = Event(
@@ -237,11 +269,8 @@ class ClusterState:
                     ),
                     count=1,
                     last_timestamp=now,
-                    resource_version=next(self._rv),
                 )
-                self._events.append(event)
-                self._event_index[f"{event.namespace}/{event.name}"] = event
-                stamped.append(event)
+                stamped.append(self._record_event_locked(event))
             handlers = list(self._event_handlers)
             batch_handlers = list(self._batch_handlers)
         for event in stamped:
@@ -254,11 +283,17 @@ class ClusterState:
 
     # -- events ------------------------------------------------------------
 
+    def _record_event_locked(self, event: Event) -> Event:
+        """Stamp + append + index an event; the recording invariant lives
+        only here (callers hold the lock)."""
+        event = replace(event, resource_version=next(self._rv))
+        self._events.append(event)
+        self._event_index[f"{event.namespace}/{event.name}"] = event
+        return event
+
     def emit_event(self, event: Event) -> None:
         with self._lock:
-            event = replace(event, resource_version=next(self._rv))
-            self._events.append(event)
-            self._event_index[f"{event.namespace}/{event.name}"] = event
+            event = self._record_event_locked(event)
             handlers = list(self._event_handlers)
             batch_handlers = list(self._batch_handlers)
         for handler in handlers:

@@ -104,7 +104,7 @@ class Simulator:
 
     def _stream(self, node_name: str, metric: str):
         def current() -> float:
-            bound = len(self.cluster.list_pods(node_name))
+            bound = self.cluster.count_pods(node_name)
             load = self._base[(node_name, metric)] + self.config.per_pod_load * bound
             return max(0.0, min(1.0, load))
 
