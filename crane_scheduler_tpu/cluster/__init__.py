@@ -1,3 +1,4 @@
+from .kube import KubeClusterClient
 from .state import (
     Container,
     ResourceRequirements,
@@ -18,4 +19,5 @@ __all__ = [
     "Event",
     "OwnerReference",
     "ClusterState",
+    "KubeClusterClient",
 ]

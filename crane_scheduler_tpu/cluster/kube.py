@@ -1,0 +1,455 @@
+"""Kubernetes apiserver client: the deployment-grade ClusterState.
+
+The reference coordinates everything through the kube-apiserver —
+client-go informers for reads, JSON merge-patches for annotation writes
+(ref: pkg/controller/annotator/node.go:123-146), the pod ``binding``
+subresource for binds, and a server-side-filtered Event watch
+(ref: cmd/controller/app/options/factory.go:25-33). This module is the
+same architecture in stdlib Python:
+
+- **Reads are informer-style**: background watch threads mirror nodes,
+  pods, and events into an in-memory ``ClusterState``; every consumer
+  (annotator, scheduler, store refresh) reads the mirror exactly as it
+  reads the simulator's cluster — snapshot semantics, no per-read HTTP.
+- **Writes go through the API**: ``patch_node_annotation`` /
+  ``patch_pod_annotation`` send strategic-merge patches
+  (``{"metadata":{"annotations":{...}}}``), ``bind_pod(s)`` POSTs the
+  ``binding`` subresource like the real scheduler; the mirror applies
+  the change optimistically so the writer immediately observes its own
+  write (client-go's informer eventually reflects it too).
+- **Events**: the watch is filtered server-side with
+  ``fieldSelector=reason=Scheduled,type=Normal`` and feeds the same
+  subscriber interface the in-memory cluster exposes, so the annotator's
+  EventIngestor runs unchanged.
+
+No external dependencies: urllib + the newline-delimited JSON watch
+protocol. Auth: optional bearer token (in-cluster service-account file
+or explicit). TLS contexts can be passed through ``context``.
+Tested against a stub apiserver speaking the same wire protocol
+(tests/kube_stub.py + tests/test_kube_client.py).
+"""
+
+from __future__ import annotations
+
+import json
+import ssl
+import threading
+import urllib.error
+import urllib.request
+from typing import Callable
+
+from .state import (
+    ClusterState,
+    Container,
+    Event,
+    Node,
+    NodeAddress,
+    OwnerReference,
+    Pod,
+    ResourceRequirements,
+)
+
+DEFAULT_TIMEOUT_SECONDS = 10.0
+WATCH_TIMEOUT_SECONDS = 300.0
+SERVICE_ACCOUNT_TOKEN = "/var/run/secrets/kubernetes.io/serviceaccount/token"  # noqa: S105
+
+
+def node_from_json(obj: dict) -> Node:
+    meta = obj.get("metadata", {})
+    status = obj.get("status", {})
+    return Node(
+        name=meta.get("name", ""),
+        annotations=dict(meta.get("annotations") or {}),
+        labels=dict(meta.get("labels") or {}),
+        addresses=tuple(
+            NodeAddress(a.get("type", ""), a.get("address", ""))
+            for a in status.get("addresses") or []
+        ),
+    )
+
+
+def pod_from_json(obj: dict) -> Pod:
+    meta = obj.get("metadata", {})
+    spec = obj.get("spec", {})
+    containers = []
+    for c in spec.get("containers") or []:
+        res = c.get("resources") or {}
+        containers.append(
+            Container(
+                name=c.get("name", ""),
+                resources=ResourceRequirements(
+                    requests=dict(res.get("requests") or {}),
+                    limits=dict(res.get("limits") or {}),
+                ),
+            )
+        )
+    return Pod(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        annotations=dict(meta.get("annotations") or {}),
+        owner_references=tuple(
+            OwnerReference(kind=r.get("kind", ""), name=r.get("name", ""))
+            for r in meta.get("ownerReferences") or []
+        ),
+        containers=tuple(containers),
+        node_name=spec.get("nodeName", "") or "",
+    )
+
+
+def _parse_wall_time(value) -> float:
+    """RFC3339 (k8s event timestamps) -> epoch seconds; 0.0 on absence."""
+    if not value:
+        return 0.0
+    if isinstance(value, (int, float)):
+        return float(value)
+    from datetime import datetime
+
+    s = str(value).replace("Z", "+00:00")
+    try:
+        return datetime.fromisoformat(s).timestamp()
+    except ValueError:
+        return 0.0
+
+
+def event_from_json(obj: dict) -> Event:
+    meta = obj.get("metadata", {})
+    return Event(
+        namespace=meta.get("namespace", "default"),
+        name=meta.get("name", ""),
+        type=obj.get("type", ""),
+        reason=obj.get("reason", ""),
+        message=obj.get("message", ""),
+        count=int(obj.get("count") or 0),
+        event_time=_parse_wall_time(obj.get("eventTime")),
+        last_timestamp=_parse_wall_time(obj.get("lastTimestamp")),
+    )
+
+
+class KubeClusterClient:
+    """Informer-backed cluster view + API write-through.
+
+    Drop-in for ``ClusterState`` everywhere the framework reads or
+    writes cluster data. ``start()`` performs the initial list + spawns
+    watch threads; ``stop()`` tears them down. All read methods delegate
+    to the internal mirror (including ``sched_version`` for the
+    scheduler's snapshot cache and event subscription for the
+    annotator), so consumers cannot tell it apart from the in-memory
+    cluster — which is the point: SURVEY §1's "two processes communicate
+    only through the Kubernetes API" contract, preserved.
+    """
+
+    def __init__(
+        self,
+        base_url: str,
+        token: str | None = None,
+        context: ssl.SSLContext | None = None,
+        timeout: float = DEFAULT_TIMEOUT_SECONDS,
+    ):
+        self.base_url = base_url.rstrip("/")
+        self._token = token
+        self._context = context
+        self._timeout = timeout
+        self._mirror = ClusterState()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self.watch_errors = 0
+        # bounded identity memory so a reconnected event watch replaying
+        # its backlog cannot double-count Scheduled events (hot values
+        # would inflate otherwise); keyed on apiserver-side identity
+        self._seen_events: dict[tuple, None] = {}
+        self._seen_events_cap = 8192
+        self._seen_lock = threading.Lock()
+
+    # -- HTTP plumbing -----------------------------------------------------
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body=None,
+        content_type="application/json",
+        timeout: float | None = None,
+    ):
+        req = urllib.request.Request(
+            self.base_url + path,
+            method=method,
+            data=None if body is None else json.dumps(body).encode(),
+        )
+        if body is not None:
+            req.add_header("Content-Type", content_type)
+        if self._token:
+            req.add_header("Authorization", f"Bearer {self._token}")
+        return urllib.request.urlopen(  # noqa: S310 — caller controls base_url
+            req,
+            timeout=self._timeout if timeout is None else timeout,
+            context=self._context,
+        )
+
+    def _get_json(self, path: str) -> dict:
+        with self._request("GET", path) as resp:
+            return json.loads(resp.read())
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _relist(self) -> None:
+        """Full resync of nodes + pods into the mirror (informer relist):
+        adds/updates everything listed and prunes what disappeared, so
+        deltas missed during a watch disconnect cannot linger (a dead
+        node kept schedulable is the failure this prevents)."""
+        nodes = [node_from_json(i) for i in self._get_json("/api/v1/nodes").get("items", [])]
+        pods = [pod_from_json(i) for i in self._get_json("/api/v1/pods").get("items", [])]
+        for node in nodes:
+            self._mirror.add_node(node)
+        for pod in pods:
+            self._mirror.add_pod(pod)
+        live_nodes = {n.name for n in nodes}
+        for name in [n.name for n in self._mirror.list_nodes()]:
+            if name not in live_nodes:
+                self._mirror.delete_node(name)
+        live_pods = {p.key() for p in pods}
+        for key in [p.key() for p in self._mirror.list_pods()]:
+            if key not in live_pods:
+                self._mirror.delete_pod(key)
+
+    def start(self) -> None:
+        """Initial list of nodes + pods, then watch threads for nodes,
+        pods, and Scheduled events (server-side filtered)."""
+        self._relist()
+        watches = (
+            ("/api/v1/nodes?watch=1", self._apply_node),
+            ("/api/v1/pods?watch=1", self._apply_pod),
+            (
+                "/api/v1/events?watch=1&fieldSelector="
+                "reason%3DScheduled%2Ctype%3DNormal",
+                self._apply_event,
+            ),
+        )
+        for path, apply in watches:
+            t = threading.Thread(
+                target=self._watch_loop, args=(path, apply), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        # watch threads are daemons blocked in reads up to the watch
+        # timeout; a short join reaps the responsive ones without
+        # stalling shutdown on the rest
+        for t in self._threads:
+            t.join(timeout=0.2)
+        self._threads.clear()
+
+    def _watch_loop(self, path: str, apply: Callable[[str, dict], None]) -> None:
+        first = True
+        while not self._stop.is_set():
+            try:
+                if not first:
+                    # informer contract: relist before re-watching so
+                    # deltas missed while disconnected are reconciled
+                    self._relist()
+                first = False
+                with self._request(
+                    "GET", path, timeout=WATCH_TIMEOUT_SECONDS
+                ) as resp:
+                    for line in resp:
+                        if self._stop.is_set():
+                            return
+                        line = line.strip()
+                        if not line:
+                            continue
+                        change = json.loads(line)
+                        apply(change.get("type", ""), change.get("object", {}))
+            except (urllib.error.URLError, OSError, json.JSONDecodeError):
+                self.watch_errors += 1
+                if self._stop.wait(timeout=1.0):  # backoff then re-watch
+                    return
+
+    def _apply_node(self, change_type: str, obj: dict) -> None:
+        node = node_from_json(obj)
+        if change_type == "DELETED":
+            self._mirror.delete_node(node.name)
+        else:
+            self._mirror.add_node(node)
+
+    def _apply_pod(self, change_type: str, obj: dict) -> None:
+        pod = pod_from_json(obj)
+        if change_type == "DELETED":
+            self._mirror.delete_pod(pod.key())
+        else:
+            self._mirror.add_pod(pod)
+
+    def _apply_event(self, change_type: str, obj: dict) -> None:
+        if change_type == "DELETED":
+            return
+        event = event_from_json(obj)
+        # replayed backlogs after a reconnect must not double-count:
+        # dedup on apiserver-side identity (the mirror assigns its own
+        # resourceVersion, so that can't serve as the key)
+        key = (
+            event.namespace,
+            event.name,
+            event.count,
+            event.last_timestamp,
+            event.event_time,
+            event.message,
+        )
+        with self._seen_lock:
+            if key in self._seen_events:
+                return
+            if len(self._seen_events) >= self._seen_events_cap:
+                self._seen_events.pop(next(iter(self._seen_events)))
+            self._seen_events[key] = None
+        self._mirror.emit_event(event)
+
+    # -- reads: the informer mirror ---------------------------------------
+
+    @property
+    def sched_version(self) -> int:
+        return self._mirror.sched_version
+
+    def list_nodes(self):
+        return self._mirror.list_nodes()
+
+    def get_node(self, name: str):
+        return self._mirror.get_node(name)
+
+    def node_names(self):
+        return self._mirror.node_names()
+
+    def list_pods(self, node_name: str | None = None):
+        return self._mirror.list_pods(node_name)
+
+    def count_pods(self, node_name: str) -> int:
+        return self._mirror.count_pods(node_name)
+
+    def get_pod(self, key: str):
+        return self._mirror.get_pod(key)
+
+    def list_events(self):
+        return self._mirror.list_events()
+
+    def get_event(self, key: str):
+        return self._mirror.get_event(key)
+
+    def subscribe_events(self, handler) -> None:
+        self._mirror.subscribe_events(handler)
+
+    def subscribe_events_batch(self, handler) -> None:
+        self._mirror.subscribe_events_batch(handler)
+
+    # -- writes: through the API ------------------------------------------
+
+    # writes never raise: ClusterState's contract is a bool, and the
+    # annotator's worker/ticker threads rely on skip-and-retry — an
+    # escaping URLError would silently kill them for the process
+    # lifetime. HTTP errors, refused connections, and timeouts all
+    # report False (the workqueue backs off and retries).
+    _WRITE_ERRORS = (urllib.error.URLError, OSError)
+
+    def patch_node_annotation(self, name: str, key: str, value: str) -> bool:
+        """Annotation merge-patch (ref: node.go:123-146)."""
+        body = {"metadata": {"annotations": {key: value}}}
+        try:
+            with self._request(
+                "PATCH",
+                f"/api/v1/nodes/{name}",
+                body,
+                content_type="application/merge-patch+json",
+            ):
+                pass
+        except self._WRITE_ERRORS:
+            return False
+        # optimistic local apply: the writer's next read sees its write
+        # (the watch will deliver the authoritative object too)
+        return self._mirror.patch_node_annotation(name, key, value)
+
+    def patch_pod_annotation(self, key: str, anno_key: str, value: str) -> bool:
+        """PreBind's pod-annotation patch (ref: binder.go:19-65)."""
+        namespace, name = key.split("/", 1)
+        body = {"metadata": {"annotations": {anno_key: value}}}
+        try:
+            with self._request(
+                "PATCH",
+                f"/api/v1/namespaces/{namespace}/pods/{name}",
+                body,
+                content_type="application/merge-patch+json",
+            ):
+                pass
+        except self._WRITE_ERRORS:
+            return False
+        return self._mirror.patch_pod_annotation(key, anno_key, value)
+
+    def add_pod(self, pod: Pod) -> None:
+        """Create the pod via the API (primarily for tests/tools; real
+        pods arrive through the watch). The body carries the FULL pod —
+        containers/resources, ownerReferences, nodeName — because any
+        later watch delivery rebuilds the mirror entry from the server's
+        copy, and a stripped server copy would silently erase resource
+        requests and daemonset detection."""
+        body = {
+            "metadata": {
+                "name": pod.name,
+                "namespace": pod.namespace,
+                "annotations": dict(pod.annotations),
+                "ownerReferences": [
+                    {"kind": r.kind, "name": r.name}
+                    for r in pod.owner_references
+                ],
+            },
+            "spec": {
+                "nodeName": pod.node_name,
+                "containers": [
+                    {
+                        "name": c.name,
+                        "resources": {
+                            "requests": dict(c.resources.requests),
+                            "limits": dict(c.resources.limits),
+                        },
+                    }
+                    for c in pod.containers
+                ],
+            },
+        }
+        with self._request(
+            "POST", f"/api/v1/namespaces/{pod.namespace}/pods", body
+        ):
+            pass
+        self._mirror.add_pod(pod)
+
+    def bind_pod(self, pod_key: str, node_name: str, now: float | None = None) -> bool:
+        """POST the ``binding`` subresource — the scheduler's bind call.
+        The apiserver emits the Scheduled event; it reaches subscribers
+        through the event watch (the closed loop of SURVEY §3.4)."""
+        namespace, name = pod_key.split("/", 1)
+        body = {
+            "metadata": {"name": name, "namespace": namespace},
+            "target": {"kind": "Node", "name": node_name},
+        }
+        try:
+            with self._request(
+                "POST",
+                f"/api/v1/namespaces/{namespace}/pods/{name}/binding",
+                body,
+            ):
+                pass
+        except self._WRITE_ERRORS:
+            return False
+        # optimistic placement apply (no event emission here — the event
+        # is the apiserver's, delivered by the watch)
+        pod = self._mirror.get_pod(pod_key)
+        if pod is not None:
+            from dataclasses import replace
+
+            self._mirror.add_pod(replace(pod, node_name=node_name))
+        return True
+
+    def bind_pods(self, assignments, now: float | None = None) -> list[str]:
+        items = (
+            assignments.items() if hasattr(assignments, "items") else assignments
+        )
+        return [
+            pod_key
+            for pod_key, node_name in items
+            if self.bind_pod(pod_key, node_name, now)
+        ]

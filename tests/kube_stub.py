@@ -1,0 +1,245 @@
+"""Stub kube-apiserver speaking the wire subset KubeClusterClient uses.
+
+In-memory nodes/pods/events behind the real HTTP endpoints: list,
+newline-delimited JSON watch streams (with fieldSelector filtering for
+events), strategic-merge annotation patches, pod create, and the
+``binding`` subresource — which, like the real apiserver, emits the
+``Scheduled`` event whose message the annotator parses. This is the
+test double standing where `gocrane`'s fake clientset stood in the
+reference's tests (ref: filter_test.go:366-367), but at the HTTP layer.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class KubeStubState:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.nodes: dict[str, dict] = {}
+        self.pods: dict[str, dict] = {}
+        self.events: list[dict] = []
+        self.watchers: list[tuple[str, queue.Queue]] = []  # (kind, q)
+        self.requests: list[tuple[str, str]] = []  # (method, path) log
+
+    def add_node(self, name: str, ip: str, annotations: dict | None = None):
+        with self.lock:
+            self.nodes[name] = {
+                "metadata": {"name": name, "annotations": dict(annotations or {})},
+                "status": {"addresses": [{"type": "InternalIP", "address": ip}]},
+            }
+            self._notify("nodes", "ADDED", self.nodes[name])
+
+    def delete_node(self, name: str):
+        with self.lock:
+            obj = self.nodes.pop(name, None)
+            if obj is not None:
+                self._notify("nodes", "DELETED", obj)
+
+    def add_pod(self, namespace: str, name: str, spec: dict | None = None,
+                annotations: dict | None = None):
+        with self.lock:
+            key = f"{namespace}/{name}"
+            self.pods[key] = {
+                "metadata": {
+                    "name": name,
+                    "namespace": namespace,
+                    "annotations": dict(annotations or {}),
+                },
+                "spec": dict(spec or {}),
+            }
+            self._notify("pods", "ADDED", self.pods[key])
+
+    def emit_event(self, obj: dict):
+        with self.lock:
+            self.events.append(obj)
+            self._notify("events", "ADDED", obj)
+
+    def _notify(self, kind: str, change_type: str, obj: dict):
+        for wkind, q in list(self.watchers):
+            if wkind == kind:
+                q.put({"type": change_type, "object": obj})
+
+    def close_watches(self):
+        """Terminate every open watch stream (disconnect simulation)."""
+        with self.lock:
+            for _, q in list(self.watchers):
+                q.put(None)
+
+
+def _make_handler(state: KubeStubState):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):  # quiet
+            pass
+
+        def _json(self, code: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _read_body(self) -> dict:
+            n = int(self.headers.get("Content-Length") or 0)
+            return json.loads(self.rfile.read(n)) if n else {}
+
+        def _watch(self, kind: str, event_filter=None):
+            q: queue.Queue = queue.Queue()
+            with state.lock:
+                state.watchers.append((kind, q))
+                backlog = []
+                if kind == "events":
+                    backlog = [
+                        {"type": "ADDED", "object": o} for o in state.events
+                    ]
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def send(change):
+                if event_filter and not event_filter(change["object"]):
+                    return
+                data = (json.dumps(change) + "\n").encode()
+                self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                self.wfile.flush()
+
+            try:
+                for change in backlog:
+                    send(change)
+                while True:
+                    try:
+                        change = q.get(timeout=30.0)
+                    except queue.Empty:
+                        break
+                    if change is None:  # close_watches sentinel
+                        break
+                    send(change)
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            finally:
+                with state.lock:
+                    state.watchers.remove((kind, q))
+
+        def do_GET(self):
+            state.requests.append(("GET", self.path))
+            path, _, query = self.path.partition("?")
+            watching = "watch=1" in query
+            if path == "/api/v1/nodes":
+                if watching:
+                    return self._watch("nodes")
+                with state.lock:
+                    return self._json(200, {"items": list(state.nodes.values())})
+            if path == "/api/v1/pods":
+                if watching:
+                    return self._watch("pods")
+                with state.lock:
+                    return self._json(200, {"items": list(state.pods.values())})
+            if path == "/api/v1/events" and watching:
+                flt = None
+                if "fieldSelector=" in query:
+                    def flt(obj):
+                        return (
+                            obj.get("reason") == "Scheduled"
+                            and obj.get("type") == "Normal"
+                        )
+                return self._watch("events", flt)
+            return self._json(404, {"message": f"not found: {path}"})
+
+        def do_PATCH(self):
+            state.requests.append(("PATCH", self.path))
+            body = self._read_body()
+            annotations = body.get("metadata", {}).get("annotations", {})
+            parts = self.path.strip("/").split("/")
+            with state.lock:
+                if self.path.startswith("/api/v1/nodes/"):
+                    name = parts[-1]
+                    node = state.nodes.get(name)
+                    if node is None:
+                        return self._json(404, {"message": "node not found"})
+                    node["metadata"].setdefault("annotations", {}).update(annotations)
+                    state._notify("nodes", "MODIFIED", node)
+                    return self._json(200, node)
+                if "/pods/" in self.path:
+                    key = f"{parts[-3]}/{parts[-1]}"
+                    pod = state.pods.get(key)
+                    if pod is None:
+                        return self._json(404, {"message": "pod not found"})
+                    pod["metadata"].setdefault("annotations", {}).update(annotations)
+                    state._notify("pods", "MODIFIED", pod)
+                    return self._json(200, pod)
+            return self._json(404, {"message": "bad patch path"})
+
+        def do_POST(self):
+            state.requests.append(("POST", self.path))
+            body = self._read_body()
+            parts = self.path.strip("/").split("/")
+            with state.lock:
+                if self.path.endswith("/binding"):
+                    namespace, name = parts[-4], parts[-2]
+                    key = f"{namespace}/{name}"
+                    pod = state.pods.get(key)
+                    if pod is None:
+                        return self._json(404, {"message": "pod not found"})
+                    node_name = body.get("target", {}).get("name", "")
+                    pod["spec"]["nodeName"] = node_name
+                    state._notify("pods", "MODIFIED", pod)
+                    # the apiserver-side Scheduled event (ref: SURVEY §3.4)
+                    state.emit_event({
+                        "metadata": {
+                            "namespace": namespace,
+                            "name": f"{name}.scheduled",
+                        },
+                        "type": "Normal",
+                        "reason": "Scheduled",
+                        "message": f"Successfully assigned {key} to {node_name}",
+                        "count": 1,
+                        "lastTimestamp": "2026-07-30T00:00:00Z",
+                    })
+                    return self._json(201, {"status": "Success"})
+                if parts[-1] == "pods":
+                    namespace = parts[-2]
+                    meta = body.get("metadata", {})
+                    state.add_pod(
+                        namespace,
+                        meta.get("name", ""),
+                        spec=body.get("spec"),
+                        annotations=meta.get("annotations"),
+                    )
+                    return self._json(201, body)
+            return self._json(404, {"message": "bad post path"})
+
+    return Handler
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True  # lingering watch handlers must not block close
+
+
+class KubeStubServer:
+    def __init__(self):
+        self.state = KubeStubState()
+        self._server = _Server(("127.0.0.1", 0), _make_handler(self.state))
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address
+        return f"http://{host}:{port}"
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
