@@ -2,15 +2,19 @@
 
 Flags mirror the reference controller
 (ref: cmd/controller/app/options/options.go:61-76): policy file,
-Prometheus address, binding heap size, concurrent syncs, health port, and
-leader election (file-lock based). Without a kube API, nodes come from a
-JSON file (``--nodes-file``: [{"name": ..., "ip": ...}]) or a demo sim
-cluster (``--demo-nodes N`` with synthetic metrics).
+Prometheus address, binding heap size, concurrent syncs, health port,
+leader election (file-lock based), and ``--master`` for a live
+kube-apiserver (informer mirror + patch write-through via
+``cluster.kube``; token from ``--token-file`` or the in-cluster service
+account). Without a kube API, nodes come from a JSON file
+(``--nodes-file``: [{"name": ..., "ip": ...}]) or a demo sim cluster
+(``--demo-nodes N`` with synthetic metrics).
 
 Usage:
   python -m crane_scheduler_tpu.cli.annotator_main \
       --policy-config-path policy.yaml --prometheus-address http://prom:9090 \
-      --nodes-file nodes.json [--leader-elect --lock-file /tmp/crane.lock]
+      [--master https://apiserver:6443 | --nodes-file nodes.json] \
+      [--leader-elect --lock-file /tmp/crane.lock]
 """
 
 from __future__ import annotations
@@ -29,6 +33,12 @@ def main(argv=None) -> int:
     parser.add_argument("--binding-heap-size", type=int, default=1024)
     parser.add_argument("--concurrent-syncs", type=int, default=1)
     parser.add_argument("--health-port", type=int, default=8090)
+    parser.add_argument("--master", default=None,
+                        help="kube-apiserver URL (uses the informer-style "
+                             "KubeClusterClient instead of a local cluster)")
+    parser.add_argument("--token-file", default=None,
+                        help="bearer token file for --master (defaults to "
+                             "the in-cluster service-account token if present)")
     parser.add_argument("--nodes-file", default=None)
     parser.add_argument("--demo-nodes", type=int, default=0)
     parser.add_argument("--leader-elect", action="store_true")
@@ -49,21 +59,29 @@ def main(argv=None) -> int:
         else DEFAULT_POLICY
     )
 
-    cluster = ClusterState()
-    if args.nodes_file:
-        with open(args.nodes_file) as f:
-            for doc in json.load(f):
-                cluster.add_node(
-                    Node(
-                        name=doc["name"],
-                        addresses=(NodeAddress("InternalIP", doc.get("ip", doc["name"])),),
+    if args.master:
+        from ..cluster.kube import KubeClusterClient
+
+        cluster = KubeClusterClient.from_flags(args.master, args.token_file)
+        cluster.start()
+        print(f"kube mirror: {len(cluster.list_nodes())} nodes from {args.master}",
+              flush=True)
+    else:
+        cluster = ClusterState()
+        if args.nodes_file:
+            with open(args.nodes_file) as f:
+                for doc in json.load(f):
+                    cluster.add_node(
+                        Node(
+                            name=doc["name"],
+                            addresses=(NodeAddress("InternalIP", doc.get("ip", doc["name"])),),
+                        )
                     )
+        elif args.demo_nodes:
+            for i in range(args.demo_nodes):
+                cluster.add_node(
+                    Node(name=f"node-{i}", addresses=(NodeAddress("InternalIP", f"10.0.0.{i}"),))
                 )
-    elif args.demo_nodes:
-        for i in range(args.demo_nodes):
-            cluster.add_node(
-                Node(name=f"node-{i}", addresses=(NodeAddress("InternalIP", f"10.0.0.{i}"),))
-            )
 
     if args.prometheus_address:
         from ..metrics import PrometheusClient

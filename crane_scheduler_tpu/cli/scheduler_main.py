@@ -7,10 +7,18 @@ plugins registered. Without a kube API the cluster is a simulation
 at ``--arrival-rate`` and are scheduled continuously in plugin mode or in
 batched bursts (``--batch-size``).
 
+With ``--master`` the scheduler runs against a live kube-apiserver via
+the informer-style ``KubeClusterClient``: it schedules the cluster's
+pending pods (reading the annotator's node annotations from the mirror)
+and binds through the ``binding`` subresource.
+
 Usage:
   python -m crane_scheduler_tpu.cli.scheduler_main \
       --config deploy/dynamic/scheduler-config.yaml --demo-nodes 20 \
       --pods 100 [--batch-size 25]
+  python -m crane_scheduler_tpu.cli.scheduler_main \
+      --config deploy/dynamic/scheduler-config.yaml \
+      --master https://apiserver:6443 [--batch-size 256]
 """
 
 from __future__ import annotations
@@ -24,15 +32,22 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="crane-scheduler")
     parser.add_argument("--config", default="deploy/dynamic/scheduler-config.yaml")
     parser.add_argument("--demo-nodes", type=int, default=16)
-    parser.add_argument("--pods", type=int, default=50)
+    parser.add_argument("--pods", type=int, default=None,
+                        help="sim mode: pods to generate (default 50); "
+                             "--master mode: cap on pending pods scheduled "
+                             "(default: all pending)")
     parser.add_argument("--batch-size", type=int, default=0,
                         help="> 0: use the TPU batch scheduler in bursts")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--master", default=None,
+                        help="kube-apiserver URL: schedule the live "
+                             "cluster's pending pods instead of a sim")
+    parser.add_argument("--token-file", default=None)
     args = parser.parse_args(argv)
 
     from ..config import build_scheduler_from_config
     from ..config.scheme import load_scheduler_config_from_file
-    from ..policy import load_policy_from_file
+    from ..policy import DEFAULT_POLICY, load_policy_from_file
     from ..sim import SimConfig, Simulator
     from ..topology.types import InMemoryNRTLister
 
@@ -45,17 +60,53 @@ def main(argv=None) -> int:
         else None
     )
 
+    if args.master:
+        from ..cluster.kube import KubeClusterClient
+        from ..framework.scheduler import BatchScheduler
+
+        cluster = KubeClusterClient.from_flags(args.master, args.token_file)
+        cluster.start()
+        policy = policy or DEFAULT_POLICY
+        pending = [p for p in cluster.list_pods() if not p.node_name]
+        if args.pods is not None:  # unset means ALL pending, never 50
+            pending = pending[: args.pods]
+        stats = {"scheduled": 0, "unschedulable": 0}
+        t0 = time.perf_counter()
+        if args.batch_size > 0:
+            batch = BatchScheduler(cluster, policy)
+            for i in range(0, len(pending), args.batch_size):
+                result = batch.schedule_batch_mixed(
+                    pending[i : i + args.batch_size]
+                )
+                stats["scheduled"] += len(result.assignments)
+                stats["unschedulable"] += len(result.unassigned)
+        else:
+            sched = build_scheduler_from_config(
+                cluster, config, nrt_lister=InMemoryNRTLister(), policy=policy
+            )
+            for pod in pending:
+                result = sched.schedule_one(pod)
+                stats["scheduled" if result.node else "unschedulable"] += 1
+        print(json.dumps({
+            "config": args.config,
+            "master": args.master,
+            "nodes": len(cluster.list_nodes()),
+            **stats,
+            "wall_seconds": round(time.perf_counter() - t0, 3),
+        }))
+        cluster.stop()
+        return 0
+
     sim = Simulator(SimConfig(n_nodes=args.demo_nodes, seed=args.seed),
-                    policy=policy or __import__(
-                        "crane_scheduler_tpu.policy", fromlist=["DEFAULT_POLICY"]
-                    ).DEFAULT_POLICY)
+                    policy=policy or DEFAULT_POLICY)
     sim.sync_metrics()
 
+    n_pods = 50 if args.pods is None else args.pods
     stats = {"scheduled": 0, "unschedulable": 0}
     t0 = time.perf_counter()
     if args.batch_size > 0:
         batch = sim.build_batch_scheduler()
-        remaining = args.pods
+        remaining = n_pods
         while remaining > 0:
             burst = [sim.make_pod() for _ in range(min(args.batch_size, remaining))]
             result = batch.schedule_batch(burst)
@@ -70,7 +121,7 @@ def main(argv=None) -> int:
             nrt_lister=InMemoryNRTLister(),
             clock=sim.clock, policy=sim.policy,
         )
-        for _ in range(args.pods):
+        for _ in range(n_pods):
             result = sched.schedule_one(sim.make_pod())
             stats["scheduled" if result.node else "unschedulable"] += 1
             sim.clock.advance(1.0)

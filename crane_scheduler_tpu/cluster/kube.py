@@ -138,6 +138,23 @@ class KubeClusterClient:
     only through the Kubernetes API" contract, preserved.
     """
 
+    @classmethod
+    def from_flags(
+        cls, master: str, token_file: str | None = None
+    ) -> "KubeClusterClient":
+        """CLI/in-cluster construction: bearer token from ``token_file``
+        or the mounted service-account token when present."""
+        import os
+
+        token = None
+        path = token_file or (
+            SERVICE_ACCOUNT_TOKEN if os.path.exists(SERVICE_ACCOUNT_TOKEN) else None
+        )
+        if path:
+            with open(path) as f:
+                token = f.read().strip()
+        return cls(master, token=token)
+
     def __init__(
         self,
         base_url: str,
@@ -191,42 +208,53 @@ class KubeClusterClient:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def _relist(self) -> None:
-        """Full resync of nodes + pods into the mirror (informer relist):
-        adds/updates everything listed and prunes what disappeared, so
-        deltas missed during a watch disconnect cannot linger (a dead
-        node kept schedulable is the failure this prevents)."""
+    def _relist_nodes(self) -> None:
+        """Resync nodes into the mirror (informer relist): adds/updates
+        everything listed and prunes what disappeared, so deltas missed
+        during a watch disconnect cannot linger (a dead node kept
+        schedulable is the failure this prevents). Only the NODE watch
+        thread calls this while ITS stream is down, so no concurrent
+        node delivery can race the prune; other resources are never
+        touched from here."""
         nodes = [node_from_json(i) for i in self._get_json("/api/v1/nodes").get("items", [])]
-        pods = [pod_from_json(i) for i in self._get_json("/api/v1/pods").get("items", [])]
         for node in nodes:
             self._mirror.add_node(node)
+        live = {n.name for n in nodes}
+        for name in [n.name for n in self._mirror.list_nodes()]:
+            if name not in live:
+                self._mirror.delete_node(name)
+
+    def _relist_pods(self) -> None:
+        """Pod twin of ``_relist_nodes`` (called only by the pod watch
+        thread while its own stream is down)."""
+        pods = [pod_from_json(i) for i in self._get_json("/api/v1/pods").get("items", [])]
         for pod in pods:
             self._mirror.add_pod(pod)
-        live_nodes = {n.name for n in nodes}
-        for name in [n.name for n in self._mirror.list_nodes()]:
-            if name not in live_nodes:
-                self._mirror.delete_node(name)
-        live_pods = {p.key() for p in pods}
+        live = {p.key() for p in pods}
         for key in [p.key() for p in self._mirror.list_pods()]:
-            if key not in live_pods:
+            if key not in live:
                 self._mirror.delete_pod(key)
 
     def start(self) -> None:
         """Initial list of nodes + pods, then watch threads for nodes,
-        pods, and Scheduled events (server-side filtered)."""
-        self._relist()
+        pods, and Scheduled events (server-side filtered). Events need no
+        relist: missed Scheduled events age out of the hot-value windows
+        by design (the reference's informer replay has the same bound)."""
+        self._relist_nodes()
+        self._relist_pods()
         watches = (
-            ("/api/v1/nodes?watch=1", self._apply_node),
-            ("/api/v1/pods?watch=1", self._apply_pod),
+            ("/api/v1/nodes?watch=1", self._apply_node, self._relist_nodes),
+            ("/api/v1/pods?watch=1", self._apply_pod, self._relist_pods),
             (
                 "/api/v1/events?watch=1&fieldSelector="
                 "reason%3DScheduled%2Ctype%3DNormal",
                 self._apply_event,
+                None,
             ),
         )
-        for path, apply in watches:
+        for path, apply, relist in watches:
             t = threading.Thread(
-                target=self._watch_loop, args=(path, apply), daemon=True
+                target=self._watch_loop, args=(path, apply, relist), daemon=True
             )
             t.start()
             self._threads.append(t)
@@ -240,18 +268,25 @@ class KubeClusterClient:
             t.join(timeout=0.2)
         self._threads.clear()
 
-    def _watch_loop(self, path: str, apply: Callable[[str, dict], None]) -> None:
-        first = True
+    def _watch_loop(
+        self,
+        path: str,
+        apply: Callable[[str, dict], None],
+        relist: Callable[[], None] | None,
+    ) -> None:
         while not self._stop.is_set():
             try:
-                if not first:
-                    # informer contract: relist before re-watching so
-                    # deltas missed while disconnected are reconciled
-                    self._relist()
-                first = False
                 with self._request(
                     "GET", path, timeout=WATCH_TIMEOUT_SECONDS
                 ) as resp:
+                    # relist AFTER the watch stream is established (the
+                    # server registered this watcher before sending
+                    # headers): any delta between a previous list and
+                    # this connection — including the start() bootstrap
+                    # gap and everything missed while disconnected — is
+                    # reconciled, and nothing after it can be missed.
+                    if relist is not None:
+                        relist()
                     for line in resp:
                         if self._stop.is_set():
                             return
@@ -262,8 +297,11 @@ class KubeClusterClient:
                         apply(change.get("type", ""), change.get("object", {}))
             except (urllib.error.URLError, OSError, json.JSONDecodeError):
                 self.watch_errors += 1
-                if self._stop.wait(timeout=1.0):  # backoff then re-watch
-                    return
+            # backoff on clean stream end too: a proxy/apiserver that
+            # closes watches immediately must not induce a tight
+            # relist+rewatch loop
+            if self._stop.wait(timeout=1.0):
+                return
 
     def _apply_node(self, change_type: str, obj: dict) -> None:
         node = node_from_json(obj)
@@ -411,10 +449,16 @@ class KubeClusterClient:
                 ],
             },
         }
-        with self._request(
-            "POST", f"/api/v1/namespaces/{pod.namespace}/pods", body
-        ):
-            pass
+        try:
+            with self._request(
+                "POST", f"/api/v1/namespaces/{pod.namespace}/pods", body
+            ):
+                pass
+        except self._WRITE_ERRORS:
+            # never raise (ClusterState.add_pod cannot fail); the pod is
+            # simply not created — counted like any other failed write
+            self.watch_errors += 1
+            return
         self._mirror.add_pod(pod)
 
     def bind_pod(self, pod_key: str, node_name: str, now: float | None = None) -> bool:
