@@ -279,6 +279,7 @@ class BatchScheduler:
         # built from; an unchanged store re-dispatches with zero uploads
         self._prepared = None
         self._prepared_key = None
+        self._prepared_layout = None
         self._prepared_snap = None  # host snapshot behind self._prepared
         self._prepared_names: tuple[str, ...] = ()
         self._prepared_n = 0
@@ -292,27 +293,83 @@ class BatchScheduler:
         self.store.bulk_ingest((n.name, n.annotations) for n in nodes)
         self.store.prune_absent(n.name for n in nodes)
 
+    # Delta uploads only pay off while the dirt is sparse: past this
+    # fraction of rows a full column re-upload is cheaper than the
+    # scatter (and avoids accumulating scatter chains).
+    _DELTA_MAX_FRACTION = 0.25
+
     def _prepare(self, now: float):
         """Upload (or reuse) the device snapshot for the current store.
 
         In hybrid mode a cache hit still refreshes the f64 rescue vectors
         when ``now`` moved (three [N] uploads; the load matrices stay
         resident) — staleness-boundary risk depends on the scoring time.
+
+        When the store changed but its row layout did not (the common
+        annotator tick: values move, membership doesn't), only the
+        changed rows scatter into the resident device arrays
+        (``ShardedScheduleStep.apply_delta``) instead of re-uploading the
+        full matrices.
         """
         key = self.store.version
-        if self._prepared is None or self._prepared_key != key:
-            snap = self.store.snapshot(bucket=self._bucket)
-            self._prepared = self._sharded.prepare(snap, now)
-            self._prepared_key = key
-            # only hybrid override refreshes re-read the host snapshot;
-            # don't pin tens of MB per 50k nodes in non-hybrid mode
-            self._prepared_snap = snap if self._hybrid else None
-            self._prepared_names = snap.node_names
-            self._prepared_n = snap.n_nodes
-        elif self._hybrid:
-            self._prepared = self._sharded.with_overrides(
-                self._prepared, self._prepared_snap, now
-            )
+        if self._prepared is not None and self._prepared_key == key:
+            if self._hybrid:
+                self._prepared = self._sharded.with_overrides(
+                    self._prepared, self._prepared_snap, now
+                )
+            return self._prepared
+
+        # Non-f64 snapshots store timestamps rebased to their prepare
+        # epoch; past ~6h of age the f32 rounding window grows enough to
+        # matter (hybrid re-rebases in with_overrides), so the delta path
+        # must not keep an over-aged epoch alive in ANY rebased mode.
+        import jax.numpy as jnp
+
+        stale_epoch = (
+            self._prepared is not None
+            and jnp.dtype(self._dtype) != jnp.dtype(jnp.float64)
+            and abs(float(now) - self._prepared.epoch) > 6 * 3600.0
+        )
+        if (
+            not stale_epoch
+            and self._prepared is not None
+            and self._prepared_layout == getattr(self.store, "layout_version", None)
+        ):
+            (new_key, layout, rows, values_rows, ts_rows, hot_rows,
+             hot_ts_rows) = self.store.delta_since(self._prepared_key)
+            if (
+                layout == self._prepared_layout
+                and 0 < len(rows) <= max(1, int(self._prepared_n * self._DELTA_MAX_FRACTION))
+            ):
+                self._prepared = self._sharded.apply_delta(
+                    self._prepared, rows, values_rows, ts_rows,
+                    hot_rows, hot_ts_rows,
+                )
+                self._prepared_key = new_key
+                if self._hybrid:
+                    # fold the SAME delta into the cached host snapshot
+                    # (re-snapshotting could observe newer data than the
+                    # device rows, breaking override parity), then
+                    # recompute the rescue vectors from it
+                    snap = self._prepared_snap
+                    snap.values[rows] = values_rows
+                    snap.ts[rows] = ts_rows
+                    snap.hot_value[rows] = hot_rows
+                    snap.hot_ts[rows] = hot_ts_rows
+                    self._prepared = self._sharded.with_overrides(
+                        self._prepared, snap, now, force=True
+                    )
+                return self._prepared
+
+        snap = self.store.snapshot(bucket=self._bucket)
+        self._prepared = self._sharded.prepare(snap, now)
+        self._prepared_key = key
+        self._prepared_layout = getattr(self.store, "layout_version", None)
+        # only hybrid override refreshes re-read the host snapshot;
+        # don't pin tens of MB per 50k nodes in non-hybrid mode
+        self._prepared_snap = snap if self._hybrid else None
+        self._prepared_names = snap.node_names
+        self._prepared_n = snap.n_nodes
         return self._prepared
 
     def schedule_batch(self, pods: list[Pod], bind: bool = True) -> BatchResult:

@@ -89,11 +89,48 @@ class NodeLoadStore:
         # monotonic mutation counter: snapshot/upload caches key on this,
         # so an unchanged store costs zero host->device traffic per cycle
         self._version = 0
+        # delta-upload support: which version last touched each row, and
+        # a separate counter for layout changes (row <-> name mapping) —
+        # value edits can upload as row deltas, layout changes cannot
+        self._row_versions: dict[int, int] = {}
+        self._layout_version = 0
 
     @property
     def version(self) -> int:
         """Bumped by every mutation that can change snapshot contents."""
         return self._version
+
+    @property
+    def layout_version(self) -> int:
+        """Bumped when the row <-> node-name mapping changes (add/remove);
+        device-resident snapshots can only delta-update while this is
+        stable."""
+        return self._layout_version
+
+    def _touch(self, row: int) -> None:
+        """Record that ``row`` changed at the current version (callers
+        hold the lock and have already bumped ``_version``)."""
+        self._row_versions[row] = self._version
+
+    @_locked
+    def delta_since(self, version: int):
+        """Rows whose contents changed after ``version``, with their
+        current data, all under one lock hold:
+        ``(current_version, layout_version, row_ids, values[k, M],
+        ts[k, M], hot_value[k], hot_ts[k])``. Valid for delta-uploading a
+        device snapshot taken at ``version`` ONLY while layout_version is
+        unchanged (the caller checks)."""
+        rows = sorted(i for i, v in self._row_versions.items() if v > version)
+        ids = np.asarray(rows, dtype=np.int64)
+        return (
+            self._version,
+            self._layout_version,
+            ids,
+            self.values[ids].copy(),
+            self.ts[ids].copy(),
+            self.hot_value[ids].copy(),
+            self.hot_ts[ids].copy(),
+        )
 
     # -- node membership ---------------------------------------------------
 
@@ -122,6 +159,8 @@ class NodeLoadStore:
         self.hot_value[i] = np.nan
         self.hot_ts[i] = _NEG_INF
         self._version += 1
+        self._layout_version += 1
+        self._touch(i)
         return i
 
     @_locked
@@ -143,6 +182,10 @@ class NodeLoadStore:
         self._names.pop()
         self._n = last
         self._version += 1
+        self._layout_version += 1
+        self._row_versions.pop(last, None)
+        if i != last:
+            self._touch(i)  # row i now holds the moved node's data
 
     def _grow(self, new_cap: int) -> None:
         m = self.tensors.num_metrics
@@ -181,6 +224,7 @@ class NodeLoadStore:
         self.values[i, col] = value
         self.ts[i, col] = ts
         self._version += 1
+        self._touch(i)
 
     @_locked
     def set_hot_value(
@@ -195,6 +239,7 @@ class NodeLoadStore:
         self.hot_value[i] = value
         self.hot_ts[i] = ts
         self._version += 1
+        self._touch(i)
 
     @_locked
     def ingest_annotation(self, node: str, key: str, raw: str) -> None:
@@ -219,6 +264,7 @@ class NodeLoadStore:
         self.hot_value[i] = np.nan
         self.hot_ts[i] = _NEG_INF
         self._version += 1
+        self._touch(i)
         if not anno:
             return
         for key, raw in anno.items():
@@ -240,15 +286,21 @@ class NodeLoadStore:
         hold, so a concurrent ``prune_absent`` (which swap-removes rows)
         can never redirect a pre-resolved id to another node's row."""
         ids = np.asarray([self.add_node(n) for n in names], dtype=np.int64)
+        wrote = False
         col = self.tensors.metric_index.get(metric)
         if col is not None and len(ids):
             self.values[ids, col] = values
             self.ts[ids, col] = ts
             self._version += 1
+            wrote = True
         if hot_values is not None and len(ids):
             self.hot_value[ids] = hot_values
             self.hot_ts[ids] = hot_ts
             self._version += 1
+            wrote = True
+        if wrote:
+            version = self._version
+            self._row_versions.update((int(i), version) for i in ids)
 
     @_locked
     def prune_absent(self, live_names) -> int:
@@ -281,6 +333,7 @@ class NodeLoadStore:
             if skip_unchanged and self._last_anno.get(name) is anno:
                 continue
             self._version += 1
+            self._touch(i)
             self._last_anno[name] = anno
             self.values[i, :] = np.nan
             self.ts[i, :] = _NEG_INF

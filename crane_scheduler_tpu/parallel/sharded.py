@@ -20,6 +20,7 @@ only changes *where* it runs.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import Any
 
@@ -214,12 +215,15 @@ class ShardedScheduleStep:
         }
 
     def with_overrides(
-        self, prepared: PreparedSnapshot, snapshot, now: float
+        self, prepared: PreparedSnapshot, snapshot, now: float,
+        force: bool = False,
     ) -> PreparedSnapshot:
         """Refresh the hybrid rescue vectors for a new wall time against
         the same (cached) snapshot — only three [N] vectors re-upload; the
         resident load matrices are reused. No-op for non-hybrid steps or
-        when the overrides are already current for ``now``.
+        (unless ``force``) when the overrides are already current for
+        ``now`` — force after ``apply_delta``, where the underlying data
+        changed at an unchanged scoring time.
 
         The f32 rounding of the rebased timestamps grows with
         ``now - epoch`` (the cached snapshot's age); the risk scan widens
@@ -228,7 +232,7 @@ class ShardedScheduleStep:
         """
         import dataclasses
 
-        if not self.hybrid or prepared.ovr_now == float(now):
+        if not self.hybrid or (not force and prepared.ovr_now == float(now)):
             return prepared
         age = abs(float(now) - prepared.epoch)
         if age > 6 * 3600.0:  # hybrid is always non-f64 (see __init__)
@@ -248,6 +252,79 @@ class ShardedScheduleStep:
         return dataclasses.replace(
             prepared,
             **self._override_vectors(snapshot, float(now), rebase_age=age),
+        )
+
+    def apply_delta(
+        self,
+        prepared: PreparedSnapshot,
+        rows,
+        values_rows,
+        ts_rows,
+        hot_rows,
+        hot_ts_rows,
+    ) -> PreparedSnapshot:
+        """Scatter changed rows into the resident device arrays instead
+        of re-uploading full matrices (the annotator touches a handful of
+        rows per tick; full prepare is O(N·M) H2D). Timestamps rebase to
+        the prepared snapshot's existing epoch, so the result is
+        bit-identical to a full ``prepare`` of the updated store at the
+        same epoch. Row counts pad to power-of-two buckets (out-of-range
+        indices drop) so jit variants stay few. Hybrid callers must
+        refresh their override vectors afterwards — the rescue rows
+        derive from the data that just changed."""
+        k = len(rows)
+        if k == 0:
+            return prepared
+        import dataclasses
+        import math as _math
+
+        dtype = self.scorer.dtype
+        kpad = 1 << max(0, _math.ceil(_math.log2(k)))
+        npad = int(prepared.capacity.shape[0])
+        idx = np.full((kpad,), npad, dtype=np.int32)  # pad rows drop
+        idx[:k] = np.asarray(rows, np.int64)
+        m = self.tensors.num_metrics
+
+        def pad(a, fill, shape):
+            out = np.full(shape, fill, dtype=np.float64)
+            out[:k] = a
+            return out
+
+        ts_rows = np.asarray(ts_rows, np.float64) - prepared.epoch
+        hot_ts_rows = np.asarray(hot_ts_rows, np.float64) - prepared.epoch
+        values2, ts2, hot2, hot_ts2 = self._jit_delta(
+            prepared.values,
+            prepared.ts,
+            prepared.hot_value,
+            prepared.hot_ts,
+            jnp.asarray(idx),
+            jnp.asarray(pad(values_rows, np.nan, (kpad, m)), dtype),
+            jnp.asarray(pad(ts_rows, -np.inf, (kpad, m)), dtype),
+            jnp.asarray(pad(hot_rows, np.nan, (kpad,)), dtype),
+            jnp.asarray(pad(hot_ts_rows, -np.inf, (kpad,)), dtype),
+        )
+        return dataclasses.replace(
+            prepared, values=values2, ts=ts2, hot_value=hot2, hot_ts=hot_ts2
+        )
+
+    @functools.cached_property
+    def _jit_delta(self):
+        def scatter(values, ts, hot, hot_ts, idx, v_rows, t_rows, h_rows, ht_rows):
+            # mode="drop": the kpad padding indices point past the array
+            return (
+                values.at[idx].set(v_rows, mode="drop"),
+                ts.at[idx].set(t_rows, mode="drop"),
+                hot.at[idx].set(h_rows, mode="drop"),
+                hot_ts.at[idx].set(ht_rows, mode="drop"),
+            )
+
+        return jax.jit(
+            scatter,
+            in_shardings=(
+                self._row, self._row, self._vec, self._vec,
+                self._rep, self._rep, self._rep, self._rep, self._rep,
+            ),
+            out_shardings=(self._row, self._row, self._vec, self._vec),
         )
 
     def with_vectors(

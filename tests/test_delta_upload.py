@@ -1,0 +1,119 @@
+"""Delta store->device uploads: changed rows scatter into the resident
+arrays (ShardedScheduleStep.apply_delta) instead of re-uploading full
+matrices; results must be bit-identical to a full prepare of the updated
+store at the same epoch, in f64, f32, and hybrid modes."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from crane_scheduler_tpu.loadstore import NodeLoadStore, encode_annotation
+from crane_scheduler_tpu.parallel import ShardedScheduleStep, make_node_mesh
+from crane_scheduler_tpu.policy import DEFAULT_POLICY, compile_policy
+
+NOW = 1753776000.0
+
+
+def _build_store(n=48, seed=0):
+    rng = np.random.default_rng(seed)
+    tensors = compile_policy(DEFAULT_POLICY)
+    store = NodeLoadStore(tensors)
+    for i in range(n):
+        anno = {
+            m: encode_annotation(float(rng.uniform(0, 1)), NOW - 30.0)
+            for m in tensors.metric_names
+        }
+        anno["node_hot_value"] = encode_annotation(float(rng.integers(0, 3)), NOW - 10.0)
+        store.ingest_node_annotations(f"node-{i:03d}", anno)
+    return tensors, store
+
+
+def _mutate_some(store, tensors, rng):
+    names = store.node_names
+    touched = set()
+    for i in rng.choice(len(names), size=5, replace=False):
+        name = names[int(i)]
+        metric = tensors.metric_names[int(rng.integers(0, len(tensors.metric_names)))]
+        store.set_metric(name, metric, float(rng.uniform(0, 1)), NOW + 5.0)
+        touched.add(int(i))
+    store.set_hot_value(names[0], 7.0, NOW + 5.0)
+    touched.add(0)
+    return touched
+
+
+@pytest.mark.parametrize("dtype,hybrid", [
+    (jnp.float64, False), (jnp.float32, False), (jnp.float32, True),
+])
+def test_apply_delta_bit_identical_to_full_prepare(dtype, hybrid):
+    tensors, store = _build_store()
+    rng = np.random.default_rng(7)
+    step = ShardedScheduleStep(tensors, make_node_mesh(8), dtype=dtype, hybrid=hybrid)
+
+    base_version = store.version
+    prepared = step.prepare(store.snapshot(bucket=16), NOW)
+    touched = _mutate_some(store, tensors, rng)
+    new_v, layout, rows, v_rows, t_rows, h_rows, ht_rows = store.delta_since(base_version)
+    assert set(int(r) for r in rows) == touched
+    assert new_v == store.version
+
+    updated = step.apply_delta(prepared, rows, v_rows, t_rows, h_rows, ht_rows)
+    snap = store.snapshot(bucket=16)
+    if hybrid:
+        updated = step.with_overrides(updated, snap, NOW, force=True)
+    want = step.prepare(snap, NOW)
+
+    for field in ("values", "ts", "hot_value", "hot_ts"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(updated, field)), np.asarray(getattr(want, field)),
+            err_msg=field,
+        )
+    if hybrid:
+        for field in ("ovr_mask", "ovr_sched", "ovr_score"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(updated, field)),
+                np.asarray(getattr(want, field)), err_msg=field,
+            )
+    got = np.asarray(step.packed(updated, 100))
+    np.testing.assert_array_equal(got, np.asarray(step.packed(want, 100)))
+
+
+def test_batch_scheduler_uses_delta_and_matches_full(monkeypatch):
+    """BatchScheduler takes the delta path for value-only changes and a
+    full re-prepare on membership changes; placements always equal a
+    cold scheduler's."""
+    from crane_scheduler_tpu.cluster import Node, NodeAddress
+    from crane_scheduler_tpu.loadstore import encode_annotation
+    from tests.test_framework_e2e import make_sim
+
+    sim = make_sim(6, seed=40)
+    batch = sim.build_batch_scheduler(dtype=jnp.float32)
+    deltas = {"n": 0}
+    real = batch._sharded.apply_delta
+
+    def counting(*a, **k):
+        deltas["n"] += 1
+        return real(*a, **k)
+
+    monkeypatch.setattr(batch._sharded, "apply_delta", counting)
+
+    pods = [sim.make_pod() for _ in range(8)]
+    batch.schedule_batch(pods, bind=False)  # full prepare
+    assert deltas["n"] == 0
+
+    node = sim.cluster.list_nodes()[0]
+    for m in batch.tensors.metric_names[:2]:
+        sim.cluster.patch_node_annotation(node.name, m, encode_annotation(0.97, sim.clock()))
+    r_delta = batch.schedule_batch(pods, bind=False)
+    assert deltas["n"] == 1  # value change -> delta path
+
+    cold = sim.build_batch_scheduler(dtype=jnp.float32)
+    r_cold = cold.schedule_batch(pods, bind=False)
+    assert r_delta.scores == r_cold.scores
+    assert r_delta.schedulable == r_cold.schedulable
+    assert sorted(r_delta.assignments.values()) == sorted(r_cold.assignments.values())
+
+    # membership change: layout bump -> full prepare, not delta
+    sim.cluster.add_node(Node(name="late-node",
+                              addresses=(NodeAddress("InternalIP", "10.7.0.9"),)))
+    batch.schedule_batch(pods, bind=False)
+    assert deltas["n"] == 1
