@@ -260,6 +260,8 @@ class BatchScheduler:
             mesh = make_node_mesh(1)
         self._mesh = mesh
         self._dtype = dtype
+        # rebased modes store ts relative to the prepare epoch (non-f64)
+        self._rebased = jnp.dtype(dtype) != jnp.dtype(jnp.float64)
         if hybrid is None:
             hybrid = True
         # f64 is already the parity mode; hybrid only means something for
@@ -311,25 +313,29 @@ class BatchScheduler:
         (``ShardedScheduleStep.apply_delta``) instead of re-uploading the
         full matrices.
         """
+        from ..parallel.sharded import EPOCH_REBASE_SECONDS
+
         key = self.store.version
+        # Non-f64 snapshots store timestamps rebased to their prepare
+        # epoch; past the shared threshold the f32 rounding window grows
+        # enough to matter, so NO rebased mode may keep an over-aged
+        # epoch alive — not the delta path, and not an unchanged-store
+        # cache hit either (hybrid re-rebases inside with_overrides; the
+        # plain path must fall through to a fresh full prepare).
+        stale_epoch = (
+            self._prepared is not None
+            and self._rebased
+            and abs(float(now) - self._prepared.epoch) > EPOCH_REBASE_SECONDS
+        )
         if self._prepared is not None and self._prepared_key == key:
             if self._hybrid:
                 self._prepared = self._sharded.with_overrides(
                     self._prepared, self._prepared_snap, now
                 )
-            return self._prepared
+                return self._prepared
+            if not stale_epoch:
+                return self._prepared
 
-        # Non-f64 snapshots store timestamps rebased to their prepare
-        # epoch; past ~6h of age the f32 rounding window grows enough to
-        # matter (hybrid re-rebases in with_overrides), so the delta path
-        # must not keep an over-aged epoch alive in ANY rebased mode.
-        import jax.numpy as jnp
-
-        stale_epoch = (
-            self._prepared is not None
-            and jnp.dtype(self._dtype) != jnp.dtype(jnp.float64)
-            and abs(float(now) - self._prepared.epoch) > 6 * 3600.0
-        )
         if (
             not stale_epoch
             and self._prepared is not None

@@ -122,14 +122,15 @@ class NodeLoadStore:
         unchanged (the caller checks)."""
         rows = sorted(i for i, v in self._row_versions.items() if v > version)
         ids = np.asarray(rows, dtype=np.int64)
+        # fancy indexing already yields fresh arrays — no extra copies
         return (
             self._version,
             self._layout_version,
             ids,
-            self.values[ids].copy(),
-            self.ts[ids].copy(),
-            self.hot_value[ids].copy(),
-            self.hot_ts[ids].copy(),
+            self.values[ids],
+            self.ts[ids],
+            self.hot_value[ids],
+            self.hot_ts[ids],
         )
 
     # -- node membership ---------------------------------------------------

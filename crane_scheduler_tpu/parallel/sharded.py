@@ -32,6 +32,12 @@ from jax.sharding import Mesh
 from ..policy.compile import PolicyTensors
 from ..scorer.batched import BatchedScorer
 from ..scorer.topk import GangScheduler
+
+# Rebased (non-f64) snapshots must not age past this: the f32 rounding
+# window of `now - epoch` grows with age. with_overrides re-rebases past
+# it, and BatchScheduler._prepare forces a full prepare — both sides
+# must share the threshold.
+EPOCH_REBASE_SECONDS = 6 * 3600.0
 from .mesh import node_sharding, replicated_sharding
 
 
@@ -235,7 +241,7 @@ class ShardedScheduleStep:
         if not self.hybrid or (not force and prepared.ovr_now == float(now)):
             return prepared
         age = abs(float(now) - prepared.epoch)
-        if age > 6 * 3600.0:  # hybrid is always non-f64 (see __init__)
+        if age > EPOCH_REBASE_SECONDS:  # hybrid is always non-f64 (see __init__)
             # re-rebase the resident matrices around the current time
             # (capacity/offsets are age-independent; carry them over)
             dtype = self.scorer.dtype

@@ -59,6 +59,36 @@ class _Handler(BaseHTTPRequestHandler):
                     "scores": verdicts.scores,
                 },
             )
+        elif self.path == "/v1/assign":
+            try:
+                num_pods = int(req.get("numPods", 0))
+                capacity = req.get("capacity")
+                if capacity is not None:
+                    capacity = {str(k): int(v) for k, v in capacity.items()}
+                now = req.get("now")
+                if now is not None:
+                    now = float(now)
+            except (TypeError, ValueError, AttributeError):
+                self._send(400, {
+                    "error": "numPods must be an integer, capacity a "
+                             "{node: int} map, now a number",
+                })
+                return
+            if req.get("refresh", True):
+                self.service.refresh()
+            assignment = self.service.assign_batch(
+                num_pods, capacity=capacity, now=now,
+            )
+            self._send(
+                200,
+                {
+                    "backend": assignment.backend,
+                    "stalenessSeconds": assignment.staleness_seconds,
+                    "counts": assignment.counts,
+                    "unassigned": assignment.unassigned,
+                    "waterline": assignment.waterline,
+                },
+            )
         elif self.path == "/v1/refresh":
             self.service.refresh()
             self._send(200, {"status": "ok", "nodes": len(self.service.store)})

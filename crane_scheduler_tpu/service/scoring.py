@@ -47,6 +47,15 @@ class BatchVerdicts:
     staleness_seconds: float
 
 
+@dataclass
+class BatchAssignment:
+    counts: dict  # node -> pods assigned (zero-count nodes omitted)
+    unassigned: int
+    waterline: int
+    backend: str  # scorer backend, or "host-fallback" if the solver fell back
+    staleness_seconds: float
+
+
 class ScoringService:
     def __init__(
         self,
@@ -82,9 +91,7 @@ class ScoringService:
         with self._lock:
             nodes = self.cluster.list_nodes()
             self.store.bulk_ingest((n.name, n.annotations) for n in nodes)
-            seen = {n.name for n in nodes}
-            for name in set(self.store.node_names) - seen:
-                self.store.remove_node(name)
+            self.store.prune_absent(n.name for n in nodes)
             self.stats.refreshes += 1
             self.stats.last_refresh_at = self._clock()
 
@@ -144,6 +151,66 @@ class ScoringService:
             backend="oracle-fallback",
             staleness_seconds=0.0,
         )
+
+    def assign_batch(
+        self, num_pods: int, capacity: dict | None = None,
+        now: float | None = None,
+    ) -> "BatchAssignment":
+        """Gang-assign ``num_pods`` interchangeable pods across the
+        scored nodes (water-filling, same solver as the batch scheduler;
+        the north star's "scores/top-k placements out" surface). Never
+        raises: if the device path fails, the numpy host twin solves the
+        same placement from the oracle scores (both are parity-tested
+        against each other)."""
+        import numpy as np
+
+        from ..scorer.topk import gang_assign_host
+
+        if now is None:
+            now = self._clock()
+        verdicts = self.score_batch(now=now)
+        names = list(verdicts.scores)
+        scores = np.asarray([verdicts.scores[n] for n in names], np.int64)
+        schedulable = np.asarray([verdicts.schedulable[n] for n in names], bool)
+        cap = None
+        if capacity is not None:
+            cap = np.asarray(
+                [int(capacity.get(n, 1 << 30)) for n in names], np.int64
+            )
+        with self._lock:
+            try:
+                result = self._gang(scores, schedulable, num_pods, cap)
+                counts = np.asarray(result.counts)
+                unassigned = int(result.unassigned)
+                waterline = int(result.waterline)
+                backend = verdicts.backend
+            except Exception:
+                self.stats.fallbacks += 1
+                host = gang_assign_host(
+                    scores, schedulable, num_pods, self.tensors.hv_count,
+                    capacity=cap,
+                )
+                counts = np.asarray(host.counts)
+                unassigned = int(host.unassigned)
+                waterline = int(host.waterline)
+                backend = "host-fallback"
+        return BatchAssignment(
+            counts={names[i]: int(c) for i, c in enumerate(counts) if c},
+            unassigned=unassigned,
+            waterline=waterline,
+            backend=backend,
+            staleness_seconds=verdicts.staleness_seconds,
+        )
+
+    @property
+    def _gang(self):
+        from ..scorer.topk import GangScheduler
+
+        gang = getattr(self, "_gang_solver", None)
+        if gang is None:
+            gang = GangScheduler(self.tensors.hv_count)
+            self._gang_solver = gang
+        return gang
 
     def metrics(self) -> dict:
         """Exported counters (SURVEY §5: the reference has none)."""

@@ -157,6 +157,43 @@ def test_scoring_service_fail_open_fallback():
     assert svc.metrics()["fallbacks"] == 1
 
 
+def test_scoring_service_assign_matches_host_solver():
+    """The sidecar's placement surface: device gang counts equal the
+    numpy host twin on the same scores; fail-open when the device solver
+    dies."""
+    import numpy as np
+
+    from crane_scheduler_tpu.scorer.topk import gang_assign_host
+    from crane_scheduler_tpu.service import ScoringService
+
+    sim = make_sim(6, seed=8)
+    svc = ScoringService(sim.cluster, DEFAULT_POLICY)
+    svc.refresh()
+    now = sim.clock.now()
+    assignment = svc.assign_batch(20, capacity={f"node-{i:05d}": 5 for i in range(6)}, now=now)
+    verdicts = svc.score_batch(now=now)
+    names = list(verdicts.scores)
+    want = gang_assign_host(
+        np.asarray([verdicts.scores[n] for n in names]),
+        np.asarray([verdicts.schedulable[n] for n in names]),
+        20,
+        svc.tensors.hv_count,
+        capacity=np.asarray([5] * len(names)),
+    )
+    got = np.asarray([assignment.counts.get(n, 0) for n in names])
+    np.testing.assert_array_equal(got, np.asarray(want.counts))
+    assert assignment.unassigned == int(want.unassigned)
+    assert assignment.waterline == int(want.waterline)
+
+    def boom(*a, **k):
+        raise RuntimeError("device gone")
+
+    svc._gang_solver = type("Broken", (), {"__call__": boom})()
+    fb = svc.assign_batch(20, now=now)
+    assert fb.backend == "host-fallback"
+    assert sum(fb.counts.values()) + fb.unassigned == 20
+
+
 def test_scoring_http_server():
     from crane_scheduler_tpu.service import ScoringHTTPServer, ScoringService
 
@@ -182,8 +219,18 @@ def test_scoring_http_server():
             assert payload["scores"][node.name] == oracle.score_node(
                 dict(node.annotations), DEFAULT_POLICY.spec, sim.clock.now()
             )
+        req = urllib.request.Request(
+            f"{base}/v1/assign",
+            data=json.dumps({"numPods": 5, "now": sim.clock.now(),
+                             "refresh": False}).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assignment = json.load(r)
+        assert sum(assignment["counts"].values()) + assignment["unassigned"] == 5
         with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
-            assert json.load(r)["score_calls"] == 1
+            assert json.load(r)["score_calls"] >= 1
     finally:
         server.stop()
 
