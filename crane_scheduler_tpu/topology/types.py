@@ -11,6 +11,7 @@ format so result annotations round-trip.
 
 from __future__ import annotations
 
+import functools
 import json
 from dataclasses import dataclass, field
 from typing import Mapping, Protocol
@@ -86,7 +87,18 @@ def zones_to_json(zones: list[Zone]) -> str:
 
 def zones_from_json(raw: str) -> list[Zone] | None:
     """Parse a result annotation; None on any decode error
-    (ref: helper.go:76-88)."""
+    (ref: helper.go:76-88).
+
+    Memoized per raw string (Zone is frozen; each call returns a fresh
+    list over the shared immutable zones): node-wrapper rebuilds re-parse
+    every bound pod's result annotation each cycle.
+    """
+    zones = _zones_from_json_cached(raw) if isinstance(raw, str) else None
+    return list(zones) if zones is not None else None
+
+
+@functools.lru_cache(maxsize=65536)
+def _zones_from_json_cached(raw: str) -> tuple[Zone, ...] | None:
     try:
         docs = json.loads(raw)
     except (TypeError, ValueError):
@@ -94,7 +106,7 @@ def zones_from_json(raw: str) -> list[Zone] | None:
     if not isinstance(docs, list):
         return None
     try:
-        return [Zone.from_wire(d) for d in docs]
+        return tuple(Zone.from_wire(d) for d in docs)
     except (AttributeError, TypeError):
         return None
 

@@ -10,6 +10,7 @@ CPU to millicores (rounded up), everything else to whole units
 
 from __future__ import annotations
 
+import functools
 import math
 
 _BINARY = {"Ki": 1024, "Mi": 1024**2, "Gi": 1024**3, "Ti": 1024**4, "Pi": 1024**5, "Ei": 1024**6}
@@ -21,13 +22,23 @@ class QuantityError(ValueError):
 
 
 def parse_quantity(value) -> float:
-    """Parse a quantity into a float of base units."""
+    """Parse a quantity into a float of base units.
+
+    Pure, so string parses memoize: a cluster sweep parses the same few
+    quantity literals ("64000m", "256Gi", ...) per node per zone, and
+    the suffix scan dominated NUMA wrapper-build profiles.
+    """
     if isinstance(value, bool):
         raise QuantityError(f"invalid quantity {value!r}")
     if isinstance(value, (int, float)):
         return float(value)
     if not isinstance(value, str) or not value:
         raise QuantityError(f"invalid quantity {value!r}")
+    return _parse_str(value)
+
+
+@functools.lru_cache(maxsize=65536)
+def _parse_str(value: str) -> float:
     s = value.strip()
     for suffix, mult in _BINARY.items():
         if s.endswith(suffix):
