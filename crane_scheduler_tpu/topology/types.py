@@ -89,12 +89,29 @@ def zones_from_json(raw: str) -> list[Zone] | None:
     """Parse a result annotation; None on any decode error
     (ref: helper.go:76-88).
 
-    Memoized per raw string (Zone is frozen; each call returns a fresh
-    list over the shared immutable zones): node-wrapper rebuilds re-parse
-    every bound pod's result annotation each cycle.
+    Memoized per raw string: node-wrapper rebuilds re-parse every bound
+    pod's result annotation each cycle. Each call returns fresh Zone
+    objects with fresh resource dicts — Zone itself is frozen but its
+    resource Mappings are plain dicts, and handing out cache-shared
+    dicts would let one caller's mutation poison every later parse of
+    the same annotation.
     """
     zones = _zones_from_json_cached(raw) if isinstance(raw, str) else None
-    return list(zones) if zones is not None else None
+    if zones is None:
+        return None
+    return [
+        Zone(
+            name=z.name,
+            type=z.type,
+            resources=None
+            if z.resources is None
+            else ZoneResourceInfo(
+                allocatable=dict(z.resources.allocatable),
+                capacity=dict(z.resources.capacity),
+            ),
+        )
+        for z in zones
+    ]
 
 
 @functools.lru_cache(maxsize=65536)
