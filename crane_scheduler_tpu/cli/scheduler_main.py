@@ -73,16 +73,29 @@ def main(argv=None) -> int:
         stats = {"scheduled": 0, "unschedulable": 0}
         t0 = time.perf_counter()
         if args.batch_size > 0:
+            from ..topology import TopologyMatch
+
+            # same NUMA enforcement as plugin mode: the mixed-batch path
+            # takes the mirrored-CRD plugin when any CRs exist
+            topology = (
+                TopologyMatch(cluster.nrt_lister, cluster=cluster)
+                if cluster.nrt_lister.names()
+                else None
+            )
             batch = BatchScheduler(cluster, policy)
             for i in range(0, len(pending), args.batch_size):
                 result = batch.schedule_batch_mixed(
-                    pending[i : i + args.batch_size]
+                    pending[i : i + args.batch_size], topology=topology
                 )
                 stats["scheduled"] += len(result.assignments)
                 stats["unschedulable"] += len(result.unassigned)
         else:
             sched = build_scheduler_from_config(
-                cluster, config, nrt_lister=InMemoryNRTLister(), policy=policy
+                # the client mirrors NodeResourceTopology CRs when the
+                # CRD is installed; empty lister otherwise (plugin
+                # treats a missing CR as Unschedulable only for
+                # guaranteed-CPU pods it enforces)
+                cluster, config, nrt_lister=cluster.nrt_lister, policy=policy
             )
             for pod in pending:
                 result = sched.schedule_one(pod)

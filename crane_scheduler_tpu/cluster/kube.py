@@ -111,6 +111,31 @@ def _parse_wall_time(value) -> float:
         return 0.0
 
 
+NRT_API_PATH = "/apis/topology.crane.io/v1alpha1/noderesourcetopologies"
+
+
+def nrt_from_json(obj: dict):
+    """gocrane NodeResourceTopology CR -> topology model (ref: the
+    gocrane/api CRD shape consumed at
+    pkg/plugins/noderesourcetopology/plugin.go:31-71)."""
+    from ..topology.types import (
+        CraneManagerPolicy,
+        NodeResourceTopology,
+        Zone,
+    )
+
+    meta = obj.get("metadata", {})
+    policy = obj.get("craneManagerPolicy", {}) or {}
+    return NodeResourceTopology(
+        name=meta.get("name", ""),
+        crane_manager_policy=CraneManagerPolicy(
+            cpu_manager_policy=policy.get("cpuManagerPolicy", ""),
+            topology_manager_policy=policy.get("topologyManagerPolicy", ""),
+        ),
+        zones=tuple(Zone.from_wire(z) for z in obj.get("zones") or []),
+    )
+
+
 def event_from_json(obj: dict) -> Event:
     meta = obj.get("metadata", {})
     return Event(
@@ -167,6 +192,12 @@ class KubeClusterClient:
         self._context = context
         self._timeout = timeout
         self._mirror = ClusterState()
+        from ..topology.types import InMemoryNRTLister
+
+        # NodeResourceTopology CRD mirror (ref: initTopologyInformer,
+        # plugin.go:60-71); stays empty when the CRD isn't installed
+        self.nrt_lister = InMemoryNRTLister()
+        self._nrt_available = False
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self.watch_errors = 0
@@ -235,14 +266,27 @@ class KubeClusterClient:
             if key not in live:
                 self._mirror.delete_pod(key)
 
+    def _relist_nrt(self) -> None:
+        """NRT CRD twin of ``_relist_nodes`` (NRT watch thread only)."""
+        items = [
+            nrt_from_json(i)
+            for i in self._get_json(NRT_API_PATH).get("items", [])
+        ]
+        for nrt in items:
+            self.nrt_lister.upsert(nrt)
+        live = {nrt.name for nrt in items}
+        for name in [n for n in self.nrt_lister.names() if n not in live]:
+            self.nrt_lister.delete(name)
+
     def start(self) -> None:
-        """Initial list of nodes + pods, then watch threads for nodes,
-        pods, and Scheduled events (server-side filtered). Events need no
-        relist: missed Scheduled events age out of the hot-value windows
-        by design (the reference's informer replay has the same bound)."""
+        """Initial list of nodes + pods (+ NRT CRs when the CRD is
+        installed), then watch threads for each resource plus Scheduled
+        events (server-side filtered). Events need no relist: missed
+        Scheduled events age out of the hot-value windows by design (the
+        reference's informer replay has the same bound)."""
         self._relist_nodes()
         self._relist_pods()
-        watches = (
+        watches = [
             ("/api/v1/nodes?watch=1", self._apply_node, self._relist_nodes),
             ("/api/v1/pods?watch=1", self._apply_pod, self._relist_pods),
             (
@@ -251,7 +295,25 @@ class KubeClusterClient:
                 self._apply_event,
                 None,
             ),
-        )
+        ]
+        try:
+            self._relist_nrt()
+            self._nrt_available = True
+            watches.append(
+                (f"{NRT_API_PATH}?watch=1", self._apply_nrt, self._relist_nrt)
+            )
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                pass  # CRD not installed: Dynamic-only deployment, normal
+            else:
+                # transient 5xx / RBAC 403 at startup must not disable
+                # the mirror for the process lifetime: spawn the watch
+                # anyway — its relist+backoff loop retries
+                self.watch_errors += 1
+                self._nrt_available = True
+                watches.append(
+                    (f"{NRT_API_PATH}?watch=1", self._apply_nrt, self._relist_nrt)
+                )
         for path, apply, relist in watches:
             t = threading.Thread(
                 target=self._watch_loop, args=(path, apply, relist), daemon=True
@@ -316,6 +378,13 @@ class KubeClusterClient:
             self._mirror.delete_pod(pod.key())
         else:
             self._mirror.add_pod(pod)
+
+    def _apply_nrt(self, change_type: str, obj: dict) -> None:
+        nrt = nrt_from_json(obj)
+        if change_type == "DELETED":
+            self.nrt_lister.delete(nrt.name)
+        else:
+            self.nrt_lister.upsert(nrt)
 
     def _apply_event(self, change_type: str, obj: dict) -> None:
         if change_type == "DELETED":

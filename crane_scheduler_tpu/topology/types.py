@@ -171,5 +171,8 @@ class InMemoryNRTLister:
         self._items.pop(name, None)
         self._version += 1
 
+    def names(self) -> list[str]:
+        return list(self._items)
+
     def get(self, name: str) -> NodeResourceTopology:
         return self._items[name]

@@ -22,6 +22,8 @@ class KubeStubState:
         self.lock = threading.RLock()
         self.nodes: dict[str, dict] = {}
         self.pods: dict[str, dict] = {}
+        self.nrts: dict[str, dict] = {}
+        self.serve_nrt = True  # False simulates "CRD not installed" (404)
         self.events: list[dict] = []
         self.watchers: list[tuple[str, queue.Queue]] = []  # (kind, q)
         self.requests: list[tuple[str, str]] = []  # (method, path) log
@@ -39,6 +41,20 @@ class KubeStubState:
             obj = self.nodes.pop(name, None)
             if obj is not None:
                 self._notify("nodes", "DELETED", obj)
+
+    def add_nrt(self, name: str, cpu_manager_policy: str = "Static",
+                topology_manager_policy: str = "None",
+                zones: list | None = None):
+        with self.lock:
+            self.nrts[name] = {
+                "metadata": {"name": name},
+                "craneManagerPolicy": {
+                    "cpuManagerPolicy": cpu_manager_policy,
+                    "topologyManagerPolicy": topology_manager_policy,
+                },
+                "zones": list(zones or []),
+            }
+            self._notify("nrts", "ADDED", self.nrts[name])
 
     def add_pod(self, namespace: str, name: str, spec: dict | None = None,
                 annotations: dict | None = None):
@@ -142,6 +158,13 @@ def _make_handler(state: KubeStubState):
                     return self._watch("pods")
                 with state.lock:
                     return self._json(200, {"items": list(state.pods.values())})
+            if path == "/apis/topology.crane.io/v1alpha1/noderesourcetopologies":
+                if not state.serve_nrt:
+                    return self._json(404, {"message": "CRD not installed"})
+                if watching:
+                    return self._watch("nrts")
+                with state.lock:
+                    return self._json(200, {"items": list(state.nrts.values())})
             if path == "/api/v1/events" and watching:
                 flt = None
                 if "fieldSelector=" in query:
