@@ -75,11 +75,13 @@ def main(argv=None) -> int:
         if args.batch_size > 0:
             from ..topology import TopologyMatch
 
-            # same NUMA enforcement as plugin mode: the mixed-batch path
-            # takes the mirrored-CRD plugin when any CRs exist
+            # NUMA enforcement follows the scheduler CONFIG, exactly like
+            # plugin mode (an enabled plugin with no CRs marks
+            # guaranteed-CPU pods unschedulable in both paths — the
+            # reference's missing-CR semantics, filter.go:56-58)
             topology = (
                 TopologyMatch(cluster.nrt_lister, cluster=cluster)
-                if cluster.nrt_lister.names()
+                if "NodeResourceTopologyMatch" in set(profile.filter_enabled)
                 else None
             )
             batch = BatchScheduler(cluster, policy)

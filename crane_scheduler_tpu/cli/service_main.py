@@ -1,11 +1,14 @@
 """crane-scorer: the TPU scoring sidecar entrypoint.
 
-Serves the scoring API (POST /v1/score, POST /v1/refresh, GET /metrics,
-GET /healthz) over the current cluster state. The demo mode builds a
-simulated cluster with one annotator pass so the service has data.
+Serves the scoring API (POST /v1/score, POST /v1/assign, POST
+/v1/refresh, GET /metrics, GET /healthz) over the current cluster
+state: a live apiserver mirror (``--master``), or a simulated cluster
+with one annotator pass (``--demo-nodes``) so the service has data.
 
 Usage:
   python -m crane_scheduler_tpu.cli.service_main --port 8080 --demo-nodes 100
+  python -m crane_scheduler_tpu.cli.service_main --port 8099 \
+      --master https://apiserver:6443
 """
 
 from __future__ import annotations
@@ -20,6 +23,10 @@ def main(argv=None) -> int:
     parser.add_argument("--port", type=int, default=8080)
     parser.add_argument("--policy-config-path", default=None)
     parser.add_argument("--demo-nodes", type=int, default=0)
+    parser.add_argument("--master", default=None,
+                        help="kube-apiserver URL: score the live cluster "
+                             "via the informer mirror")
+    parser.add_argument("--token-file", default=None)
     parser.add_argument("--f32", action="store_true")
     parser.add_argument("--run-seconds", type=float, default=0.0)
     # multi-host (DCN): every process serves its node shard; see
@@ -57,7 +64,13 @@ def main(argv=None) -> int:
         else DEFAULT_POLICY
     )
 
-    if args.demo_nodes:
+    if args.master:
+        from ..cluster.kube import KubeClusterClient
+
+        cluster = KubeClusterClient.from_flags(args.master, args.token_file)
+        cluster.start()
+        print(f"kube mirror: {len(cluster.list_nodes())} nodes", flush=True)
+    elif args.demo_nodes:
         from ..sim import SimConfig, Simulator
 
         sim = Simulator(SimConfig(n_nodes=args.demo_nodes), policy=policy)

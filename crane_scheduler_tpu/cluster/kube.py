@@ -52,6 +52,8 @@ from .state import (
 DEFAULT_TIMEOUT_SECONDS = 10.0
 WATCH_TIMEOUT_SECONDS = 300.0
 SERVICE_ACCOUNT_TOKEN = "/var/run/secrets/kubernetes.io/serviceaccount/token"  # noqa: S105
+SERVICE_ACCOUNT_CA = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
+NRT_RETRY_SECONDS = 60.0  # re-probe cadence while the CRD is absent
 
 
 def node_from_json(obj: dict) -> Node:
@@ -168,7 +170,10 @@ class KubeClusterClient:
         cls, master: str, token_file: str | None = None
     ) -> "KubeClusterClient":
         """CLI/in-cluster construction: bearer token from ``token_file``
-        or the mounted service-account token when present."""
+        or the mounted service-account token, and the in-cluster CA
+        bundle when present (the apiserver's cert is signed by the
+        cluster CA, not anything in the system trust store — without
+        this, HTTPS in-cluster fails verification at the first list)."""
         import os
 
         token = None
@@ -178,7 +183,10 @@ class KubeClusterClient:
         if path:
             with open(path) as f:
                 token = f.read().strip()
-        return cls(master, token=token)
+        context = None
+        if os.path.exists(SERVICE_ACCOUNT_CA):
+            context = ssl.create_default_context(cafile=SERVICE_ACCOUNT_CA)
+        return cls(master, token=token, context=context)
 
     def __init__(
         self,
@@ -296,30 +304,56 @@ class KubeClusterClient:
                 None,
             ),
         ]
+        crd_absent = False
         try:
             self._relist_nrt()
             self._nrt_available = True
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                # CRD not installed (normal for Dynamic-only clusters):
+                # don't 404-loop a watch; a prober re-checks so a CRD
+                # applied later still gets mirrored without a restart
+                crd_absent = True
+            else:
+                self.watch_errors += 1  # transient 5xx / RBAC gap
+        except (urllib.error.URLError, OSError):
+            self.watch_errors += 1  # network blip: the watch loop retries
+        if crd_absent:
+            t = threading.Thread(target=self._nrt_prober, daemon=True)
+        else:
             watches.append(
                 (f"{NRT_API_PATH}?watch=1", self._apply_nrt, self._relist_nrt)
             )
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                pass  # CRD not installed: Dynamic-only deployment, normal
-            else:
-                # transient 5xx / RBAC 403 at startup must not disable
-                # the mirror for the process lifetime: spawn the watch
-                # anyway — its relist+backoff loop retries
-                self.watch_errors += 1
-                self._nrt_available = True
-                watches.append(
-                    (f"{NRT_API_PATH}?watch=1", self._apply_nrt, self._relist_nrt)
-                )
+            t = None
         for path, apply, relist in watches:
-            t = threading.Thread(
+            wt = threading.Thread(
                 target=self._watch_loop, args=(path, apply, relist), daemon=True
             )
+            wt.start()
+            self._threads.append(wt)
+        if t is not None:
             t.start()
             self._threads.append(t)
+
+    def _nrt_prober(self) -> None:
+        """Waits for the NRT CRD to appear (installed after this process
+        started), then becomes the NRT watch thread."""
+        while not self._stop.wait(timeout=NRT_RETRY_SECONDS):
+            try:
+                self._relist_nrt()
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    continue  # still absent
+                self.watch_errors += 1
+                continue
+            except (urllib.error.URLError, OSError):
+                self.watch_errors += 1
+                continue
+            self._nrt_available = True
+            self._watch_loop(
+                f"{NRT_API_PATH}?watch=1", self._apply_nrt, self._relist_nrt
+            )
+            return
 
     def stop(self) -> None:
         self._stop.set()

@@ -224,9 +224,13 @@ def test_nrt_crd_mirror_feeds_topology_plugin(stub, client):
     assert topo.filter(state, pod, node_info).ok()
 
 
-def test_nrt_crd_absent_is_tolerated(stub):
+def test_nrt_crd_absent_then_installed(stub, monkeypatch):
     """No CRD installed: the client starts normally with an empty lister
-    and no NRT watch error-looping."""
+    and no error-looping NRT watch (a slow prober waits instead); when
+    the CRD appears later, the prober picks it up without a restart."""
+    import crane_scheduler_tpu.cluster.kube as kube_mod
+
+    monkeypatch.setattr(kube_mod, "NRT_RETRY_SECONDS", 0.1)
     stub.state.serve_nrt = False
     stub.state.add_node("node-a", "10.0.0.1")
     c = KubeClusterClient(stub.url)
@@ -235,10 +239,15 @@ def test_nrt_crd_absent_is_tolerated(stub):
         assert c.nrt_lister.names() == []
         assert c._nrt_available is False
         assert c.get_node("node-a") is not None
-        # the claim in the docstring, actually asserted: no NRT watch
-        # thread was spawned (nodes + pods + events only), no errors
-        assert len(c._threads) == 3
+        # 3 watch threads + 1 prober; 404s are not counted as errors
+        assert len(c._threads) == 4
         assert c.watch_errors == 0
+
+        # the CRD lands after startup: the prober mirrors it
+        stub.state.serve_nrt = True
+        stub.state.add_nrt("node-a", zones=[])
+        assert _wait_until(lambda: "node-a" in c.nrt_lister.names())
+        assert c._nrt_available is True
     finally:
         c.stop()
 
