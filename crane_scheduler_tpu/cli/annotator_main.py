@@ -119,14 +119,34 @@ def main(argv=None) -> int:
         annotator.stop()
 
     if args.leader_elect:
-        elector = LeaderElector(
-            args.lock_file,
-            identity=f"crane-annotator-{os.getpid()}",
-            on_started_leading=run_annotator,
-        )
+        if args.master:
+            # lease-based election against the apiserver (ref:
+            # server.go:86-126) — works across pods, unlike a file lock
+            from ..service.kube_leader import KubeLeaderElector
+
+            import socket
+
+            elector = KubeLeaderElector(
+                cluster,
+                lease_name="crane-scheduler-tpu-annotator",
+                # hostname (the pod name in k8s) MUST be in the identity:
+                # every container's entrypoint is PID 1, so a pid-only
+                # identity would make two replicas treat each other's
+                # lease as their own (split-brain)
+                identity=f"crane-annotator-{socket.gethostname()}-{os.getpid()}",
+                on_started_leading=run_annotator,
+            )
+            print("leader election on lease crane-scheduler-tpu-annotator",
+                  flush=True)
+        else:
+            elector = LeaderElector(
+                args.lock_file,
+                identity=f"crane-annotator-{os.getpid()}",
+                on_started_leading=run_annotator,
+            )
+            print(f"leader election on {args.lock_file}", flush=True)
         thread = threading.Thread(target=elector.run, daemon=True)
         thread.start()
-        print(f"leader election on {args.lock_file}", flush=True)
     else:
         threading.Thread(target=run_annotator, args=(stop,), daemon=True).start()
 

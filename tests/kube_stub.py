@@ -24,6 +24,8 @@ class KubeStubState:
         self.pods: dict[str, dict] = {}
         self.nrts: dict[str, dict] = {}
         self.serve_nrt = True  # False simulates "CRD not installed" (404)
+        self.leases: dict[str, dict] = {}  # ns/name -> Lease object
+        self._lease_rv = 0
         self.events: list[dict] = []
         self.watchers: list[tuple[str, queue.Queue]] = []  # (kind, q)
         self.requests: list[tuple[str, str]] = []  # (method, path) log
@@ -165,6 +167,13 @@ def _make_handler(state: KubeStubState):
                     return self._watch("nrts")
                 with state.lock:
                     return self._json(200, {"items": list(state.nrts.values())})
+            if "/leases/" in path:
+                with state.lock:
+                    key = "/".join(path.strip("/").split("/")[-3::2])
+                    lease = state.leases.get(key)
+                    if lease is None:
+                        return self._json(404, {"message": "lease not found"})
+                    return self._json(200, lease)
             if path == "/api/v1/events" and watching:
                 flt = None
                 if "fieldSelector=" in query:
@@ -182,6 +191,19 @@ def _make_handler(state: KubeStubState):
             annotations = body.get("metadata", {}).get("annotations", {})
             parts = self.path.strip("/").split("/")
             with state.lock:
+                if "/leases/" in self.path:
+                    key = f"{parts[-3]}/{parts[-1]}"
+                    lease = state.leases.get(key)
+                    if lease is None:
+                        return self._json(404, {"message": "lease not found"})
+                    expected = body.get("metadata", {}).get("resourceVersion")
+                    current = lease["metadata"]["resourceVersion"]
+                    if expected is not None and str(expected) != str(current):
+                        return self._json(409, {"message": "resourceVersion conflict"})
+                    lease["spec"].update(body.get("spec", {}))
+                    state._lease_rv += 1
+                    lease["metadata"]["resourceVersion"] = str(state._lease_rv)
+                    return self._json(200, lease)
                 if self.path.startswith("/api/v1/nodes/"):
                     name = parts[-1]
                     node = state.nodes.get(name)
@@ -205,6 +227,19 @@ def _make_handler(state: KubeStubState):
             body = self._read_body()
             parts = self.path.strip("/").split("/")
             with state.lock:
+                if parts[-1] == "leases":
+                    ns = parts[-2]
+                    name = body.get("metadata", {}).get("name", "")
+                    key = f"{ns}/{name}"
+                    if key in state.leases:
+                        return self._json(409, {"message": "lease exists"})
+                    state._lease_rv += 1
+                    state.leases[key] = {
+                        "metadata": {"name": name, "namespace": ns,
+                                     "resourceVersion": str(state._lease_rv)},
+                        "spec": dict(body.get("spec", {})),
+                    }
+                    return self._json(201, state.leases[key])
                 if self.path.endswith("/binding"):
                     namespace, name = parts[-4], parts[-2]
                     key = f"{namespace}/{name}"

@@ -252,6 +252,52 @@ def test_nrt_crd_absent_then_installed(stub, monkeypatch):
         c.stop()
 
 
+def test_lease_leader_election_single_winner_and_failover(stub):
+    """Lease-based election (ref: server.go:86-126): one winner among
+    two candidates racing the same Lease (CAS on resourceVersion), and
+    the loser takes over after the holder stops renewing."""
+    import threading
+    import time as _time
+
+    from crane_scheduler_tpu.service.kube_leader import KubeLeaderElector
+
+    c1 = KubeClusterClient(stub.url)
+    c2 = KubeClusterClient(stub.url)
+    leaders = []
+    lock = threading.Lock()
+
+    def make(name, client):
+        def on_start(stop_event):
+            with lock:
+                leaders.append(name)
+            stop_event.wait()
+
+        return KubeLeaderElector(
+            client, "test-lease", name, on_start,
+            lease_duration=0.6, renew_deadline=0.4, retry_period=0.1,
+        )
+
+    e1, e2 = make("a", c1), make("b", c2)
+    threads = [threading.Thread(target=e.run, daemon=True) for e in (e1, e2)]
+    for t in threads:
+        t.start()
+    deadline = _time.time() + 5
+    while not leaders and _time.time() < deadline:
+        _time.sleep(0.02)
+    _time.sleep(0.3)  # give the loser time to (wrongly) grab it
+    assert len(leaders) == 1, leaders
+    winner = leaders[0]
+
+    # holder stops renewing -> the lease expires -> the other takes over
+    (e1 if winner == "a" else e2).stop()
+    deadline = _time.time() + 8
+    while len(leaders) < 2 and _time.time() < deadline:
+        _time.sleep(0.05)
+    assert len(leaders) == 2 and leaders[1] != winner, leaders
+    for e in (e1, e2):
+        e.stop()
+
+
 def test_watch_reconnect_relists_and_dedups_events(stub, client):
     """A dropped watch must not lose deltas or double-count events: on
     reconnect the client relists (a node deleted while disconnected
