@@ -370,6 +370,7 @@ class KubeClusterClient:
         apply: Callable[[str, dict], None],
         relist: Callable[[], None] | None,
     ) -> None:
+        failures = 0
         while not self._stop.is_set():
             try:
                 with self._request(
@@ -391,12 +392,19 @@ class KubeClusterClient:
                             continue
                         change = json.loads(line)
                         apply(change.get("type", ""), change.get("object", {}))
+                        # reset only on DELIVERED events, not on mere
+                        # connection establishment: a flapping apiserver
+                        # that accepts watches then fails the stream must
+                        # still escalate the backoff
+                        failures = 0
             except (urllib.error.URLError, OSError, json.JSONDecodeError):
                 self.watch_errors += 1
-            # backoff on clean stream end too: a proxy/apiserver that
-            # closes watches immediately must not induce a tight
-            # relist+rewatch loop
-            if self._stop.wait(timeout=1.0):
+                failures += 1
+            # backoff on clean stream end too (a proxy that closes
+            # watches immediately must not induce a tight relist loop);
+            # exponential while the apiserver keeps failing, so an
+            # outage isn't hammered at 1 rps per watcher forever
+            if self._stop.wait(timeout=min(30.0, 1.0 * (2 ** min(failures, 5)))):
                 return
 
     def _apply_node(self, change_type: str, obj: dict) -> None:
