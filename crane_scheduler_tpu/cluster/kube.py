@@ -397,6 +397,12 @@ class KubeClusterClient:
                         # that accepts watches then fails the stream must
                         # still escalate the backoff
                         failures = 0
+            except TimeoutError:
+                # normal idle-watch expiry on a quiet cluster (the read
+                # blocked the whole watch timeout with nothing to say) —
+                # NOT a failure; escalating here would delay delivery of
+                # the next real event by up to the backoff cap
+                pass
             except (urllib.error.URLError, OSError, json.JSONDecodeError):
                 self.watch_errors += 1
                 failures += 1

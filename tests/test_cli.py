@@ -65,3 +65,61 @@ def test_annotator_main_nodes_file(capsys, tmp_path):
         ]
     )
     assert rc == 0
+
+
+def test_service_main_demo_scores_and_assigns():
+    """The scorer sidecar entrypoint end to end: demo cluster, HTTP up,
+    /v1/score and /v1/assign both answer; the test signals the process
+    to stop as soon as the requests succeed."""
+    import json as _json
+    import os
+    import signal
+    import socket
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+
+    from crane_scheduler_tpu.cli import service_main
+
+    with socket.socket() as s:  # pre-pick a free port: no stdout scraping
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    results = {}
+
+    def poke():
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v1/score",
+                    data=_json.dumps({}).encode(),
+                    method="POST",
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    results["score"] = _json.load(r)
+                break
+            except (urllib.error.URLError, OSError):
+                time.sleep(0.1)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/assign",
+            data=_json.dumps({"numPods": 4}).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            results["assign"] = _json.load(r)
+        os.kill(os.getpid(), signal.SIGTERM)  # stop main() immediately
+
+    t = threading.Thread(target=poke, daemon=True)
+    t.start()
+    rc = service_main.main(
+        ["--port", str(port), "--demo-nodes", "4", "--run-seconds", "30",
+         "--f32"]
+    )
+    t.join(timeout=10)
+    assert rc == 0
+    assert len(results["score"]["scores"]) == 4
+    out = results["assign"]
+    assert sum(out["counts"].values()) + out["unassigned"] == 4
