@@ -239,7 +239,14 @@ class NodeAnnotator:
         self.cluster.patch_node_annotation(node.name, NODE_HOT_VALUE_KEY, anno)
         if self._store is not None and self.config.direct_store:
             v, ts = decode_annotation_or_missing(anno)
-            self._store.set_hot_value(node.name, v, ts, create=False)
+            # Same liveness-checked row resolution as set_metric above: a
+            # new node whose hot-value sync lands before any metric write
+            # must still get a store row, or its hot value stays stale
+            # until the next bulk tick despite the annotation patch.
+            self._store.set_hot_value(
+                node.name, v, ts,
+                create=self.cluster.get_node(node.name) is not None,
+            )
         return anno
 
     def enqueue_metric(self, metric_name: str) -> None:

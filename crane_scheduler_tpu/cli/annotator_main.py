@@ -118,6 +118,15 @@ def main(argv=None) -> int:
         stop_event.wait()
         annotator.stop()
 
+    def lost_lease():
+        # Reference contract: panic on lost lease so kubelet restarts the
+        # pod and it re-enters the election (ref: server.go:119-121).
+        # Without this a replica that loses its lease (e.g. a transient
+        # apiserver outage past the renew deadline) would park forever as
+        # a passive zombie with a healthy /healthz.
+        print("lost leader lease; exiting for restart", flush=True)
+        os._exit(1)
+
     if args.leader_elect:
         if args.master:
             # lease-based election against the apiserver (ref:
@@ -135,6 +144,7 @@ def main(argv=None) -> int:
                 # lease as their own (split-brain)
                 identity=f"crane-annotator-{socket.gethostname()}-{os.getpid()}",
                 on_started_leading=run_annotator,
+                on_stopped_leading=lost_lease,
             )
             print("leader election on lease crane-scheduler-tpu-annotator",
                   flush=True)
@@ -143,6 +153,7 @@ def main(argv=None) -> int:
                 args.lock_file,
                 identity=f"crane-annotator-{os.getpid()}",
                 on_started_leading=run_annotator,
+                on_stopped_leading=lost_lease,
             )
             print(f"leader election on {args.lock_file}", flush=True)
         thread = threading.Thread(target=elector.run, daemon=True)

@@ -516,8 +516,12 @@ class KubeClusterClient:
         except self._WRITE_ERRORS:
             return False
         # optimistic local apply: the writer's next read sees its write
-        # (the watch will deliver the authoritative object too)
-        return self._mirror.patch_node_annotation(name, key, value)
+        # (the watch will deliver the authoritative object too). The API
+        # write already succeeded, so report True even if the object has
+        # not reached the mirror yet (watch lag) — a False here would
+        # make callers retry an already-applied write.
+        self._mirror.patch_node_annotation(name, key, value)
+        return True
 
     def patch_pod_annotation(self, key: str, anno_key: str, value: str) -> bool:
         """PreBind's pod-annotation patch (ref: binder.go:19-65)."""
@@ -533,7 +537,10 @@ class KubeClusterClient:
                 pass
         except self._WRITE_ERRORS:
             return False
-        return self._mirror.patch_pod_annotation(key, anno_key, value)
+        # API write succeeded; mirror apply is best-effort (watch lag —
+        # the pod may not have reached the mirror yet).
+        self._mirror.patch_pod_annotation(key, anno_key, value)
+        return True
 
     def add_pod(self, pod: Pod) -> None:
         """Create the pod via the API (primarily for tests/tools; real

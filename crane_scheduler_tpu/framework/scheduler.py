@@ -186,7 +186,13 @@ class Scheduler:
         prev = self.cluster.get_pod(pod.key())
         was_bound = prev is not None and bool(prev.node_name)
         pre_version = self.cluster.sched_version
-        self.cluster.bind_pod(pod.key(), best_name, self._clock())
+        if not self.cluster.bind_pod(pod.key(), best_name, self._clock()):
+            # Bind failed (e.g. transient apiserver error through
+            # KubeClusterClient). Reporting the pod as scheduled — or
+            # stamping the snapshot cache via _note_bind — would poison
+            # the cache with a phantom pod at pre_version+1.
+            self._unreserve(state, pod, best_name)
+            return ScheduleResult(pod.key(), None, len(feasible), "bind failed")
         self._note_bind(pod.key(), best_name, pre_version, was_bound)
         return ScheduleResult(pod.key(), best_name, len(feasible), scores=totals)
 
@@ -391,8 +397,21 @@ class BatchScheduler:
         result = self._build_result(packed, [pod.key() for pod in pods], now=now)
 
         if bind:
-            self.cluster.bind_pods(result.assignments, now)
+            self._apply_binds(result, now)
         return result
+
+    def _apply_binds(self, result: BatchResult, now: float) -> None:
+        """Bind the batch and reconcile the result with what actually
+        bound: keys bind_pods could not bind (transient apiserver errors
+        through KubeClusterClient) move to ``unassigned`` — reporting
+        them as scheduled would be the phantom-placement bug fixed in
+        ``schedule_one``."""
+        bound = set(self.cluster.bind_pods(result.assignments, now))
+        if len(bound) != len(result.assignments):
+            failed = [k for k in result.assignments if k not in bound]
+            for k in failed:
+                del result.assignments[k]
+            result.unassigned.extend(failed)
 
     def schedule_batches_pipelined(self, batches, bind: bool = True,
                                    depth: int = 4):
@@ -441,7 +460,7 @@ class BatchScheduler:
         packed = np.asarray(dev)  # the only synchronization point
         result = self._build_result(packed, keys, now=now, names=names, n=n)
         if bind:
-            self.cluster.bind_pods(result.assignments, now)
+            self._apply_binds(result, now)
         return result
 
     @staticmethod

@@ -1,0 +1,180 @@
+"""Regression tests for round-2 advisor findings (ADVICE.md round 3).
+
+Each test pins one finding:
+- scheduler.schedule_one must not report a pod scheduled (nor stamp the
+  snapshot cache) when the cluster bind fails;
+- KubeClusterClient annotation patches report True once the HTTP write
+  succeeds, even when the object hasn't reached the informer mirror yet;
+- the annotator's direct-store hot-value write creates the store row for
+  a live node whose hot-value sync lands before any metric write;
+- the shipped RBAC grants the 'patch' verb on leases (the elector renews
+  exclusively via merge-PATCH) and doesn't carry the unused 'update';
+- annotator_main wires on_stopped_leading so a lost lease exits the
+  process (the reference's panic contract, server.go:119-121).
+"""
+
+import os
+
+from crane_scheduler_tpu.sim import SimConfig, Simulator
+
+
+def make_sim(n_nodes=3, seed=0):
+    sim = Simulator(SimConfig(n_nodes=n_nodes, seed=seed))
+    sim.sync_metrics()
+    return sim
+
+
+def test_schedule_one_reports_unscheduled_on_bind_failure():
+    sim = make_sim(3)
+    sched = sim.build_scheduler()
+
+    ok = sim.make_pod(cpu_milli=100)
+    res_ok = sched.schedule_one(ok)
+    assert res_ok.node is not None
+
+    # Make the next bind fail the way a transient apiserver error does
+    # through KubeClusterClient (bind_pod -> False).
+    real_bind = sim.cluster.bind_pod
+    sim.cluster.bind_pod = lambda *a, **k: False
+    try:
+        pod = sim.make_pod(cpu_milli=100)
+        pre_version = sim.cluster.sched_version
+        result = sched.schedule_one(pod)
+        assert result.node is None
+        assert "bind" in (result.reason or "")
+        # no phantom bind reached the cluster, and no cache stamp for
+        # pre_version+1 was recorded
+        assert sim.cluster.sched_version == pre_version
+        assert sim.cluster.get_pod(pod.key()).node_name in (None, "")
+    finally:
+        sim.cluster.bind_pod = real_bind
+
+    # scheduler still works afterwards and the cache is not poisoned:
+    # the next successful bind must land on real state
+    pod2 = sim.make_pod(cpu_milli=100)
+    res2 = sched.schedule_one(pod2)
+    assert res2.node is not None
+    assert sim.cluster.get_pod(pod2.key()).node_name == res2.node
+
+
+def test_hot_value_direct_store_creates_row_for_live_node():
+    """A node whose hot-value annotation syncs before any metric write
+    still gets a store row (ADVICE finding 4)."""
+    from crane_scheduler_tpu.annotator import AnnotatorConfig, NodeAnnotator
+    from crane_scheduler_tpu.cluster import ClusterState, Node, NodeAddress
+    from crane_scheduler_tpu.loadstore import NodeLoadStore
+    from crane_scheduler_tpu.metrics import FakeMetricsSource
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY, compile_policy
+
+    cluster = ClusterState()
+    node = Node(name="n1", addresses=(NodeAddress("InternalIP", "10.0.0.1"),))
+    cluster.add_node(node)
+    annotator = NodeAnnotator(
+        cluster,
+        FakeMetricsSource(),
+        DEFAULT_POLICY,
+        AnnotatorConfig(direct_store=True),
+    )
+    store = NodeLoadStore(compile_policy(DEFAULT_POLICY))
+    annotator.attach_store(store)
+    now = 1753776000.0
+    annotator.annotate_node_hot_value(node, now)
+    # the row exists and carries the hot value written to the annotation
+    assert "n1" in store.node_names
+    i = store.node_id("n1")
+    assert float(store.hot_ts[i]) == now
+
+
+def test_rbac_grants_patch_on_leases():
+    import yaml
+
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "deploy", "controller", "rbac.yaml"
+    )
+    with open(path) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    roles = [d for d in docs if d.get("kind") == "ClusterRole"]
+    assert roles
+    lease_rules = [
+        r
+        for role in roles
+        for r in role.get("rules", [])
+        if "leases" in r.get("resources", [])
+    ]
+    assert lease_rules
+    for rule in lease_rules:
+        verbs = set(rule["verbs"])
+        assert "patch" in verbs  # the elector renews via merge-PATCH
+        assert "update" not in verbs  # elector never PUTs
+
+
+def test_annotator_main_wires_lost_lease_exit(monkeypatch, tmp_path):
+    """A lost lease must exit the process (reference panic contract)."""
+    import threading
+
+    from crane_scheduler_tpu.cli import annotator_main
+    from crane_scheduler_tpu.service import leader as leader_mod
+
+    captured = {}
+
+    class CapturingElector:
+        def __init__(self, *a, **kw):
+            captured["on_stopped_leading"] = kw.get("on_stopped_leading")
+            captured["on_started_leading"] = kw.get("on_started_leading")
+
+        def run(self):
+            pass
+
+    # the CLI does `from ..service.leader import LeaderElector` inside
+    # main(), so patching the module attribute is enough
+    monkeypatch.setattr(leader_mod, "LeaderElector", CapturingElector)
+
+    exited = {}
+    monkeypatch.setattr(os, "_exit", lambda code: exited.setdefault("code", code))
+
+    rc = annotator_main.main(
+        [
+            "--demo-nodes",
+            "2",
+            "--leader-elect",
+            "--lock-file",
+            str(tmp_path / "l.lock"),
+            "--run-seconds",
+            "0.2",
+            "--health-port",
+            "0",
+        ]
+    )
+    assert rc == 0
+    hook = captured.get("on_stopped_leading")
+    assert hook is not None, "annotator_main must wire on_stopped_leading"
+    hook()
+    assert exited.get("code") == 1
+
+
+def test_schedule_batch_moves_failed_binds_to_unassigned():
+    """BatchScheduler must not report phantom placements when binds fail
+    (review finding on the schedule_one fix: same defect class)."""
+    sim = make_sim(4, seed=2)
+    batch = sim.build_batch_scheduler()
+    pods = [sim.make_pod() for _ in range(6)]
+    fail_keys = {pods[1].key(), pods[4].key()}
+    real_bind_pods = sim.cluster.bind_pods
+
+    def flaky_bind_pods(assignments, now=None):
+        items = (
+            assignments.items() if hasattr(assignments, "items") else assignments
+        )
+        kept = [(k, n) for k, n in items if k not in fail_keys]
+        return real_bind_pods(kept, now)
+
+    sim.cluster.bind_pods = flaky_bind_pods
+    try:
+        result = batch.schedule_batch(pods)
+    finally:
+        sim.cluster.bind_pods = real_bind_pods
+    assert fail_keys.isdisjoint(result.assignments)
+    assert fail_keys <= set(result.unassigned)
+    assert len(result.assignments) == 4
+    bound = {p.key() for p in sim.cluster.list_pods() if p.node_name}
+    assert bound == set(result.assignments)

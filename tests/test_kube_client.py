@@ -332,3 +332,16 @@ def test_watch_reconnect_relists_and_dedups_events(stub, client):
         if m == "GET" and p == "/api/v1/nodes"
     ]
     assert len(node_lists) >= 2
+
+
+def test_annotation_patch_true_despite_mirror_lag(stub, client):
+    """A successful API PATCH reports True even when the object hasn't
+    reached the informer mirror yet (watch lag) — a False would make
+    callers retry an already-applied write (ADVICE r2 finding 5)."""
+    stub.state.add_node("node-a", "10.0.0.1")
+    stub.state.add_pod("default", "p1")
+    # client NOT started: the mirror is empty, but HTTP writes work
+    assert client.patch_pod_annotation("default/p1", "k", "v") is True
+    assert client.patch_node_annotation("node-a", "k", "v") is True
+    assert stub.state.pods["default/p1"]["metadata"]["annotations"]["k"] == "v"
+    assert stub.state.nodes["node-a"]["metadata"]["annotations"]["k"] == "v"
