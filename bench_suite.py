@@ -259,61 +259,102 @@ def config5(dtype, rtt):
           "assigned": int(np.asarray(result.counts).sum())})
 
 
-def config6(dtype, rtt):
-    """Beyond BASELINE: FULL-LOOP sustained throughput. Each cycle pays
-    everything a real deployment pays on one box: device filter+score+
-    gang solve, the packed fetch (pipelined, depth 4), creating + binding
-    every assigned pod, Scheduled-event emission + parse + binding-heap
-    push (hot-value feedback), and a bulk annotator sync (direct-store
-    mode) every cycle. Reports sustained pods/s for the whole loop."""
+def _burst_parity(batch, result, n_pods) -> str:
+    """Burst-path placement parity vs the exact f64/Go host path on the
+    same store snapshot (arrays, no per-pod dict materialization)."""
+    from crane_scheduler_tpu.scorer.parity import ParityError, check_placement_parity
+
+    snap = batch.store.snapshot()
+    n = snap.n_nodes
+    idx = np.asarray(result.node_idx)
+    counts = np.bincount(idx[idx >= 0], minlength=n).astype(np.int64)
+    try:
+        check_placement_parity(
+            values=snap.values[:n], ts=snap.ts[:n],
+            hot_value=snap.hot_value[:n], hot_ts=snap.hot_ts[:n],
+            node_valid=snap.node_valid[:n], now=result.now,
+            tensors=batch.tensors,
+            schedulable=np.asarray(result.schedulable_row),
+            scores=np.asarray(result.scores_row),
+            counts=counts, num_pods=n_pods,
+            unassigned=int((idx < 0).sum()),
+        )
+    except ParityError as e:
+        return f"FAIL: {e}"
+    return "ok"
+
+
+def config6(dtype, rtt, node_scales=(10_000, 50_000)):
+    """Beyond BASELINE: FULL-LOOP sustained throughput in columnar burst
+    mode, at 10k AND 50k nodes. Each cycle pays everything a real
+    deployment pays on one box: device filter+score+gang solve, the
+    packed fetch (pipelined, depth 4), columnar bind application
+    (``ClusterState.bind_burst``), Scheduled-event feedback into the
+    binding heap (columnar delivery), the deferred annotation-contract
+    flush, and a bulk annotator sync (direct-store mode) every cycle —
+    the reference syncs each metric every 3m-3h (policy.yaml), so
+    per-cycle is the worst case. Placements are parity-gated against the
+    f64/Go host path before the timed loop."""
     from crane_scheduler_tpu.framework.scheduler import BatchScheduler
-    from crane_scheduler_tpu.cluster import Pod
 
-    n_nodes, pods_per_cycle, cycles = 10_000, 20_000, 6
-    sim = _sim(n_nodes, seed=6)
-    ann = sim.annotator
-    ann.config.bulk_sync = True
-    ann.config.direct_store = True
-    batch = BatchScheduler(
-        sim.cluster, sim.policy, dtype=dtype, clock=sim.clock,
-        snapshot_bucket=16384, refresh_from_cluster=False,
-    )
-    ann.attach_store(batch.store)
-    ann.sync_all_once_bulk(sim.clock())
+    for n_nodes in node_scales:
+        pods_per_cycle, cycles = 100_000, 6
+        sim = _sim(n_nodes, seed=6)
+        ann = sim.annotator
+        ann.config.bulk_sync = True
+        ann.config.direct_store = True
+        batch = BatchScheduler(
+            sim.cluster, sim.policy, dtype=dtype, clock=sim.clock,
+            snapshot_bucket=16384, refresh_from_cluster=False,
+        )
+        ann.attach_store(batch.store)
+        ann.sync_all_once_bulk(sim.clock())
 
-    seq = [0]
+        seq = [0]
 
-    def make_batch():
-        pods = []
-        for _ in range(pods_per_cycle):
+        def make_names():
+            base = seq[0] * pods_per_cycle
             seq[0] += 1
-            pod = Pod(name=f"bench6-{seq[0]}", namespace="bench")
-            sim.cluster.add_pod(pod)
-            pods.append(pod)
-        return pods
+            return [f"bench6-{base + i}" for i in range(pods_per_cycle)]
 
-    # warm (compile + first uploads)
-    for _ in batch.schedule_batches_pipelined([make_batch()], bind=True):
-        pass
+        # parity gate on the live store state (bind=False probe), then
+        # warm the compiled path with one bound burst
+        probe = batch.schedule_pod_burst("bench", make_names(), bind=False)
+        parity = _burst_parity(batch, probe, pods_per_cycle)
+        for _ in batch.schedule_bursts_pipelined(
+            [("bench", make_names())], bind=True
+        ):
+            pass
 
-    def cycle_stream():
-        for _ in range(cycles):
-            ann.sync_all_once_bulk(sim.clock())  # feedback -> store
-            yield make_batch()
+        phase = {"sync": 0.0, "flush": 0.0}
 
-    t0 = time.perf_counter()
-    assigned = 0
-    for result in batch.schedule_batches_pipelined(cycle_stream(), bind=True):
-        assigned += len(result.assignments)
-    wall = time.perf_counter() - t0
-    emit({"config": 6,
-          "desc": "full loop: solve+fetch+bind+events+hot-values+annotator sync "
-                  f"({n_nodes} nodes, {pods_per_cycle} pods/cycle, pipelined)",
-          "cycles": cycles,
-          "assigned": assigned,
-          "wall_s": round(wall, 2),
-          "pods_per_sec": round(assigned / wall),
-          "ms_per_cycle": round(wall / cycles * 1e3, 1)})
+        def cycle_stream():
+            for _ in range(cycles):
+                t0 = time.perf_counter()
+                ann.sync_all_once_bulk(sim.clock())  # feedback -> store
+                phase["sync"] += time.perf_counter() - t0
+                t0 = time.perf_counter()
+                ann.flush_annotations()  # annotation contract catch-up
+                phase["flush"] += time.perf_counter() - t0
+                yield ("bench", make_names())
+
+        t0 = time.perf_counter()
+        assigned = 0
+        for result in batch.schedule_bursts_pipelined(cycle_stream(), bind=True):
+            assigned += result.n_assigned
+        wall = time.perf_counter() - t0
+        emit({"config": 6,
+              "desc": "full loop, columnar burst: solve+fetch+bind+events+"
+                      "hot-values+annotator sync+annotation flush "
+                      f"({n_nodes} nodes, {pods_per_cycle} pods/cycle, pipelined)",
+              "cycles": cycles,
+              "assigned": assigned,
+              "parity": parity,
+              "wall_s": round(wall, 2),
+              "pods_per_sec": round(assigned / wall),
+              "ms_per_cycle": round(wall / cycles * 1e3, 1),
+              "sync_ms_per_cycle": round(phase["sync"] / cycles * 1e3, 1),
+              "flush_ms_per_cycle": round(phase["flush"] / cycles * 1e3, 1)})
 
 
 def main(argv=None) -> int:

@@ -58,6 +58,20 @@ class BindingRecords:
                     self._heap, (binding.timestamp, self._seq, binding)
                 )
 
+    def add_bind_columns(self, node_table, node_idx, ts: int) -> None:
+        """Columnar push: one (node_table[i], ts) record per ``node_idx``
+        entry — identical heap state to ``add_binding_batch`` over
+        equivalent Bindings (namespace/pod are not part of the count
+        semantics, ref: binding.go:81-97)."""
+        ts = int(ts)
+        bindings = [
+            Binding(
+                node=node_table[int(i)], namespace="", pod_name="", timestamp=ts
+            )
+            for i in node_idx
+        ]
+        self.add_binding_batch(bindings)
+
     def get_last_node_binding_count(
         self, node: str, time_range_seconds: float, now: float | None = None
     ) -> int:

@@ -38,6 +38,7 @@ from ..loadstore.codec import (
     encode_annotation,
     go_parse_float,
 )
+from ..utils.timeutil import format_local_time
 from ..loadstore.store import NodeLoadStore
 from ..metrics.source import MetricsQueryError, MetricsSource
 from ..policy.types import DynamicSchedulerPolicy
@@ -114,8 +115,15 @@ class NodeAnnotator:
         self._stop = threading.Event()
         # direct-store mode (AnnotatorConfig.direct_store)
         self._store: NodeLoadStore | None = None
-        self._anno_pending: list[tuple[str, str, str]] = []
+        # deferred annotation patches, coalesced last-write-wins per
+        # (node, key): annotation writes are idempotent state, so a slow
+        # flusher never backlogs more than |nodes| x (|metrics|+1)
+        # entries, and re-syncs between flushes collapse to one patch
+        self._anno_pending: dict[tuple[str, str], str] = {}
         self._anno_lock = threading.Lock()
+        # (node_set_version, [(name, ip)]) — a bulk sweep re-reads the
+        # same pair list |metrics| times per cycle
+        self._node_pairs_cache: tuple[int, list[tuple[str, str]]] | None = None
 
     def attach_store(self, store: NodeLoadStore) -> NodeLoadStore:
         """Register the store that direct-mode bulk syncs write into."""
@@ -124,16 +132,48 @@ class NodeAnnotator:
 
     def _emit_annotation(self, node_name: str, key: str, raw: str) -> None:
         with self._anno_lock:
-            self._anno_pending.append((node_name, key, raw))
+            self._anno_pending[(node_name, key)] = raw
+
+    def _emit_annotations_bulk(self, items) -> None:
+        """One lock hold for a whole sweep's deferred patches.
+        ``items``: iterable of ``((node, key), raw)`` pairs or a dict."""
+        with self._anno_lock:
+            self._anno_pending.update(items)
+
+    def _node_pairs(self) -> list[tuple[str, str]]:
+        """(name, internal_ip) per node, cached on the cluster's node-set
+        version (annotation patches don't change names/addresses)."""
+        version = getattr(self.cluster, "node_set_version", None)
+        if version is None:
+            return [(n.name, n.internal_ip()) for n in self.cluster.list_nodes()]
+        cache = self._node_pairs_cache
+        if cache is None or cache[0] != version:
+            cache = (
+                version,
+                [(n.name, n.internal_ip()) for n in self.cluster.list_nodes()],
+            )
+            self._node_pairs_cache = cache
+        return cache[1]
 
     def flush_annotations(self) -> int:
         """Apply deferred annotation patches (direct mode writes the store
         first; the annotation contract catches up here — from the emitter
-        thread in threaded mode, or explicitly in synchronous tests)."""
+        thread in threaded mode, or explicitly in synchronous tests).
+        Uses the cluster's bulk patch primitive when present (one
+        lock/PATCH per node instead of per (node, key))."""
         with self._anno_lock:
-            pending, self._anno_pending = self._anno_pending, []
-        for node_name, key, raw in pending:
-            self.cluster.patch_node_annotation(node_name, key, raw)
+            pending, self._anno_pending = self._anno_pending, {}
+        if not pending:
+            return 0
+        bulk = getattr(self.cluster, "patch_node_annotations_bulk", None)
+        if bulk is not None:
+            per_node: dict[str, dict[str, str]] = {}
+            for (node_name, key), raw in pending.items():
+                per_node.setdefault(node_name, {})[key] = raw
+            bulk(per_node)
+        else:
+            for (node_name, key), raw in pending.items():
+                self.cluster.patch_node_annotation(node_name, key, raw)
         return len(pending)
 
     # -- core sync logic ---------------------------------------------------
@@ -263,7 +303,15 @@ class NodeAnnotator:
             for node_name in self.cluster.node_names():
                 self.sync_node(_meta_key(node_name, sp.name), now)
 
-    def sync_metric_bulk(self, metric_name: str, now: float | None = None) -> int:
+    _HOT_UNSET = object()  # sentinel: compute hot values in this call
+
+    def sync_metric_bulk(
+        self,
+        metric_name: str,
+        now: float | None = None,
+        hot_by_node=_HOT_UNSET,
+        hot_emitted: set | None = None,
+    ) -> int:
         """Bulk sync: ONE metrics query covers every node.
 
         The reference issues |nodes| filtered Prometheus queries per
@@ -271,6 +319,20 @@ class NodeAnnotator:
         ``query_all_by_metric`` serve the whole column in one instant
         query. Nodes without a sample fall back to the per-node work
         queue (IP-then-name path with backoff). Returns patched count.
+
+        ``hot_by_node``: pass ``hot_values_batch(now)``'s result when
+        sweeping several metrics at one ``now`` (hot values are a pure
+        function of the heap and ``now`` — recomputing the heap sweep per
+        metric is pure overhead); default computes it here.
+
+        ``hot_emitted``: each independent metric tick re-patches the hot
+        value like the reference (ref: node.go:101-121). Within one
+        same-``now`` multi-metric sweep all those re-patches are
+        identical, so ``sync_all_once_bulk`` shares one set here and each
+        node's hot value is written exactly once — by whichever metric
+        pass sees it first (a node missing from one metric's samples
+        still gets its hot value from a later pass). Default None writes
+        hot for every node, the standalone per-tick behavior.
         """
         if now is None:
             now = time.time()
@@ -293,13 +355,15 @@ class NodeAnnotator:
             if host != instance:
                 by_host.setdefault(host, value)
         direct = self._store is not None and self.config.direct_store
-        hot_by_node = self.hot_values_batch(now)
+        if hot_by_node is self._HOT_UNSET:
+            hot_by_node = self.hot_values_batch(now)
         patched = 0
         names: list[str] = []
         metric_vals: list[float] = []
         metric_ts: list[float] = []
         hot_vals: list[float] = []
         hot_ts: list[float] = []
+        emit_items: dict[tuple[str, str], str] = {}
         # The direct-store write must be bit-identical to a future
         # re-ingest of the emitted annotation string (the timestamp
         # truncates to seconds in the wire format). Every row in this
@@ -307,52 +371,93 @@ class NodeAnnotator:
         # of round-tripping "value,ts" through the full codec per node —
         # decode of our own encode reduces to go_parse_float(value) +
         # this shared parsed ts (values are float-formatted, comma-free).
-        _, shared_ts = decode_annotation_or_missing(encode_annotation("0", now))
+        # The shared wire timestamp is likewise rendered once: every
+        # annotation in this sweep is f"{value},{ts_str}" (encoding
+        # per node re-paid a TZ env read + lru lookup 2x per node —
+        # it dominated full-loop profiles). Hot-value strings repeat
+        # (small ints), so they're cached per distinct value.
+        ts_str = format_local_time(now)
+        _, shared_ts = decode_annotation_or_missing(f"0,{ts_str}")
         nan, neg_inf = float("nan"), float("-inf")
-        for node in self.cluster.list_nodes():
-            value = by_host.get(node.internal_ip()) or by_host.get(node.name)
+        stale = shared_ts == neg_inf
+        hot_anno_cache: dict[int, str] = {}
+        queue_add = self.queue.add
+        by_host_get = by_host.get
+        hot_names: list[str] = []
+        for name, ip in self._node_pairs():
+            value = by_host_get(ip) or by_host_get(name)
             if not value:
-                self.queue.add(_meta_key(node.name, metric_name))
+                queue_add(_meta_key(name, metric_name))
                 continue
-            anno = encode_annotation(value, now)
-            if hot_by_node is not None:
-                hot = hot_by_node.get(node.name, 0)
-            else:
-                hot = self.hot_value(node.name, now)
-            hot_anno = encode_annotation(str(hot), now)
+            emit_hot = hot_emitted is None or name not in hot_emitted
+            if emit_hot:
+                if hot_emitted is not None:
+                    hot_emitted.add(name)
+                if hot_by_node is not None:
+                    hot = hot_by_node.get(name, 0)
+                else:
+                    hot = self.hot_value(name, now)
+                hot_anno = hot_anno_cache.get(hot)
+                if hot_anno is None:
+                    hot_anno = hot_anno_cache[hot] = f"{hot},{ts_str}"
             if direct:
                 v = go_parse_float(value)
-                if v is None or shared_ts == neg_inf:
+                if v is None or stale:
                     v, ts = nan, neg_inf
                 else:
                     ts = shared_ts
-                names.append(node.name)
+                names.append(name)
                 metric_vals.append(v)
                 metric_ts.append(ts)
-                hot_vals.append(float(hot) if shared_ts != neg_inf else nan)
-                hot_ts.append(shared_ts)
-                self._emit_annotation(node.name, metric_name, anno)
-                self._emit_annotation(node.name, NODE_HOT_VALUE_KEY, hot_anno)
+                emit_items[(name, metric_name)] = f"{value},{ts_str}"
+                if emit_hot:
+                    hot_names.append(name)
+                    hot_vals.append(nan if stale else float(hot))
+                    hot_ts.append(shared_ts)
+                    emit_items[(name, NODE_HOT_VALUE_KEY)] = hot_anno
             else:
-                self.cluster.patch_node_annotation(node.name, metric_name, anno)
                 self.cluster.patch_node_annotation(
-                    node.name, NODE_HOT_VALUE_KEY, hot_anno
+                    name, metric_name, f"{value},{ts_str}"
                 )
+                if emit_hot:
+                    self.cluster.patch_node_annotation(
+                        name, NODE_HOT_VALUE_KEY, hot_anno
+                    )
             patched += 1
-            self.synced += 1
+        self.synced += patched
+        if emit_items:
+            self._emit_annotations_bulk(emit_items)
         if direct and names:
             import numpy as np
 
             # One lock hold resolves name->row AND writes, so a
             # concurrent prune's swap-removes can't redirect stale ids.
-            self._store.bulk_set_by_name(
-                metric_name,
-                names,
-                np.asarray(metric_vals),
-                np.asarray(metric_ts),
-                np.asarray(hot_vals),
-                np.asarray(hot_ts),
-            )
+            if len(hot_names) == len(names):
+                # hot rows align with metric rows (the common sweep)
+                self._store.bulk_set_by_name(
+                    metric_name,
+                    names,
+                    np.asarray(metric_vals),
+                    np.asarray(metric_ts),
+                    np.asarray(hot_vals),
+                    np.asarray(hot_ts),
+                )
+            else:
+                self._store.bulk_set_by_name(
+                    metric_name,
+                    names,
+                    np.asarray(metric_vals),
+                    np.asarray(metric_ts),
+                )
+                if hot_names:
+                    self._store.bulk_set_by_name(
+                        None,
+                        hot_names,
+                        None,
+                        None,
+                        np.asarray(hot_vals),
+                        np.asarray(hot_ts),
+                    )
         return patched
 
     def _prune_direct_store(self) -> None:
@@ -365,11 +470,18 @@ class NodeAnnotator:
             self._store.prune_absent(self.cluster.node_names())
 
     def sync_all_once_bulk(self, now: float | None = None) -> None:
-        """Deterministic bulk pass over syncPolicy metrics."""
+        """Deterministic bulk pass over syncPolicy metrics. Each node's
+        hot value is computed and patched once for the whole sweep (see
+        ``sync_metric_bulk``'s ``hot_emitted``; per-metric re-patches at
+        one ``now`` are identical)."""
         if now is None:
             now = time.time()
+        hot_by_node = self.hot_values_batch(now)
+        hot_emitted: set[str] = set()
         for sp in self.policy.spec.sync_period:
-            self.sync_metric_bulk(sp.name, now)
+            self.sync_metric_bulk(
+                sp.name, now, hot_by_node=hot_by_node, hot_emitted=hot_emitted
+            )
 
     # -- TPU-native bulk refresh ------------------------------------------
 

@@ -17,7 +17,6 @@ this interface.
 
 from __future__ import annotations
 
-import itertools
 import threading
 import time
 from collections import deque
@@ -97,6 +96,45 @@ class Event:
 EventHandler = Callable[[Event], None]
 
 
+class _PodBurst:
+    """Columnar pod population: a burst of bare pods as rows, not objects.
+
+    TPU-native counterpart of a 100k-pod arrival wave: names are a list,
+    placements are one int32 column indexing a burst-local node table.
+    Rows materialize into real ``Pod`` objects lazily (get/list/patch/
+    delete), so every ClusterState read keeps its semantics while bind
+    application and event feedback stay O(1) Python calls per burst.
+    """
+
+    __slots__ = (
+        "namespace", "names", "node_ids", "table", "table_map", "dead", "version",
+    )
+
+    def __init__(self, namespace: str, names: list):
+        import numpy as np
+
+        self.namespace = namespace
+        self.names = names
+        self.node_ids = np.full((len(names),), -1, dtype=np.int32)
+        self.table: list[str] = []  # burst-local node intern table
+        self.table_map: dict[str, int] = {}
+        self.dead: set[int] = set()  # rows materialized out / deleted
+        self.version = 0  # bumped per bind; keys count caches
+
+    def materialize(self, row: int) -> Pod:
+        node = self.table[self.node_ids[row]] if self.node_ids[row] >= 0 else ""
+        pod = object.__new__(Pod)
+        pod.__dict__.update(
+            name=self.names[row],
+            namespace=self.namespace,
+            annotations={},
+            owner_references=(),
+            containers=(),
+            node_name=node,
+        )
+        return pod
+
+
 class ClusterState:
     """Thread-safe cluster model with event subscription."""
 
@@ -112,8 +150,19 @@ class ClusterState:
         self._event_index: dict[str, Event] = {}
         self._event_handlers: list[EventHandler] = []
         self._batch_handlers: list[Callable[[list[Event]], None]] = []
-        self._rv = itertools.count(1)
+        self._rv_next = 1  # next event resourceVersion
         self._sched_version = 0
+        self._node_set_version = 0
+        # columnar pod bursts (see add_pod_burst)
+        self._bursts: list[_PodBurst] = []
+        self._burst_index: dict[str, tuple[_PodBurst, int]] | None = None
+        # bound-pod counts contributed by live burst rows, maintained
+        # incrementally on bind/retire (a per-call rescan would grow
+        # with total burst history)
+        self._burst_bound_counts: dict[str, int] = {}
+        # batch handlers that also accept columnar delivery (parallel to
+        # _batch_handlers; None = must materialize events for this one)
+        self._batch_columnar: list[Callable | None] = []
 
     @property
     def sched_version(self) -> int:
@@ -126,17 +175,32 @@ class ClusterState:
         with self._lock:
             return self._sched_version
 
+    @property
+    def node_set_version(self) -> int:
+        """Bumps only on node add/delete — identity/address churn, not
+        annotation patches. Lets sweep loops cache (name, ip) pair lists
+        across |metrics| passes per cycle."""
+        with self._lock:
+            return self._node_set_version
+
     # -- nodes -------------------------------------------------------------
 
     def add_node(self, node: Node) -> None:
         with self._lock:
+            prev = self._nodes.get(node.name)
             self._nodes[node.name] = node
             self._sched_version += 1
+            # annotation-only updates (e.g. a kube mirror echoing the
+            # annotator's own patches as MODIFIED events) must not defeat
+            # (name, ip) pair caches keyed on node_set_version
+            if prev is None or prev.addresses != node.addresses:
+                self._node_set_version += 1
 
     def delete_node(self, name: str) -> None:
         with self._lock:
             self._nodes.pop(name, None)
             self._sched_version += 1
+            self._node_set_version += 1
 
     def get_node(self, name: str) -> Node | None:
         with self._lock:
@@ -162,6 +226,31 @@ class ClusterState:
             self._sched_version += 1
             return True
 
+    def patch_node_annotations_bulk(self, per_node: Mapping[str, Mapping[str, str]]) -> int:
+        """Batch annotation patch: one lock hold and one node-object copy
+        per node for a whole sweep's writes (the per-(node, key) primitive
+        costs a lock + full annotation copy each). Returns patched count;
+        missing nodes are skipped like ``patch_node_annotation``'s False."""
+        patched = 0
+        with self._lock:
+            nodes = self._nodes
+            for name, kv in per_node.items():
+                node = nodes.get(name)
+                if node is None:
+                    continue
+                anno = dict(node.annotations)
+                anno.update(kv)
+                # raw copy (see bind_pods): field-identical to
+                # replace(node, annotations=anno), minus __init__ overhead
+                new_node = object.__new__(Node)
+                d = new_node.__dict__
+                d.update(node.__dict__)
+                d["annotations"] = anno
+                nodes[name] = new_node
+                self._sched_version += 1
+                patched += 1
+        return patched
+
     # -- pods --------------------------------------------------------------
 
     def _index_remove(self, pod: Pod) -> None:
@@ -176,20 +265,75 @@ class ClusterState:
         if pod.node_name:
             self._pods_by_node.setdefault(pod.node_name, {})[pod.key()] = None
 
+    def _shadow_burst_locked(self, key: str) -> bool:
+        """An object pod added under a live burst key replaces the row
+        (mirrors add_pod's replace semantics). Returns True when the
+        retired row was bound — the caller must count that as replacing
+        a bound pod for ``sched_version``."""
+        hit = self._burst_lookup_locked(key)
+        if hit is None:
+            return False
+        burst, row = hit
+        was_bound = int(burst.node_ids[row]) >= 0
+        self._burst_retire_row_locked(burst, row)
+        if self._burst_index is not None:
+            self._burst_index.pop(key, None)
+        return was_bound
+
     def add_pod(self, pod: Pod) -> None:
         with self._lock:
-            prev = self._pods.get(pod.key())
+            key = pod.key()
+            prev_burst_bound = (
+                self._shadow_burst_locked(key) if self._bursts else False
+            )
+            prev = self._pods.get(key)
             if prev is not None:
                 self._index_remove(prev)
-            self._pods[pod.key()] = pod
+            self._pods[key] = pod
             self._index_add(pod)
             # replacing a bound pod is a bound-pod delete for snapshots
-            if pod.node_name or (prev is not None and prev.node_name):
+            if (
+                pod.node_name
+                or (prev is not None and prev.node_name)
+                or prev_burst_bound
+            ):
                 self._sched_version += 1
+
+    def add_pods(self, pods) -> None:
+        """Batch ``add_pod``: one lock hold for a whole burst's pod
+        creations (per-pod lock round-trips dominate 100k-pod cycles)."""
+        with self._lock:
+            for pod in pods:
+                key = pod.key()
+                prev_burst_bound = (
+                    self._shadow_burst_locked(key) if self._bursts else False
+                )
+                prev = self._pods.get(key)
+                if prev is not None:
+                    self._index_remove(prev)
+                self._pods[key] = pod
+                self._index_add(pod)
+                if (
+                    pod.node_name
+                    or (prev is not None and prev.node_name)
+                    or prev_burst_bound
+                ):
+                    self._sched_version += 1
 
     def delete_pod(self, key: str) -> None:
         with self._lock:
             pod = self._pods.pop(key, None)
+            if pod is None and self._bursts:
+                hit = self._burst_lookup_locked(key)
+                if hit is not None:
+                    burst, row = hit
+                    pod = burst.materialize(row)
+                    self._burst_retire_row_locked(burst, row)
+                    if self._burst_index is not None:
+                        self._burst_index.pop(key, None)
+                    if pod.node_name:
+                        self._sched_version += 1
+                    return
             if pod is not None:
                 self._index_remove(pod)
             if pod is not None and pod.node_name:
@@ -197,27 +341,56 @@ class ClusterState:
 
     def get_pod(self, key: str) -> Pod | None:
         with self._lock:
-            return self._pods.get(key)
+            pod = self._pods.get(key)
+            if pod is None and self._bursts:
+                hit = self._burst_lookup_locked(key)
+                if hit is not None:
+                    return hit[0].materialize(hit[1])
+            return pod
 
     def list_pods(self, node_name: str | None = None) -> list[Pod]:
         with self._lock:
             if node_name is None:
-                return list(self._pods.values())
-            keys = self._pods_by_node.get(node_name)
-            if not keys:
-                return []
-            return [self._pods[k] for k in keys]
+                out = list(self._pods.values())
+            else:
+                keys = self._pods_by_node.get(node_name)
+                out = [self._pods[k] for k in keys] if keys else []
+            if self._bursts:
+                out.extend(self._burst_pods_locked(node_name))
+            return out
 
     def count_pods(self, node_name: str) -> int:
         """Bound pods on ``node_name`` — O(1) via the per-node index."""
         with self._lock:
             keys = self._pods_by_node.get(node_name)
-            return len(keys) if keys else 0
+            count = len(keys) if keys else 0
+            burst_counts = self._burst_counts_locked()
+            if burst_counts:
+                count += burst_counts.get(node_name, 0)
+            return count
+
+    def count_pods_all(self) -> dict[str, int]:
+        """Bound-pod counts for every node in ONE lock hold (a metric
+        sweep reading counts per node x metric would otherwise take the
+        lock |nodes|x|metrics| times)."""
+        with self._lock:
+            counts = {
+                name: len(keys) for name, keys in self._pods_by_node.items()
+            }
+            burst_counts = self._burst_counts_locked()
+            if burst_counts:
+                for name, c in burst_counts.items():
+                    counts[name] = counts.get(name, 0) + c
+            return counts
 
     def patch_pod_annotation(self, key: str, anno_key: str, value: str) -> bool:
         """PreBind's write primitive (ref: noderesourcetopology/binder.go:19-65)."""
         with self._lock:
             pod = self._pods.get(key)
+            if pod is None and self._bursts:
+                hit = self._burst_lookup_locked(key)
+                if hit is not None:
+                    pod = self._materialize_out_locked(*hit)
             if pod is None:
                 return False
             anno = dict(pod.annotations)
@@ -248,14 +421,35 @@ class ClusterState:
         bound: list[str] = []
         stamped: list[Event] = []
         with self._lock:
+            pods = self._pods
+            pods_by_node = self._pods_by_node
+            events = self._events
+            event_index = self._event_index
             for pod_key, node_name in items:
-                pod = self._pods.get(pod_key)
+                pod = pods.get(pod_key)
                 if pod is None:
-                    continue
+                    if self._bursts:
+                        hit = self._burst_lookup_locked(pod_key)
+                        if hit is not None:
+                            pod = self._materialize_out_locked(*hit)
+                    if pod is None:
+                        continue
                 self._index_remove(pod)
-                new_pod = replace(pod, node_name=node_name)
-                self._pods[pod_key] = new_pod
-                self._index_add(new_pod)
+                # dataclasses.replace() re-runs __init__ field machinery;
+                # at 100k binds/cycle the raw-copy path below is the
+                # difference between bind application being free and it
+                # dominating the loop (field set identical to
+                # replace(pod, node_name=node_name))
+                new_pod = object.__new__(Pod)
+                d = new_pod.__dict__
+                d.update(pod.__dict__)
+                d["node_name"] = node_name
+                pods[pod_key] = new_pod
+                # _index_add inlined with the already-known key
+                per_node = pods_by_node.get(node_name)
+                if per_node is None:
+                    per_node = pods_by_node[node_name] = {}
+                per_node[pod_key] = None
                 self._sched_version += 1
                 bound.append(pod_key)
                 event = Event(
@@ -264,13 +458,17 @@ class ClusterState:
                     type="Normal",
                     reason="Scheduled",
                     message=(
-                        f"Successfully assigned {pod.namespace}/{pod.name} "
-                        f"to {node_name}"
+                        f"Successfully assigned {pod_key} to {node_name}"
                     ),
                     count=1,
                     last_timestamp=now,
+                    resource_version=self._next_rv(),
                 )
-                stamped.append(self._record_event_locked(event))
+                # inline _record_event_locked minus the re-stamp replace():
+                # the rv is already final
+                events.append(event)
+                event_index[f"{event.namespace}/{event.name}"] = event
+                stamped.append(event)
             handlers = list(self._event_handlers)
             batch_handlers = list(self._batch_handlers)
         for event in stamped:
@@ -281,12 +479,202 @@ class ClusterState:
                 handler(stamped)
         return bound
 
+    # -- columnar pod bursts -----------------------------------------------
+    #
+    # The TPU-native arrival path: a burst of bare pods lives as rows
+    # (names + one int32 placement column), not 100k Python objects. Bind
+    # application is one array transaction; event feedback is delivered
+    # as columns to subscribers that opt in (subscribe_events_batch's
+    # ``columnar=``) and materializes real Event objects only for the
+    # bounded event log's tail and for legacy subscribers. Every read API
+    # (get/list/count) sees burst pods; mutations materialize the row
+    # into the object world first (copy-on-write). The text-message event
+    # contract (ref: event.go:118-137) still holds wherever Event objects
+    # surface — columnar delivery is an in-process fast path, the kube
+    # boundary always carries real events.
+
+    def add_pod_burst(self, namespace: str, names: list) -> _PodBurst:
+        """Create a columnar burst of bare pending pods (no containers,
+        no annotations — the bulk-arrival shape). Names must be unique
+        within the namespace like any pod key."""
+        burst = _PodBurst(namespace, list(names))
+        with self._lock:
+            self._bursts.append(burst)
+            self._burst_index = None  # rebuilt lazily
+        return burst
+
+    def _burst_lookup_locked(self, key: str):
+        if not self._bursts:
+            return None
+        index = self._burst_index
+        if index is None:
+            index = {}
+            for b in self._bursts:
+                ns = b.namespace
+                dead = b.dead
+                for row, name in enumerate(b.names):
+                    if row not in dead:
+                        index[f"{ns}/{name}"] = (b, row)
+            self._burst_index = index
+        return index.get(key)
+
+    def _burst_retire_row_locked(self, burst: _PodBurst, row: int) -> None:
+        """Mark a row dead, keeping the incremental bound-counts true.
+        A fully-dead burst is dropped so burst history can't grow
+        lookup/materialization work without bound."""
+        burst.dead.add(row)
+        burst.version += 1
+        tid = int(burst.node_ids[row])
+        if tid >= 0:
+            name = burst.table[tid]
+            counts = self._burst_bound_counts
+            remaining = counts.get(name, 0) - 1
+            if remaining > 0:
+                counts[name] = remaining
+            else:
+                counts.pop(name, None)
+        if len(burst.dead) == len(burst.names):
+            try:
+                self._bursts.remove(burst)
+            except ValueError:  # pragma: no cover - already dropped
+                pass
+
+    def _materialize_out_locked(self, burst: _PodBurst, row: int) -> Pod:
+        """Copy-on-write: move a burst row into the object world so
+        object-path mutations (patch/delete/re-add) behave normally."""
+        pod = burst.materialize(row)
+        self._burst_retire_row_locked(burst, row)
+        if self._burst_index is not None:
+            self._burst_index.pop(pod.key(), None)
+        self._pods[pod.key()] = pod
+        self._index_add(pod)
+        return pod
+
+    def _burst_counts_locked(self) -> dict[str, int] | None:
+        """Bound-pod counts contributed by live burst rows (maintained
+        incrementally by bind_burst / retire)."""
+        if not self._bursts:
+            return None
+        return self._burst_bound_counts
+
+    def _burst_pods_locked(self, node_name: str | None) -> list[Pod]:
+        """Materialize burst rows (all, or those bound to ``node_name``)."""
+        import numpy as np
+
+        out: list[Pod] = []
+        for b in self._bursts:
+            if node_name is None:
+                rows = range(len(b.names))
+                if b.dead:
+                    rows = (r for r in rows if r not in b.dead)
+            else:
+                tid = b.table_map.get(node_name)
+                if tid is None:
+                    continue
+                rows = np.nonzero(b.node_ids == tid)[0]
+                if b.dead:
+                    rows = (int(r) for r in rows if int(r) not in b.dead)
+            out.extend(b.materialize(int(r)) for r in rows)
+        return out
+
+    def bind_burst(self, burst: _PodBurst, node_table: list, node_idx, now=None):
+        """Columnar bind: row ``i`` -> ``node_table[node_idx[i]]``
+        (``-1`` leaves the row pending). One lock transaction applies the
+        whole column, stamps ``sched_version``/resourceVersions exactly
+        like per-pod ``bind_pods``, materializes Events only for the
+        bounded log's tail (the deque would evict the rest anyway) and
+        for subscribers without columnar support, and hands columnar
+        subscribers ``(node_table, node_idx_bound, now)``. Returns the
+        bound row indices (ascending = event order)."""
+        import numpy as np
+
+        if now is None:
+            now = time.time()
+        node_idx = np.asarray(node_idx, dtype=np.int32)
+        with self._lock:
+            table_map = burst.table_map
+            table = burst.table
+            remap = np.empty((len(node_table),), dtype=np.int32)
+            for j, name in enumerate(node_table):
+                tid = table_map.get(name)
+                if tid is None:
+                    tid = table_map[name] = len(table)
+                    table.append(name)
+                remap[j] = tid
+            eligible = (node_idx >= 0) & (burst.node_ids[: len(node_idx)] == -1)
+            if burst.dead:
+                dead_rows = np.fromiter(burst.dead, dtype=np.int64)
+                eligible[dead_rows[dead_rows < len(eligible)]] = False
+            rows = np.nonzero(eligible)[0]
+            bound_idx = node_idx[rows]
+            burst.node_ids[rows] = remap[bound_idx]
+            n = len(rows)
+            burst.version += 1
+            # incremental bound-count maintenance: one bincount per bind
+            counts = self._burst_bound_counts
+            bc = np.bincount(remap[bound_idx], minlength=len(table))
+            for tid in np.nonzero(bc)[0]:
+                name = table[int(tid)]
+                counts[name] = counts.get(name, 0) + int(bc[tid])
+            self._sched_version += n
+            rv_base = self._rv_next
+            self._rv_next += n
+            handlers = list(self._event_handlers)
+            batch = list(zip(self._batch_handlers, self._batch_columnar))
+            need_full = bool(handlers) or any(c is None for _, c in batch)
+            # materialize the log tail (bounded: the deque would evict
+            # everything older) — or everything if a legacy subscriber
+            # needs per-Event delivery
+            maxlen = self._events.maxlen or n
+            first = 0 if need_full else max(0, n - maxlen)
+            tail_events: list[Event] = []
+            ns = burst.namespace
+            names = burst.names
+            for k in range(first, n):
+                row = int(rows[k])
+                pod_name = names[row]
+                node_name = node_table[int(bound_idx[k])]
+                ev = object.__new__(Event)
+                ev.__dict__.update(
+                    namespace=ns,
+                    name=f"{pod_name}.scheduled",
+                    type="Normal",
+                    reason="Scheduled",
+                    message=(
+                        f"Successfully assigned {ns}/{pod_name} "
+                        f"to {node_name}"
+                    ),
+                    count=1,
+                    event_time=0.0,
+                    last_timestamp=now,
+                    resource_version=rv_base + k,
+                )
+                tail_events.append(ev)
+            for ev in tail_events[-maxlen:] if need_full else tail_events:
+                self._events.append(ev)
+                self._event_index[f"{ev.namespace}/{ev.name}"] = ev
+        if n:
+            for ev in tail_events if need_full else ():
+                for handler in handlers:
+                    handler(ev)
+            for handler, columnar in batch:
+                if columnar is not None:
+                    columnar(node_table, bound_idx, now)
+                elif tail_events:
+                    handler(tail_events)
+        return rows
+
     # -- events ------------------------------------------------------------
+
+    def _next_rv(self) -> int:
+        v = self._rv_next
+        self._rv_next = v + 1
+        return v
 
     def _record_event_locked(self, event: Event) -> Event:
         """Stamp + append + index an event; the recording invariant lives
         only here (callers hold the lock)."""
-        event = replace(event, resource_version=next(self._rv))
+        event = replace(event, resource_version=self._next_rv())
         self._events.append(event)
         self._event_index[f"{event.namespace}/{event.name}"] = event
         return event
@@ -315,9 +703,19 @@ class ClusterState:
         with self._lock:
             self._event_handlers.append(handler)
 
-    def subscribe_events_batch(self, handler: Callable[[list[Event]], None]) -> None:
+    def subscribe_events_batch(
+        self,
+        handler: Callable[[list[Event]], None],
+        columnar: Callable | None = None,
+    ) -> None:
         """Like ``subscribe_events`` but delivered in bursts: a single
         emit arrives as a 1-element list, ``bind_pods`` delivers the
-        whole burst in one call (event order preserved)."""
+        whole burst in one call (event order preserved).
+
+        ``columnar``: optional fast-path alternative for columnar binds
+        (``bind_burst``) — called as ``columnar(node_table, node_idx,
+        ts)`` instead of materializing one Event per pod for ``handler``.
+        Subscribers without it still get full Event lists."""
         with self._lock:
             self._batch_handlers.append(handler)
+            self._batch_columnar.append(columnar)

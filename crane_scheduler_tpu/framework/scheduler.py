@@ -213,6 +213,49 @@ class BatchResult:
     # must oracle at THIS time, not a later clock read)
 
 
+@dataclass
+class BurstResult:
+    """Columnar burst outcome: placements as one int32 column over a node
+    table — no per-pod Python objects. ``assignments``/``unassigned``
+    materialize the object-path views lazily for compatibility; hot loops
+    read the arrays."""
+
+    namespace: str
+    names: list  # pod names, row order
+    node_idx: object  # np.int32 [len(names)], -1 = unassigned
+    node_table: list  # node names the column indexes
+    bound_rows: object  # rows actually bound (None when bind=False)
+    scores_row: object  # np int64 [n_nodes], row-aligned with node_table
+    schedulable_row: object  # np bool [n_nodes]
+    now: float = 0.0
+
+    @property
+    def n_assigned(self) -> int:
+        import numpy as np
+
+        return int(np.count_nonzero(np.asarray(self.node_idx) >= 0))
+
+    @property
+    def assignments(self) -> dict:
+        import numpy as np
+
+        ns = self.namespace
+        table = self.node_table
+        idx = np.asarray(self.node_idx)
+        return {
+            f"{ns}/{self.names[row]}": table[int(idx[row])]
+            for row in np.nonzero(idx >= 0)[0]
+        }
+
+    @property
+    def unassigned(self) -> list:
+        import numpy as np
+
+        ns = self.namespace
+        idx = np.asarray(self.node_idx)
+        return [f"{ns}/{self.names[int(r)]}" for r in np.nonzero(idx < 0)[0]]
+
+
 class BatchScheduler:
     """TPU-native burst mode: bulk refresh -> fused score -> gang assign.
 
@@ -462,6 +505,97 @@ class BatchScheduler:
         if bind:
             self._apply_binds(result, now)
         return result
+
+    # -- columnar bursts (pods as rows, binds as one array transaction) ----
+
+    def schedule_pod_burst(
+        self, namespace: str, names: list, bind: bool = True
+    ) -> BurstResult:
+        """Schedule a burst of bare pods without materializing them as
+        objects: placements come back as one column, binds apply through
+        ``ClusterState.bind_burst`` in a single transaction, and the
+        Scheduled-event feedback reaches the hot-value heap as columns.
+        Placement-identical to ``schedule_batch`` over equivalent ``Pod``
+        objects (same solver, same ``_expand_counts`` ordering)."""
+        for result in self.schedule_bursts_pipelined(
+            [(namespace, names)], bind=bind, depth=1
+        ):
+            return result
+        raise RuntimeError("empty burst stream")  # pragma: no cover
+
+    def schedule_bursts_pipelined(
+        self, bursts, bind: bool = True, depth: int = 4
+    ):
+        """Pipelined columnar bursts: ``bursts`` yields ``(namespace,
+        names)`` pairs; one ``BurstResult`` per burst, in order. Same
+        dispatch/drain overlap (and the same bounded feedback lag) as
+        ``schedule_batches_pipelined``. Requires a burst-capable cluster
+        (``add_pod_burst``/``bind_burst`` — ClusterState has them)."""
+        from collections import deque
+
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        add_burst = getattr(self.cluster, "add_pod_burst", None)
+        if bind and add_burst is None:
+            raise TypeError(
+                "cluster does not support columnar bursts; use "
+                "schedule_batch with Pod objects"
+            )
+        pending = deque()
+        for namespace, names in bursts:
+            now = self._clock()
+            self.refresh()
+            prepared = self._prepare(now)
+            dev = self._sharded.packed(prepared, len(names), now=now)
+            dev.copy_to_host_async()
+            handle = add_burst(namespace, names) if bind else None
+            pending.append(
+                (dev, namespace, names, handle, now,
+                 self._prepared_names, self._prepared_n)
+            )
+            if len(pending) >= depth:
+                yield self._drain_burst(pending.popleft(), bind)
+        while pending:
+            yield self._drain_burst(pending.popleft(), bind)
+
+    def _drain_burst(self, item, bind: bool) -> BurstResult:
+        import numpy as np
+
+        dev, namespace, names, handle, now, node_names, n = item
+        packed = np.asarray(dev)  # the only synchronization point
+        schedulable, scores, counts, _unassigned, _ = self._sharded.unpack(
+            packed, n
+        )
+        scores = np.asarray(scores)
+        counts = np.asarray(counts)
+        # same stable score-descending expansion as _expand_counts, kept
+        # columnar: order[i] is pod-row i's node row
+        by_score = np.argsort(-scores, kind="stable")
+        order = np.repeat(by_score, counts[by_score]).astype(np.int32)
+        node_idx = np.full((len(names),), -1, dtype=np.int32)
+        k = min(len(order), len(names))
+        node_idx[:k] = order[:k]
+        table = list(node_names[:n])
+        bound = None
+        if bind and handle is not None:
+            bound = self.cluster.bind_burst(handle, table, node_idx, now)
+            if len(bound) != int((node_idx >= 0).sum()):
+                # reconcile with what actually bound (rows deleted or
+                # shadowed between dispatch and drain) — reporting them
+                # as scheduled would be the phantom-placement bug
+                mask = np.zeros((len(names),), dtype=bool)
+                mask[bound] = True
+                node_idx = np.where(mask, node_idx, -1).astype(np.int32)
+        return BurstResult(
+            namespace=namespace,
+            names=names,
+            node_idx=node_idx,
+            node_table=table,
+            bound_rows=bound,
+            scores_row=scores,
+            schedulable_row=np.asarray(schedulable),
+            now=now,
+        )
 
     @staticmethod
     def _expand_counts(scores, counts, names, keys):

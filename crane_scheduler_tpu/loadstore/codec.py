@@ -42,6 +42,13 @@ def go_parse_float(s: str) -> float | None:
     """
     if not isinstance(s, str):
         return None
+    # fast path: plain ASCII unsigned decimal (the Prometheus 5-decimal
+    # rendering, by far the common case) — digits with at most one dot
+    # is accepted identically by Go and float(); everything else (signs,
+    # exponents, underscores, unicode digits, whitespace) falls through
+    # to the exact-semantics matchers
+    if s.isascii() and s.replace(".", "", 1).isdigit():
+        return float(s)
     if _GO_FLOAT_RE.match(s):
         return float(s.replace("_", ""))
     if _GO_HEX_RE.match(s):

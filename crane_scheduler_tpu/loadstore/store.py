@@ -286,7 +286,14 @@ class NodeLoadStore:
         missing nodes) and the metric/hot writes happen under one lock
         hold, so a concurrent ``prune_absent`` (which swap-removes rows)
         can never redirect a pre-resolved id to another node's row."""
-        ids = np.asarray([self.add_node(n) for n in names], dtype=np.int64)
+        index = self._index
+        ids = np.asarray(
+            [
+                i if (i := index.get(n)) is not None else self.add_node(n)
+                for n in names
+            ],
+            dtype=np.int64,
+        )
         wrote = False
         col = self.tensors.metric_index.get(metric)
         if col is not None and len(ids):

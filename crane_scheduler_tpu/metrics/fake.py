@@ -20,14 +20,35 @@ class FakeMetricsSource:
     def __init__(self):
         self._by_ip: dict[tuple[str, str], float | Callable[[], float]] = {}
         self._by_name: dict[tuple[str, str], float | Callable[[], float]] = {}
+        # per-metric view of _by_ip: a bulk query walks one metric's
+        # instances, not every (metric, instance) pair ever set
+        self._ip_by_metric: dict[str, dict[str, float | Callable[[], float]]] = {}
         self._fail_ip: set[tuple[str, str]] = set()
         self._fail_name: set[tuple[str, str]] = set()
+        # column providers: metric -> zero-arg fn returning the whole
+        # {instance: rendered_value} column in one call (the simulator's
+        # vectorized load model; per-instance closures cost ~5us x
+        # |nodes| x |metrics| per sweep)
+        self._columns: dict[str, Callable[[], dict[str, str]]] = {}
         self.ip_queries = 0
         self.name_queries = 0
+
+    def set_column(self, metric: str, fn: Callable[[], dict[str, str]]) -> None:
+        """Register a bulk column provider for ``metric``. ``fn`` must
+        return ``{instance: value_str}`` with the Prometheus rendering
+        contract already applied (clamped >= 0, 5-decimal fixed,
+        ref: prometheus.go:120-125). Per-instance failure injection via
+        ``fail`` still applies on top."""
+        self._columns[metric] = fn
 
     def set(self, metric: str, node: str, value, by: str = "both") -> None:
         if by in ("ip", "both"):
             self._by_ip[(metric, node)] = value
+            self._ip_by_metric.setdefault(metric, {})[node] = value
+            # a per-instance override after a column provider was
+            # registered must win on the bulk path too — drop the column
+            # so bulk queries fall back to the per-instance values
+            self._columns.pop(metric, None)
         if by in ("name", "both"):
             self._by_name[(metric, node)] = value
 
@@ -54,10 +75,21 @@ class FakeMetricsSource:
 
     def query_all_by_metric(self, metric_name: str) -> dict:
         """Bulk variant: every known instance's value for one metric."""
+        fail = self._fail_ip
+        column = self._columns.get(metric_name)
+        if column is not None:
+            out = column()
+            if fail:
+                for instance in [
+                    i for i in out if (metric_name, i) in fail
+                ]:
+                    del out[instance]
+            return out
         out = {}
-        for (metric, instance), value in self._by_ip.items():
-            if metric == metric_name and (metric, instance) not in self._fail_ip:
-                out[instance] = self._render(value)
+        render = self._render
+        for instance, value in self._ip_by_metric.get(metric_name, {}).items():
+            if (metric_name, instance) not in fail:
+                out[instance] = render(value)
         return out
 
     def query_by_node_ip(self, metric_name: str, ip: str) -> str:

@@ -78,6 +78,29 @@ class NativeBindingRecords:
                 len(bindings),
             )
 
+    def add_bind_columns(self, node_table, node_idx, ts: int) -> None:
+        """Columnar push: intern the (small) node table once, map the
+        per-pod index column through it with numpy, and push the whole
+        burst in ONE FFI call — no per-pod Python objects at all."""
+        node_idx = np.asarray(node_idx, dtype=np.int64)
+        n = len(node_idx)
+        if not n:
+            return
+        with self._lock:
+            table_ids = np.fromiter(
+                (self._intern(name) for name in node_table),
+                dtype=np.int32,
+                count=len(node_table),
+            )
+            ids = np.ascontiguousarray(table_ids[node_idx])
+            ts_arr = np.full((n,), int(ts), dtype=np.int64)
+            self._lib.crane_bindings_add_batch(
+                self._handle,
+                ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                ts_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                n,
+            )
+
     def get_last_node_binding_count(
         self, node: str, time_range_seconds: float, now: float | None = None
     ) -> int:

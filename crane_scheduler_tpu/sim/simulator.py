@@ -76,14 +76,20 @@ class Simulator:
         self.stats = SimStats()
         self._base: dict[tuple[str, str], float] = {}
         self._pod_seq = 0
+        # (sched_version, counts) — a metric sweep reads bound-pod counts
+        # for |nodes| x |metrics| streams; one count_pods_all per cluster
+        # mutation generation replaces that many per-node lock hits
+        self._counts_cache: tuple[int, dict[str, int]] | None = None
 
         metric_names = {sp.name for sp in policy.spec.sync_period}
+        self._pairs: list[tuple[str, str]] = []  # (name, ip), node order
         for i in range(config.n_nodes):
             name = f"node-{i:05d}"
             ip = f"10.{(i >> 16) & 255}.{(i >> 8) & 255}.{i & 255}"
             self.cluster.add_node(
                 Node(name=name, addresses=(NodeAddress("InternalIP", ip),))
             )
+            self._pairs.append((name, ip))
             cpu_base = self.rng.uniform(*config.base_load_range)
             corr = config.cpu_mem_correlation
             mem_base = max(
@@ -94,6 +100,10 @@ class Simulator:
                 base = cpu_base if m.startswith("cpu") else mem_base
                 self._base[(name, m)] = base
                 self.metrics.set(m, ip, self._stream(name, m), by="ip")
+        for m in metric_names:
+            # bulk sweeps read the whole column in one call instead of
+            # |nodes| per-instance closures
+            self.metrics.set_column(m, self._column(m))
 
         self.annotator = NodeAnnotator(
             self.cluster, self.metrics, policy, AnnotatorConfig()
@@ -102,13 +112,45 @@ class Simulator:
 
     # -- load streams ------------------------------------------------------
 
+    def _bound_counts(self) -> dict[str, int]:
+        version = self.cluster.sched_version
+        cache = self._counts_cache
+        if cache is None or cache[0] != version:
+            cache = (version, self.cluster.count_pods_all())
+            self._counts_cache = cache
+        return cache[1]
+
     def _stream(self, node_name: str, metric: str):
+        base = self._base  # bind once; read per call for live updates
+        per_pod = self.config.per_pod_load
+
         def current() -> float:
-            bound = self.cluster.count_pods(node_name)
-            load = self._base[(node_name, metric)] + self.config.per_pod_load * bound
+            bound = self._bound_counts().get(node_name, 0)
+            load = base[(node_name, metric)] + per_pod * bound
             return max(0.0, min(1.0, load))
 
         return current
+
+    def _column(self, metric: str):
+        """Whole-column load stream: one pass over all nodes, rendered
+        with the Prometheus contract (values are clamped to [0, 1] by the
+        load model, so the >= 0 clamp is inherent; 5-decimal fixed
+        rendering matches ``format_metric_value``)."""
+
+        def column() -> dict[str, str]:
+            counts = self._bound_counts()
+            base = self._base
+            per_pod = self.config.per_pod_load
+            counts_get = counts.get
+            out = {}
+            for name, ip in self._pairs:
+                load = base[(name, metric)] + per_pod * counts_get(name, 0)
+                if load > 1.0:
+                    load = 1.0
+                out[ip] = f"{load:.5f}"
+            return out
+
+        return column
 
     # -- drivers -----------------------------------------------------------
 

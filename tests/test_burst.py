@@ -1,0 +1,239 @@
+"""Columnar pod-burst path: bit-identical to the object path.
+
+The burst path (``ClusterState.add_pod_burst``/``bind_burst``,
+``BatchScheduler.schedule_pod_burst``) keeps pods as rows. These tests
+pin its contract: identical placements, identical hot-value feedback,
+identical cluster observables (counts, sched_version, get/list), and
+copy-on-write materialization for object-path mutations.
+"""
+
+import numpy as np
+
+from crane_scheduler_tpu.cluster import ClusterState, Pod
+from crane_scheduler_tpu.sim import SimConfig, Simulator
+
+
+def make_sim(n_nodes=8, seed=3):
+    sim = Simulator(SimConfig(n_nodes=n_nodes, seed=seed))
+    sim.sync_metrics()
+    return sim
+
+
+def test_burst_placements_match_object_path():
+    sim_a, sim_b = make_sim(), make_sim()
+    batch_a = sim_a.build_batch_scheduler()
+    batch_b = sim_b.build_batch_scheduler()
+    names = [f"w-{i}" for i in range(60)]
+
+    pods = [Pod(name=n, namespace="bench") for n in names]
+    sim_a.cluster.add_pods(pods)
+    result_a = batch_a.schedule_batch(pods)
+
+    result_b = batch_b.schedule_pod_burst("bench", names)
+
+    assert result_b.assignments == result_a.assignments
+    assert result_b.unassigned == result_a.unassigned
+    assert result_b.n_assigned == len(result_a.assignments)
+    # identical cluster observables after bind
+    assert sim_b.cluster.count_pods_all() == sim_a.cluster.count_pods_all()
+    assert sim_b.cluster.sched_version == sim_a.cluster.sched_version
+    # identical hot-value feedback (same heap multiset)
+    now = sim_a.clock() + 10
+    for node in result_a.assignments.values():
+        assert sim_b.annotator.binding_records.get_last_node_binding_count(
+            node, 300.0, now
+        ) == sim_a.annotator.binding_records.get_last_node_binding_count(
+            node, 300.0, now
+        )
+
+
+def test_burst_cluster_reads_and_copy_on_write():
+    cluster = ClusterState()
+    burst = cluster.add_pod_burst("ns", [f"p{i}" for i in range(5)])
+
+    # pending burst pods are visible and unbound
+    assert cluster.get_pod("ns/p3").node_name == ""
+    assert len(cluster.list_pods()) == 5
+
+    rows = cluster.bind_burst(burst, ["node-a", "node-b"], [0, 1, 0, -1, 1])
+    assert list(rows) == [0, 1, 2, 4]
+    assert cluster.get_pod("ns/p0").node_name == "node-a"
+    assert cluster.count_pods("node-a") == 2
+    assert cluster.count_pods("node-b") == 2
+    assert cluster.count_pods_all() == {"node-a": 2, "node-b": 2}
+    assert {p.name for p in cluster.list_pods("node-b")} == {"p1", "p4"}
+    assert cluster.sched_version == 4
+
+    # events: tail materialized with the reference message contract
+    events = cluster.list_events()
+    assert len(events) == 4
+    assert events[0].message == "Successfully assigned ns/p0 to node-a"
+    assert events[0].reason == "Scheduled"
+    rvs = [e.resource_version for e in events]
+    assert rvs == sorted(rvs)
+
+    # copy-on-write: patch materializes the row, then object path applies
+    assert cluster.patch_pod_annotation("ns/p0", "k", "v") is True
+    assert cluster.get_pod("ns/p0").annotations["k"] == "v"
+    assert cluster.get_pod("ns/p0").node_name == "node-a"
+    assert cluster.count_pods("node-a") == 2  # no double count
+
+    # delete a burst row
+    cluster.delete_pod("ns/p4")
+    assert cluster.get_pod("ns/p4") is None
+    assert cluster.count_pods("node-b") == 1
+
+    # add_pod shadows a live burst row
+    cluster.add_pod(Pod(name="p2", namespace="ns", node_name="node-c"))
+    assert cluster.get_pod("ns/p2").node_name == "node-c"
+    assert cluster.count_pods("node-a") == 1  # p2's burst row retired
+
+
+def test_burst_bind_via_object_path_bind_pods():
+    cluster = ClusterState()
+    cluster.add_pod_burst("ns", ["a", "b"])
+    assert cluster.bind_pod("ns/a", "node-x") is True
+    assert cluster.get_pod("ns/a").node_name == "node-x"
+    assert cluster.count_pods("node-x") == 1
+    ev = cluster.list_events()[-1]
+    assert ev.message == "Successfully assigned ns/a to node-x"
+
+
+def test_burst_event_tail_bounded_but_heap_complete():
+    """A burst larger than the event-log cap materializes only the tail
+    (the deque would evict the rest anyway) while the hot-value heap sees
+    every binding."""
+    from crane_scheduler_tpu.annotator.bindings import BindingRecords
+    from crane_scheduler_tpu.annotator.events import EventIngestor
+
+    cluster = ClusterState(max_events=16)
+    records = BindingRecords(4096, 600.0)
+    EventIngestor(cluster, records).start()
+    n = 100
+    burst = cluster.add_pod_burst("ns", [f"p{i}" for i in range(n)])
+    now = 1753776000.0
+    cluster.bind_burst(burst, ["node-a"], np.zeros(n, dtype=np.int32), now)
+    assert len(cluster.list_events()) == 16
+    assert records.get_last_node_binding_count("node-a", 600.0, now + 1) == n
+
+
+def test_burst_legacy_subscriber_gets_all_events():
+    """A per-event subscriber without columnar support still sees every
+    event of a burst bind."""
+    cluster = ClusterState(max_events=8)
+    seen = []
+    cluster.subscribe_events(seen.append)
+    burst = cluster.add_pod_burst("ns", [f"p{i}" for i in range(20)])
+    cluster.bind_burst(burst, ["n1"], np.zeros(20, dtype=np.int32), 1.0)
+    assert len(seen) == 20
+    assert seen[0].message == "Successfully assigned ns/p0 to n1"
+    # the log still holds only the cap
+    assert len(cluster.list_events()) == 8
+
+
+def test_native_records_columnar_matches_python():
+    from crane_scheduler_tpu.annotator.bindings import BindingRecords
+
+    try:
+        from crane_scheduler_tpu.native.bindings import NativeBindingRecords
+
+        native = NativeBindingRecords(1024, 600.0)
+    except Exception:
+        native = None
+    py = BindingRecords(1024, 600.0)
+    table = ["a", "b", "c"]
+    idx = np.array([0, 1, 2, 0, 0, 1], dtype=np.int32)
+    py.add_bind_columns(table, idx, 100)
+    counts = {n: py.get_last_node_binding_count(n, 300.0, 150) for n in table}
+    assert counts == {"a": 3, "b": 2, "c": 1}
+    if native is not None:
+        native.add_bind_columns(table, idx, 100)
+        for n in table:
+            assert (
+                native.get_last_node_binding_count(n, 300.0, 150) == counts[n]
+            )
+
+
+def test_shadow_bound_burst_row_bumps_sched_version():
+    """Replacing a bound burst row via add_pod is a bound-pod delete for
+    snapshot caches (review finding on the shadow path)."""
+    cluster = ClusterState()
+    burst = cluster.add_pod_burst("ns", ["a"])
+    cluster.bind_burst(burst, ["node-x"], [0])
+    v = cluster.sched_version
+    cluster.add_pod(Pod(name="a", namespace="ns"))  # pending replacement
+    assert cluster.sched_version == v + 1
+    assert cluster.count_pods("node-x") == 0
+
+
+def test_fully_dead_burst_is_dropped():
+    cluster = ClusterState()
+    cluster.add_pod_burst("ns", ["a", "b"])
+    cluster.delete_pod("ns/a")
+    cluster.delete_pod("ns/b")
+    assert not cluster._bursts
+    assert cluster.get_pod("ns/a") is None
+
+
+def test_drain_burst_reconciles_deleted_rows():
+    """A pod deleted between dispatch and drain must not be reported as
+    scheduled (phantom-placement defect class)."""
+    sim = make_sim(4, seed=1)
+    batch = sim.build_batch_scheduler()
+    names = [f"w{i}" for i in range(10)]
+
+    def stream():
+        yield ("bench", names)
+        # depth-2 pipeline: the second dispatch happens before the first
+        # drain; delete a row in between
+        sim.cluster.delete_pod("bench/w3")
+        yield ("bench", [f"x{i}" for i in range(5)])
+
+    results = list(batch.schedule_bursts_pipelined(stream(), bind=True, depth=2))
+    first = results[0]
+    assert "bench/w3" not in first.assignments
+    assert first.n_assigned == 9
+    assert "bench/w3" in first.unassigned
+
+
+def test_metric_set_override_wins_on_bulk_path():
+    """sim.metrics.set() after init overrides the column model for bulk
+    queries too (review finding: bulk/per-node paths must agree)."""
+    sim = make_sim(3, seed=0)
+    metric = sim.policy.spec.sync_period[0].name
+    node = sim.cluster.list_nodes()[0]
+    ip = node.internal_ip()
+    sim.metrics.set(metric, ip, 0.97531, by="ip")
+    bulk = sim.metrics.query_all_by_metric(metric)
+    assert bulk[ip] == "0.97531"
+    assert sim.metrics.query_by_node_ip(metric, ip) == "0.97531"
+
+
+def test_hot_value_written_for_node_missing_first_metric():
+    """A node absent from the first metric's samples still gets its hot
+    value from a later metric pass in one bulk sweep (review finding)."""
+    from crane_scheduler_tpu.annotator import AnnotatorConfig, NodeAnnotator
+    from crane_scheduler_tpu.cluster import ClusterState, Node, NodeAddress
+    from crane_scheduler_tpu.constants import NODE_HOT_VALUE_KEY
+    from crane_scheduler_tpu.metrics import FakeMetricsSource
+    from crane_scheduler_tpu.policy.types import (
+        DynamicSchedulerPolicy,
+        PolicySpec,
+        PriorityPolicy,
+        SyncPolicy,
+    )
+
+    policy = DynamicSchedulerPolicy(spec=PolicySpec(
+        sync_period=(SyncPolicy("m1", 60.0), SyncPolicy("m2", 60.0)),
+        priority=(PriorityPolicy("m1", 1.0),),
+    ))
+    cluster = ClusterState()
+    cluster.add_node(Node(name="n1", addresses=(NodeAddress("InternalIP", "10.0.0.1"),)))
+    metrics = FakeMetricsSource()
+    metrics.set("m2", "10.0.0.1", 0.5, by="ip")  # no m1 sample at all
+    ann = NodeAnnotator(cluster, metrics, policy, AnnotatorConfig(bulk_sync=True))
+    ann.sync_all_once_bulk(1753776000.0)
+    ann.flush_annotations()
+    node = cluster.get_node("n1")
+    assert "m2" in node.annotations
+    assert NODE_HOT_VALUE_KEY in node.annotations
