@@ -107,7 +107,7 @@ class _PodBurst:
     """
 
     __slots__ = (
-        "namespace", "names", "node_ids", "table", "table_map", "dead", "version",
+        "namespace", "names", "node_ids", "table", "table_map", "dead",
     )
 
     def __init__(self, namespace: str, names: list):
@@ -119,7 +119,6 @@ class _PodBurst:
         self.table: list[str] = []  # burst-local node intern table
         self.table_map: dict[str, int] = {}
         self.dead: set[int] = set()  # rows materialized out / deleted
-        self.version = 0  # bumped per bind; keys count caches
 
     def materialize(self, row: int) -> Pod:
         node = self.table[self.node_ids[row]] if self.node_ids[row] >= 0 else ""
@@ -280,45 +279,37 @@ class ClusterState:
             self._burst_index.pop(key, None)
         return was_bound
 
+    def _add_pod_locked(self, pod: Pod) -> None:
+        """The one add/replace implementation (callers hold the lock):
+        shadow any live burst row, replace the object entry, and treat
+        replacing a bound pod — object or burst row — as a bound-pod
+        delete for snapshot versioning."""
+        key = pod.key()
+        prev_burst_bound = (
+            self._shadow_burst_locked(key) if self._bursts else False
+        )
+        prev = self._pods.get(key)
+        if prev is not None:
+            self._index_remove(prev)
+        self._pods[key] = pod
+        self._index_add(pod)
+        if (
+            pod.node_name
+            or (prev is not None and prev.node_name)
+            or prev_burst_bound
+        ):
+            self._sched_version += 1
+
     def add_pod(self, pod: Pod) -> None:
         with self._lock:
-            key = pod.key()
-            prev_burst_bound = (
-                self._shadow_burst_locked(key) if self._bursts else False
-            )
-            prev = self._pods.get(key)
-            if prev is not None:
-                self._index_remove(prev)
-            self._pods[key] = pod
-            self._index_add(pod)
-            # replacing a bound pod is a bound-pod delete for snapshots
-            if (
-                pod.node_name
-                or (prev is not None and prev.node_name)
-                or prev_burst_bound
-            ):
-                self._sched_version += 1
+            self._add_pod_locked(pod)
 
     def add_pods(self, pods) -> None:
         """Batch ``add_pod``: one lock hold for a whole burst's pod
         creations (per-pod lock round-trips dominate 100k-pod cycles)."""
         with self._lock:
             for pod in pods:
-                key = pod.key()
-                prev_burst_bound = (
-                    self._shadow_burst_locked(key) if self._bursts else False
-                )
-                prev = self._pods.get(key)
-                if prev is not None:
-                    self._index_remove(prev)
-                self._pods[key] = pod
-                self._index_add(pod)
-                if (
-                    pod.node_name
-                    or (prev is not None and prev.node_name)
-                    or prev_burst_bound
-                ):
-                    self._sched_version += 1
+                self._add_pod_locked(pod)
 
     def delete_pod(self, key: str) -> None:
         with self._lock:
@@ -500,7 +491,13 @@ class ClusterState:
         burst = _PodBurst(namespace, list(names))
         with self._lock:
             self._bursts.append(burst)
-            self._burst_index = None  # rebuilt lazily
+            index = self._burst_index
+            if index is not None:
+                # extend the existing index instead of invalidating it —
+                # a rebuild walks every live row of every burst
+                ns = burst.namespace
+                for row, name in enumerate(burst.names):
+                    index[f"{ns}/{name}"] = (burst, row)
         return burst
 
     def _burst_lookup_locked(self, key: str):
@@ -523,7 +520,6 @@ class ClusterState:
         A fully-dead burst is dropped so burst history can't grow
         lookup/materialization work without bound."""
         burst.dead.add(row)
-        burst.version += 1
         tid = int(burst.node_ids[row])
         if tid >= 0:
             name = burst.table[tid]
@@ -609,7 +605,6 @@ class ClusterState:
             bound_idx = node_idx[rows]
             burst.node_ids[rows] = remap[bound_idx]
             n = len(rows)
-            burst.version += 1
             # incremental bound-count maintenance: one bincount per bind
             counts = self._burst_bound_counts
             bc = np.bincount(remap[bound_idx], minlength=len(table))

@@ -390,6 +390,34 @@ class BatchScheduler:
             and self._prepared is not None
             and self._prepared_layout == getattr(self.store, "layout_version", None)
         ):
+            # column-write replay first: the annotator's bulk sweep is
+            # whole-column writes, uploading [N] vectors per touched
+            # column instead of the full matrices
+            column_delta = getattr(self.store, "column_delta_since", None)
+            cols = column_delta(self._prepared_key) if column_delta else None
+            if cols is not None:
+                new_key, layout, entries = cols
+                if layout == self._prepared_layout and entries:
+                    self._prepared = self._sharded.apply_columns(
+                        self._prepared, entries, self._prepared_n
+                    )
+                    self._prepared_key = new_key
+                    if self._hybrid:
+                        # fold the SAME writes into the cached host
+                        # snapshot, then refresh the rescue vectors
+                        snap = self._prepared_snap
+                        for col, ids, v, t, hv, ht in entries:
+                            if col is not None:
+                                snap.values[ids, col] = v
+                                snap.ts[ids, col] = t
+                            if hv is not None:
+                                snap.hot_value[ids] = hv
+                                snap.hot_ts[ids] = ht
+                        self._prepared = self._sharded.with_overrides(
+                            self._prepared, snap, now, force=True
+                        )
+                    return self._prepared
+
             (new_key, layout, rows, values_rows, ts_rows, hot_rows,
              hot_ts_rows) = self.store.delta_since(self._prepared_key)
             if (

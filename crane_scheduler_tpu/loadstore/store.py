@@ -94,6 +94,19 @@ class NodeLoadStore:
         # value edits can upload as row deltas, layout changes cannot
         self._row_versions: dict[int, int] = {}
         self._layout_version = 0
+        # column-write log (see _COLUMN_LOG_CAP): entries
+        # (pre_version, post_version, col_or_None, ids, values_or_None,
+        #  ts_or_None, hot_values_or_None, hot_ts_or_None). A consumer
+        # needs a CONTIGUOUS pre/post chain from its version to the
+        # current one — any foreign mutation breaks the chain by
+        # construction, so no invalidation hooks are needed.
+        self._column_log: list[tuple] = []
+
+    # column-write log: bulk_set_by_name appends one entry per call so a
+    # device snapshot can replay whole-column writes (the annotator's
+    # sweep shape) instead of re-uploading full matrices. Any other
+    # mutation invalidates the log by raising the floor. Bounded.
+    _COLUMN_LOG_CAP = 128
 
     @property
     def version(self) -> int:
@@ -111,6 +124,34 @@ class NodeLoadStore:
         """Record that ``row`` changed at the current version (callers
         hold the lock and have already bumped ``_version``)."""
         self._row_versions[row] = self._version
+
+    @_locked
+    def column_delta_since(self, version: int):
+        """Column-write replay from ``version`` to the current version:
+        ``(current_version, layout_version, entries)`` where each entry is
+        ``(col_or_None, ids, values_or_None, ts_or_None, hot_values_or_None,
+        hot_ts_or_None)`` in application order — or ``None`` when the
+        interval is not exactly covered by logged ``bulk_set_by_name``
+        calls (any other mutation breaks the version chain)."""
+        if version == self._version:
+            return self._version, self._layout_version, []
+        start = None
+        for k, entry in enumerate(self._column_log):
+            if entry[0] == version:
+                start = k
+                break
+        if start is None:
+            return None
+        chain = []
+        expect = version
+        for entry in self._column_log[start:]:
+            if entry[0] != expect:
+                return None
+            chain.append(entry[2:])
+            expect = entry[1]
+        if expect != self._version:
+            return None
+        return self._version, self._layout_version, chain
 
     @_locked
     def delta_since(self, version: int):
@@ -287,6 +328,8 @@ class NodeLoadStore:
         hold, so a concurrent ``prune_absent`` (which swap-removes rows)
         can never redirect a pre-resolved id to another node's row."""
         index = self._index
+        pre_version = self._version
+        pre_layout = self._layout_version
         ids = np.asarray(
             [
                 i if (i := index.get(n)) is not None else self.add_node(n)
@@ -309,6 +352,26 @@ class NodeLoadStore:
         if wrote:
             version = self._version
             self._row_versions.update((int(i), version) for i in ids)
+            if pre_layout == self._layout_version:
+                # log the column write for device-side replay (arrays are
+                # captured; callers build them fresh per call). A write
+                # that added nodes changed the layout — not replayable.
+                self._column_log.append((
+                    pre_version,
+                    version,
+                    col,
+                    ids,
+                    np.broadcast_to(np.asarray(values, np.float64), ids.shape).copy()
+                    if col is not None else None,
+                    np.broadcast_to(np.asarray(ts, np.float64), ids.shape).copy()
+                    if col is not None else None,
+                    np.broadcast_to(np.asarray(hot_values, np.float64), ids.shape).copy()
+                    if hot_values is not None else None,
+                    np.broadcast_to(np.asarray(hot_ts, np.float64), ids.shape).copy()
+                    if hot_values is not None else None,
+                ))
+                if len(self._column_log) > self._COLUMN_LOG_CAP:
+                    del self._column_log[0]
 
     @_locked
     def prune_absent(self, live_names) -> int:

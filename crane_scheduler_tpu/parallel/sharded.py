@@ -313,6 +313,155 @@ class ShardedScheduleStep:
             prepared, values=values2, ts=ts2, hot_value=hot2, hot_ts=hot_ts2
         )
 
+    def apply_columns(self, prepared: PreparedSnapshot, entries, n: int):
+        """Replay a store column-write log (``NodeLoadStore.
+        column_delta_since``) against the resident device arrays.
+
+        The annotator's bulk sweep writes whole columns — one [N] value
+        vector per metric with one shared timestamp — so a cycle's
+        refresh uploads ~[N] floats per touched column instead of the
+        full [N, M] matrices (the tunnel H2D of full matrices dominated
+        the 50k-node refresh). Timestamps rebase to the prepared epoch;
+        uniform ts columns upload as a scalar. Bit-identical scoring
+        results to a full ``prepare`` of the updated store at the same
+        epoch (pad rows may carry a fresher ts under a uniform-ts column
+        set; they are node_valid=False and never score).
+        """
+        import dataclasses
+        import math as _math
+
+        dtype = self.scorer.dtype
+        npad = int(prepared.capacity.shape[0])
+        values, ts = prepared.values, prepared.ts
+        hot, hot_ts = prepared.hot_value, prepared.hot_ts
+        for col, ids, v, t, hv, ht in entries:
+            full = len(ids) == n and np.array_equal(
+                ids, np.arange(n, dtype=ids.dtype)
+            )
+            if col is not None:
+                t64 = np.asarray(t, np.float64) - prepared.epoch
+                if full:
+                    v_pad = np.full((npad,), np.nan)
+                    v_pad[:n] = v
+                    if t64.size and np.all(t64 == t64[0]):
+                        values, ts = self._jit_col_set_uniform(
+                            values, ts, jnp.asarray(int(col)),
+                            jnp.asarray(v_pad, dtype),
+                            jnp.asarray(t64[0], dtype),
+                        )
+                    else:
+                        t_pad = np.full((npad,), -np.inf)
+                        t_pad[:n] = t64
+                        values, ts = self._jit_col_set(
+                            values, ts, jnp.asarray(int(col)),
+                            jnp.asarray(v_pad, dtype),
+                            jnp.asarray(t_pad, dtype),
+                        )
+                else:
+                    k = len(ids)
+                    kpad = 1 << max(0, _math.ceil(_math.log2(max(k, 1))))
+                    idx = np.full((kpad,), npad, dtype=np.int32)
+                    idx[:k] = ids
+                    v_rows = np.full((kpad,), np.nan)
+                    v_rows[:k] = v
+                    t_rows = np.full((kpad,), -np.inf)
+                    t_rows[:k] = t64
+                    values, ts = self._jit_col_scatter(
+                        values, ts, jnp.asarray(idx), jnp.asarray(int(col)),
+                        jnp.asarray(v_rows, dtype), jnp.asarray(t_rows, dtype),
+                    )
+            if hv is not None:
+                ht64 = np.asarray(ht, np.float64) - prepared.epoch
+                k = len(ids)
+                if full:
+                    h_pad = np.full((npad,), np.nan)
+                    h_pad[:k] = hv
+                    ht_pad = np.full((npad,), -np.inf)
+                    ht_pad[:k] = ht64
+                    hot = jax.device_put(jnp.asarray(h_pad, dtype), self._vec)
+                    hot_ts = jax.device_put(jnp.asarray(ht_pad, dtype), self._vec)
+                else:
+                    kpad = 1 << max(0, _math.ceil(_math.log2(max(k, 1))))
+                    idx = np.full((kpad,), npad, dtype=np.int32)
+                    idx[:k] = ids
+                    h_rows = np.full((kpad,), np.nan)
+                    h_rows[:k] = hv
+                    ht_rows = np.full((kpad,), -np.inf)
+                    ht_rows[:k] = ht64
+                    hot, hot_ts = self._jit_hot_scatter(
+                        hot, hot_ts, jnp.asarray(idx),
+                        jnp.asarray(h_rows, dtype), jnp.asarray(ht_rows, dtype),
+                    )
+        return dataclasses.replace(
+            prepared, values=values, ts=ts, hot_value=hot, hot_ts=hot_ts
+        )
+
+    @functools.cached_property
+    def _jit_col_set(self):
+        def set_col(values, ts, col, v_pad, t_pad):
+            npad = values.shape[0]
+            values = jax.lax.dynamic_update_slice(
+                values, v_pad.reshape(npad, 1), (0, col)
+            )
+            ts = jax.lax.dynamic_update_slice(ts, t_pad.reshape(npad, 1), (0, col))
+            return values, ts
+
+        return jax.jit(
+            set_col,
+            in_shardings=(self._row, self._row, self._rep, self._vec, self._vec),
+            out_shardings=(self._row, self._row),
+        )
+
+    @functools.cached_property
+    def _jit_col_set_uniform(self):
+        def set_col(values, ts, col, v_pad, t_scalar):
+            npad = values.shape[0]
+            values = jax.lax.dynamic_update_slice(
+                values, v_pad.reshape(npad, 1), (0, col)
+            )
+            ts = jax.lax.dynamic_update_slice(
+                ts, jnp.full((npad, 1), t_scalar, ts.dtype), (0, col)
+            )
+            return values, ts
+
+        return jax.jit(
+            set_col,
+            in_shardings=(
+                self._row, self._row, self._rep, self._vec, self._rep,
+            ),
+            out_shardings=(self._row, self._row),
+        )
+
+    @functools.cached_property
+    def _jit_col_scatter(self):
+        def scatter(values, ts, idx, col, v_rows, t_rows):
+            return (
+                values.at[idx, col].set(v_rows, mode="drop"),
+                ts.at[idx, col].set(t_rows, mode="drop"),
+            )
+
+        return jax.jit(
+            scatter,
+            in_shardings=(
+                self._row, self._row, self._rep, self._rep, self._rep, self._rep,
+            ),
+            out_shardings=(self._row, self._row),
+        )
+
+    @functools.cached_property
+    def _jit_hot_scatter(self):
+        def scatter(hot, hot_ts, idx, h_rows, ht_rows):
+            return (
+                hot.at[idx].set(h_rows, mode="drop"),
+                hot_ts.at[idx].set(ht_rows, mode="drop"),
+            )
+
+        return jax.jit(
+            scatter,
+            in_shardings=(self._vec, self._vec, self._rep, self._rep, self._rep),
+            out_shardings=(self._vec, self._vec),
+        )
+
     @functools.cached_property
     def _jit_delta(self):
         def scatter(values, ts, hot, hot_ts, idx, v_rows, t_rows, h_rows, ht_rows):

@@ -147,6 +147,8 @@ class Simulator:
                 load = base[(name, metric)] + per_pod * counts_get(name, 0)
                 if load > 1.0:
                     load = 1.0
+                elif load < 0.0:  # same clamp as _render/_stream
+                    load = 0.0
                 out[ip] = f"{load:.5f}"
             return out
 

@@ -1,0 +1,181 @@
+"""Column-write store->device uploads: the annotator's bulk sweep writes
+whole columns (one [N] value vector per metric, shared timestamp), so the
+device refresh replays the store's column log
+(``NodeLoadStore.column_delta_since`` -> ``ShardedScheduleStep.
+apply_columns``) instead of re-uploading full matrices. Scoring results
+must be bit-identical to a full prepare of the updated store at the same
+epoch, in f64, f32, and hybrid modes."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from crane_scheduler_tpu.loadstore import NodeLoadStore, encode_annotation
+from crane_scheduler_tpu.parallel import ShardedScheduleStep, make_node_mesh
+from crane_scheduler_tpu.policy import DEFAULT_POLICY, compile_policy
+
+NOW = 1753776000.0
+
+
+def _build_store(n=48, seed=0):
+    rng = np.random.default_rng(seed)
+    tensors = compile_policy(DEFAULT_POLICY)
+    store = NodeLoadStore(tensors)
+    for i in range(n):
+        anno = {
+            m: encode_annotation(float(rng.uniform(0, 1)), NOW - 30.0)
+            for m in tensors.metric_names
+        }
+        anno["node_hot_value"] = encode_annotation(float(rng.integers(0, 3)), NOW - 10.0)
+        store.ingest_node_annotations(f"node-{i:03d}", anno)
+    return tensors, store
+
+
+def _sweep(store, tensors, rng, now, partial_metric=None):
+    """Simulate one annotator bulk pass: per-metric full-column writes
+    with hot values on the first metric (sync_metric_bulk's shape).
+    ``partial_metric`` skips two nodes for that metric (missing samples)."""
+    names = list(store.node_names)
+    n = len(names)
+    for k, metric in enumerate(tensors.metric_names):
+        cols_names = names
+        if metric == partial_metric:
+            cols_names = names[:-2]
+        m = len(cols_names)
+        values = rng.uniform(0, 1, m)
+        ts = np.full(m, now)
+        if k == 0:
+            store.bulk_set_by_name(
+                metric, cols_names, values, ts,
+                rng.integers(0, 3, m).astype(float), np.full(m, now),
+            )
+        else:
+            store.bulk_set_by_name(metric, cols_names, values, ts)
+
+
+@pytest.mark.parametrize("dtype,hybrid", [
+    (jnp.float64, False), (jnp.float32, False), (jnp.float32, True),
+])
+@pytest.mark.parametrize("partial", [False, True])
+def test_apply_columns_bit_identical_to_full_prepare(dtype, hybrid, partial):
+    tensors, store = _build_store()
+    rng = np.random.default_rng(7)
+    step = ShardedScheduleStep(tensors, make_node_mesh(8), dtype=dtype, hybrid=hybrid)
+    n = len(store)
+
+    base_version = store.version
+    prepared = step.prepare(store.snapshot(bucket=16), NOW)
+    _sweep(store, tensors, rng, NOW + 5.0,
+           partial_metric=tensors.metric_names[1] if partial else None)
+
+    got = store.column_delta_since(base_version)
+    assert got is not None, "sweep must be replayable from the column log"
+    new_v, layout, entries = got
+    assert new_v == store.version
+    assert len(entries) == len(tensors.metric_names)
+
+    updated = step.apply_columns(prepared, entries, n)
+    snap = store.snapshot(bucket=16)
+    if hybrid:
+        updated = step.with_overrides(updated, snap, NOW, force=True)
+    want = step.prepare(snap, NOW)
+
+    # live rows bit-identical (pad rows may differ in ts under the
+    # uniform-scalar column set; they are node_valid=False)
+    for field in ("values", "ts", "hot_value", "hot_ts"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(updated, field))[:n],
+            np.asarray(getattr(want, field))[:n],
+            err_msg=field,
+        )
+    if hybrid:
+        for field in ("ovr_mask", "ovr_sched", "ovr_score"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(updated, field)),
+                np.asarray(getattr(want, field)), err_msg=field,
+            )
+    got = np.asarray(step.packed(updated, 100))
+    np.testing.assert_array_equal(got, np.asarray(step.packed(want, 100)))
+
+
+def test_column_log_chain_breaks_on_foreign_mutation():
+    tensors, store = _build_store(n=8)
+    rng = np.random.default_rng(1)
+    v0 = store.version
+    _sweep(store, tensors, rng, NOW + 5.0)
+    assert store.column_delta_since(v0) is not None
+    # a foreign mutation inside the interval breaks the chain
+    store.set_metric("node-000", tensors.metric_names[0], 0.5, NOW + 6.0)
+    assert store.column_delta_since(v0) is None
+    # but a fresh interval after it is replayable again
+    v1 = store.version
+    _sweep(store, tensors, rng, NOW + 7.0)
+    assert store.column_delta_since(v1) is not None
+    # unchanged store: empty replay
+    assert store.column_delta_since(store.version)[2] == []
+
+
+def test_column_log_membership_change_not_replayable():
+    tensors, store = _build_store(n=8)
+    rng = np.random.default_rng(2)
+    v0 = store.version
+    # a bulk write that adds a new node changes the layout: the entry is
+    # not logged and the chain from v0 must not resolve
+    names = list(store.node_names) + ["node-new"]
+    store.bulk_set_by_name(
+        tensors.metric_names[0], names,
+        rng.uniform(0, 1, len(names)), np.full(len(names), NOW),
+    )
+    assert store.column_delta_since(v0) is None
+
+
+def test_batch_scheduler_uses_column_path(monkeypatch):
+    """The annotator's direct-store sweep rides the column path in
+    BatchScheduler._prepare; placements equal a cold scheduler's."""
+    from crane_scheduler_tpu.annotator import AnnotatorConfig, NodeAnnotator
+    from crane_scheduler_tpu.framework.scheduler import BatchScheduler
+    from crane_scheduler_tpu.sim import SimConfig, Simulator
+
+    sim = Simulator(SimConfig(n_nodes=6, seed=9))
+    sim.sync_metrics()
+    ann = sim.annotator
+    ann.config.bulk_sync = True
+    ann.config.direct_store = True
+    batch = BatchScheduler(
+        sim.cluster, sim.policy, dtype=jnp.float32, clock=sim.clock,
+        snapshot_bucket=16, refresh_from_cluster=False,
+    )
+    ann.attach_store(batch.store)
+    ann.sync_all_once_bulk(sim.clock())
+
+    calls = {"columns": 0, "full": 0}
+    real_cols = batch._sharded.apply_columns
+    real_prep = batch._sharded.prepare
+
+    def counting_cols(*a, **k):
+        calls["columns"] += 1
+        return real_cols(*a, **k)
+
+    def counting_prep(*a, **k):
+        calls["full"] += 1
+        return real_prep(*a, **k)
+
+    monkeypatch.setattr(batch._sharded, "apply_columns", counting_cols)
+    monkeypatch.setattr(batch._sharded, "prepare", counting_prep)
+
+    names = [f"p{i}" for i in range(10)]
+    batch.schedule_pod_burst("b", names)  # full prepare
+    assert calls == {"columns": 0, "full": 1}
+
+    sim.clock.advance(30.0)
+    ann.sync_all_once_bulk(sim.clock())  # whole-column sweep
+    r = batch.schedule_pod_burst("b2", names)
+    assert calls == {"columns": 1, "full": 1}
+
+    cold = BatchScheduler(
+        sim.cluster, sim.policy, dtype=jnp.float32, clock=sim.clock,
+        snapshot_bucket=16, refresh_from_cluster=False, store=batch.store,
+    )
+    r_cold = cold.schedule_pod_burst("b2-cold", names, bind=False)
+    assert list(np.asarray(r.scores_row)) == list(np.asarray(r_cold.scores_row))
+    assert list(np.asarray(r.node_idx)) == list(np.asarray(r_cold.node_idx))
