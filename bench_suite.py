@@ -298,7 +298,11 @@ def config6(dtype, rtt, node_scales=(10_000, 50_000)):
     from crane_scheduler_tpu.framework.scheduler import BatchScheduler
 
     for n_nodes in node_scales:
-        pods_per_cycle, cycles = 100_000, 6
+        # two bursts per sync cycle: the reference's scores are static
+        # between annotator syncs (metrics re-sync every 3m-3h), so
+        # scheduling several bursts per sweep is its real operating
+        # shape; per-sweep-per-cycle remains far above real cadence
+        pods_per_cycle, bursts_per_sync, cycles = 100_000, 2, 6
         sim = _sim(n_nodes, seed=6)
         ann = sim.annotator
         ann.config.bulk_sync = True
@@ -336,7 +340,8 @@ def config6(dtype, rtt, node_scales=(10_000, 50_000)):
                 t0 = time.perf_counter()
                 ann.flush_annotations()  # annotation contract catch-up
                 phase["flush"] += time.perf_counter() - t0
-                yield ("bench", make_names())
+                for _ in range(bursts_per_sync):
+                    yield ("bench", make_names())
 
         t0 = time.perf_counter()
         assigned = 0
@@ -346,7 +351,8 @@ def config6(dtype, rtt, node_scales=(10_000, 50_000)):
         emit({"config": 6,
               "desc": "full loop, columnar burst: solve+fetch+bind+events+"
                       "hot-values+annotator sync+annotation flush "
-                      f"({n_nodes} nodes, {pods_per_cycle} pods/cycle, pipelined)",
+                      f"({n_nodes} nodes, {pods_per_cycle} pods/burst, "
+                      f"{bursts_per_sync} bursts/sync cycle, pipelined)",
               "cycles": cycles,
               "assigned": assigned,
               "parity": parity,

@@ -38,6 +38,7 @@ from ..loadstore.codec import (
     encode_annotation,
     go_parse_float,
 )
+from ..native.codec import bulk_parse_values
 from ..utils.timeutil import format_local_time
 from ..loadstore.store import NodeLoadStore
 from ..metrics.source import MetricsQueryError, MetricsSource
@@ -116,14 +117,18 @@ class NodeAnnotator:
         # direct-store mode (AnnotatorConfig.direct_store)
         self._store: NodeLoadStore | None = None
         # deferred annotation patches, coalesced last-write-wins per
-        # (node, key): annotation writes are idempotent state, so a slow
-        # flusher never backlogs more than |nodes| x (|metrics|+1)
-        # entries, and re-syncs between flushes collapse to one patch
-        self._anno_pending: dict[tuple[str, str], str] = {}
+        # (key, node) — columnar (key -> {node: raw}) because sweeps emit
+        # whole columns and string keys hash cheaper than tuples.
+        # Annotation writes are idempotent state, so a slow flusher never
+        # backlogs more than (|metrics|+1) x |nodes| entries, and
+        # re-syncs between flushes collapse to one patch
+        self._anno_pending: dict[str, dict[str, str]] = {}
         self._anno_lock = threading.Lock()
         # (node_set_version, [(name, ip)]) — a bulk sweep re-reads the
         # same pair list |metrics| times per cycle
-        self._node_pairs_cache: tuple[int, list[tuple[str, str]]] | None = None
+        # (node_set_version, [(name, ip)], [name], [ip]) — see _node_tables
+        self._node_pairs_cache: tuple | None = None
+        self._last_prune_state: tuple | None = None
 
     def attach_store(self, store: NodeLoadStore) -> NodeLoadStore:
         """Register the store that direct-mode bulk syncs write into."""
@@ -132,28 +137,31 @@ class NodeAnnotator:
 
     def _emit_annotation(self, node_name: str, key: str, raw: str) -> None:
         with self._anno_lock:
-            self._anno_pending[(node_name, key)] = raw
+            self._anno_pending.setdefault(key, {})[node_name] = raw
 
-    def _emit_annotations_bulk(self, items) -> None:
-        """One lock hold for a whole sweep's deferred patches.
-        ``items``: iterable of ``((node, key), raw)`` pairs or a dict."""
+    def _emit_annotation_column(self, key: str, names, values) -> None:
+        """One lock hold for a whole column's deferred patches."""
         with self._anno_lock:
-            self._anno_pending.update(items)
+            self._anno_pending.setdefault(key, {}).update(zip(names, values))
+
+    def _node_tables(self):
+        """``(pairs, names, ips)`` for the sweep loops, cached on the
+        cluster's node-set version (annotation patches don't change
+        names/addresses)."""
+        version = getattr(self.cluster, "node_set_version", None)
+        cache = self._node_pairs_cache
+        if version is None or cache is None or cache[0] != version:
+            pairs = [(n.name, n.internal_ip()) for n in self.cluster.list_nodes()]
+            cache = (
+                version, pairs, [p[0] for p in pairs], [p[1] for p in pairs],
+            )
+            if version is not None:
+                self._node_pairs_cache = cache
+        return cache[1], cache[2], cache[3]
 
     def _node_pairs(self) -> list[tuple[str, str]]:
-        """(name, internal_ip) per node, cached on the cluster's node-set
-        version (annotation patches don't change names/addresses)."""
-        version = getattr(self.cluster, "node_set_version", None)
-        if version is None:
-            return [(n.name, n.internal_ip()) for n in self.cluster.list_nodes()]
-        cache = self._node_pairs_cache
-        if cache is None or cache[0] != version:
-            cache = (
-                version,
-                [(n.name, n.internal_ip()) for n in self.cluster.list_nodes()],
-            )
-            self._node_pairs_cache = cache
-        return cache[1]
+        """(name, internal_ip) per node (see ``_node_tables``)."""
+        return self._node_tables()[0]
 
     def flush_annotations(self) -> int:
         """Apply deferred annotation patches (direct mode writes the store
@@ -165,16 +173,23 @@ class NodeAnnotator:
             pending, self._anno_pending = self._anno_pending, {}
         if not pending:
             return 0
+        total = sum(len(sub) for sub in pending.values())
         bulk = getattr(self.cluster, "patch_node_annotations_bulk", None)
         if bulk is not None:
             per_node: dict[str, dict[str, str]] = {}
-            for (node_name, key), raw in pending.items():
-                per_node.setdefault(node_name, {})[key] = raw
+            for key, sub in pending.items():
+                for node_name, raw in sub.items():
+                    d = per_node.get(node_name)
+                    if d is None:
+                        d = per_node[node_name] = {}
+                    d[key] = raw
             bulk(per_node)
         else:
-            for (node_name, key), raw in pending.items():
-                self.cluster.patch_node_annotation(node_name, key, raw)
-        return len(pending)
+            patch = self.cluster.patch_node_annotation
+            for key, sub in pending.items():
+                for node_name, raw in sub.items():
+                    patch(node_name, key, raw)
+        return total
 
     # -- core sync logic ---------------------------------------------------
 
@@ -347,117 +362,122 @@ class NodeAnnotator:
         except MetricsQueryError:
             self.enqueue_metric(metric_name)
             return 0
-        # index samples by exact instance and by host (port stripped)
-        by_host: dict[str, str] = {}
-        for instance, value in samples.items():
-            by_host.setdefault(instance, value)
-            host = instance.rsplit(":", 1)[0]
-            if host != instance:
-                by_host.setdefault(host, value)
+        import numpy as np
+
+        # index samples by exact instance and by host (port stripped) —
+        # needed only when instances carry ports; a bare-IP sample set
+        # (the common case) is used as-is, skipping a full-dict rebuild
+        if any(":" in k for k in samples):
+            by_host: dict[str, str] = {}
+            for instance, value in samples.items():
+                by_host.setdefault(instance, value)
+                host = instance.rsplit(":", 1)[0]
+                if host != instance:
+                    by_host.setdefault(host, value)
+        else:
+            by_host = samples
         direct = self._store is not None and self.config.direct_store
         if hot_by_node is self._HOT_UNSET:
             hot_by_node = self.hot_values_batch(now)
-        patched = 0
-        names: list[str] = []
-        metric_vals: list[float] = []
-        metric_ts: list[float] = []
-        hot_vals: list[float] = []
-        hot_ts: list[float] = []
-        emit_items: dict[tuple[str, str], str] = {}
         # The direct-store write must be bit-identical to a future
         # re-ingest of the emitted annotation string (the timestamp
         # truncates to seconds in the wire format). Every row in this
-        # sweep shares ONE encoded timestamp, so decode it once instead
-        # of round-tripping "value,ts" through the full codec per node —
-        # decode of our own encode reduces to go_parse_float(value) +
-        # this shared parsed ts (values are float-formatted, comma-free).
-        # The shared wire timestamp is likewise rendered once: every
-        # annotation in this sweep is f"{value},{ts_str}" (encoding
-        # per node re-paid a TZ env read + lru lookup 2x per node —
-        # it dominated full-loop profiles). Hot-value strings repeat
-        # (small ints), so they're cached per distinct value.
+        # sweep shares ONE encoded timestamp, decoded once; values parse
+        # in one native call (Python comp fallback); annotation strings
+        # are one concat per node. A per-node Python loop body here
+        # dominated full-loop profiles at 50k nodes.
         ts_str = format_local_time(now)
         _, shared_ts = decode_annotation_or_missing(f"0,{ts_str}")
         nan, neg_inf = float("nan"), float("-inf")
         stale = shared_ts == neg_inf
-        hot_anno_cache: dict[int, str] = {}
-        queue_add = self.queue.add
-        by_host_get = by_host.get
-        hot_names: list[str] = []
-        for name, ip in self._node_pairs():
-            value = by_host_get(ip) or by_host_get(name)
-            if not value:
-                queue_add(_meta_key(name, metric_name))
-                continue
-            emit_hot = hot_emitted is None or name not in hot_emitted
-            if emit_hot:
-                if hot_emitted is not None:
-                    hot_emitted.add(name)
-                if hot_by_node is not None:
-                    hot = hot_by_node.get(name, 0)
-                else:
-                    hot = self.hot_value(name, now)
-                hot_anno = hot_anno_cache.get(hot)
-                if hot_anno is None:
-                    hot_anno = hot_anno_cache[hot] = f"{hot},{ts_str}"
-            if direct:
-                v = go_parse_float(value)
-                if v is None or stale:
-                    v, ts = nan, neg_inf
-                else:
-                    ts = shared_ts
-                names.append(name)
-                metric_vals.append(v)
-                metric_ts.append(ts)
-                emit_items[(name, metric_name)] = f"{value},{ts_str}"
-                if emit_hot:
-                    hot_names.append(name)
-                    hot_vals.append(nan if stale else float(hot))
-                    hot_ts.append(shared_ts)
-                    emit_items[(name, NODE_HOT_VALUE_KEY)] = hot_anno
-            else:
-                self.cluster.patch_node_annotation(
-                    name, metric_name, f"{value},{ts_str}"
-                )
-                if emit_hot:
-                    self.cluster.patch_node_annotation(
-                        name, NODE_HOT_VALUE_KEY, hot_anno
-                    )
-            patched += 1
+        pairs, all_names, all_ips = self._node_tables()
+        # bulk column providers return {ip: value} in node order — when
+        # the key sequence matches exactly, take the values as-is instead
+        # of |nodes| dict lookups
+        if by_host is samples and list(samples) == all_ips:
+            vals = list(samples.values())
+        else:
+            by_host_get = by_host.get
+            vals = [by_host_get(ip) or by_host_get(name) for name, ip in pairs]
+        if all(vals):
+            names = all_names
+        else:
+            queue_add = self.queue.add
+            for (name, _), v in zip(pairs, vals):
+                if not v:
+                    queue_add(_meta_key(name, metric_name))
+            names = [p[0] for p, v in zip(pairs, vals) if v]
+            vals = [v for v in vals if v]
+        patched = len(names)
         self.synced += patched
-        if emit_items:
-            self._emit_annotations_bulk(emit_items)
-        if direct and names:
-            import numpy as np
-
+        if not names:
+            return 0
+        # hot values: once per (node, sweep) — see the docstring
+        if hot_emitted is None:
+            hot_names = names
+        else:
+            hot_names = [n for n in names if n not in hot_emitted]
+            hot_emitted.update(hot_names)
+        hot_annos: list[str] = []
+        if hot_names:
+            if hot_by_node is not None:
+                hget = hot_by_node.get
+                hots = [hget(n, 0) for n in hot_names]
+            else:
+                hots = [self.hot_value(n, now) for n in hot_names]
+            hot_annos = [f"{h},{ts_str}" for h in hots]
+        suffix = "," + ts_str
+        annos = [v + suffix for v in vals]
+        if direct:
+            self._emit_annotation_column(metric_name, names, annos)
+            if hot_names:
+                self._emit_annotation_column(
+                    NODE_HOT_VALUE_KEY, hot_names, hot_annos
+                )
+            if stale:
+                metric_vals = np.full((len(names),), nan)
+                metric_ts = np.full((len(names),), neg_inf)
+            else:
+                parsed = bulk_parse_values(vals)
+                if parsed is not None:
+                    metric_vals, ok = parsed
+                else:
+                    pv = [go_parse_float(v) for v in vals]
+                    metric_vals = np.asarray(
+                        [nan if x is None else x for x in pv]
+                    )
+                    ok = np.asarray([x is not None for x in pv])
+                metric_vals = np.where(ok, metric_vals, nan)
+                metric_ts = np.where(ok, shared_ts, neg_inf)
+            hot_vals = hot_ts_arr = None
+            if hot_names:
+                if stale:
+                    hot_vals = np.full((len(hot_names),), nan)
+                else:
+                    hot_vals = np.asarray(hots, dtype=np.float64)
+                hot_ts_arr = np.full((len(hot_names),), shared_ts)
             # One lock hold resolves name->row AND writes, so a
             # concurrent prune's swap-removes can't redirect stale ids.
-            if len(hot_names) == len(names):
+            if hot_names is names or len(hot_names) == len(names):
                 # hot rows align with metric rows (the common sweep)
                 self._store.bulk_set_by_name(
-                    metric_name,
-                    names,
-                    np.asarray(metric_vals),
-                    np.asarray(metric_ts),
-                    np.asarray(hot_vals),
-                    np.asarray(hot_ts),
+                    metric_name, names, metric_vals, metric_ts,
+                    hot_vals, hot_ts_arr,
                 )
             else:
                 self._store.bulk_set_by_name(
-                    metric_name,
-                    names,
-                    np.asarray(metric_vals),
-                    np.asarray(metric_ts),
+                    metric_name, names, metric_vals, metric_ts
                 )
                 if hot_names:
                     self._store.bulk_set_by_name(
-                        None,
-                        hot_names,
-                        None,
-                        None,
-                        np.asarray(hot_vals),
-                        np.asarray(hot_ts),
+                        None, hot_names, None, None, hot_vals, hot_ts_arr
                     )
+        else:
+            patch = self.cluster.patch_node_annotation
+            for name, anno in zip(names, annos):
+                patch(name, metric_name, anno)
+            for name, hot_anno in zip(hot_names, hot_annos):
+                patch(name, NODE_HOT_VALUE_KEY, hot_anno)
         return patched
 
     def _prune_direct_store(self) -> None:
@@ -465,9 +485,19 @@ class NodeAnnotator:
         scheduler's refresh() returns early), so every bulk tick must
         prune deleted cluster nodes or they stay schedulable — including
         ticks that fall back to the per-node queue (no bulk query support
-        or a failing metrics source)."""
-        if self._store is not None and self.config.direct_store:
-            self._store.prune_absent(self.cluster.node_names())
+        or a failing metrics source). Skipped while neither the cluster's
+        node set nor the store's row layout has changed since the last
+        prune (the prune scans |rows| names)."""
+        if self._store is None or not self.config.direct_store:
+            return
+        state = (
+            getattr(self.cluster, "node_set_version", None),
+            self._store.layout_version,
+        )
+        if state[0] is not None and state == self._last_prune_state:
+            return
+        self._store.prune_absent(self.cluster.node_names())
+        self._last_prune_state = (state[0], self._store.layout_version)
 
     def sync_all_once_bulk(self, now: float | None = None) -> None:
         """Deterministic bulk pass over syncPolicy metrics. Each node's

@@ -92,7 +92,7 @@ class NodeLoadStore:
         # delta-upload support: which version last touched each row, and
         # a separate counter for layout changes (row <-> name mapping) —
         # value edits can upload as row deltas, layout changes cannot
-        self._row_versions: dict[int, int] = {}
+        self._row_versions = np.zeros((cap,), dtype=np.int64)
         self._layout_version = 0
         # column-write log (see _COLUMN_LOG_CAP): entries
         # (pre_version, post_version, col_or_None, ids, values_or_None,
@@ -101,11 +101,14 @@ class NodeLoadStore:
         # current one — any foreign mutation breaks the chain by
         # construction, so no invalidation hooks are needed.
         self._column_log: list[tuple] = []
+        # (names list identity, layout_version, ids) — bulk_set_by_name's
+        # name->row resolution for the annotator's cached sweep list
+        self._ids_cache: tuple | None = None
 
     # column-write log: bulk_set_by_name appends one entry per call so a
     # device snapshot can replay whole-column writes (the annotator's
-    # sweep shape) instead of re-uploading full matrices. Any other
-    # mutation invalidates the log by raising the floor. Bounded.
+    # sweep shape) instead of re-uploading full matrices. Bounded; any
+    # other mutation breaks the version chain consumers require.
     _COLUMN_LOG_CAP = 128
 
     @property
@@ -161,8 +164,9 @@ class NodeLoadStore:
         ts[k, M], hot_value[k], hot_ts[k])``. Valid for delta-uploading a
         device snapshot taken at ``version`` ONLY while layout_version is
         unchanged (the caller checks)."""
-        rows = sorted(i for i, v in self._row_versions.items() if v > version)
-        ids = np.asarray(rows, dtype=np.int64)
+        ids = np.nonzero(self._row_versions[: self._n] > version)[0].astype(
+            np.int64
+        )
         # fancy indexing already yields fresh arrays — no extra copies
         return (
             self._version,
@@ -225,7 +229,7 @@ class NodeLoadStore:
         self._n = last
         self._version += 1
         self._layout_version += 1
-        self._row_versions.pop(last, None)
+        self._row_versions[last] = 0
         if i != last:
             self._touch(i)  # row i now holds the moved node's data
 
@@ -236,9 +240,10 @@ class NodeLoadStore:
             ("ts", _NEG_INF, (new_cap, m)),
             ("hot_value", np.nan, (new_cap,)),
             ("hot_ts", _NEG_INF, (new_cap,)),
+            ("_row_versions", 0, (new_cap,)),
         ):
             old = getattr(self, attr)
-            new = np.full(shape, fill, dtype=np.float64)
+            new = np.full(shape, fill, dtype=old.dtype)
             new[: self._n] = old[: self._n]
             setattr(self, attr, new)
         self._cap = new_cap
@@ -330,13 +335,25 @@ class NodeLoadStore:
         index = self._index
         pre_version = self._version
         pre_layout = self._layout_version
-        ids = np.asarray(
-            [
-                i if (i := index.get(n)) is not None else self.add_node(n)
-                for n in names
-            ],
-            dtype=np.int64,
-        )
+        # a sweep passes the same cached name list once per metric —
+        # resolve name->row once per (list identity, layout)
+        cached = self._ids_cache
+        if (
+            cached is not None
+            and cached[0] is names
+            and cached[1] == pre_layout
+        ):
+            ids = cached[2]
+        else:
+            ids = np.asarray(
+                [
+                    i if (i := index.get(n)) is not None else self.add_node(n)
+                    for n in names
+                ],
+                dtype=np.int64,
+            )
+            if isinstance(names, list):
+                self._ids_cache = (names, self._layout_version, ids)
         wrote = False
         col = self.tensors.metric_index.get(metric)
         if col is not None and len(ids):
@@ -350,15 +367,14 @@ class NodeLoadStore:
             self._version += 1
             wrote = True
         if wrote:
-            version = self._version
-            self._row_versions.update((int(i), version) for i in ids)
+            self._row_versions[ids] = self._version
             if pre_layout == self._layout_version:
                 # log the column write for device-side replay (arrays are
                 # captured; callers build them fresh per call). A write
                 # that added nodes changed the layout — not replayable.
                 self._column_log.append((
                     pre_version,
-                    version,
+                    self._version,
                     col,
                     ids,
                     np.broadcast_to(np.asarray(values, np.float64), ids.shape).copy()

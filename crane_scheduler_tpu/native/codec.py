@@ -66,3 +66,70 @@ def bulk_parse_annotations(raw_strings) -> tuple[np.ndarray, np.ndarray]:
     # mirror decode_annotation: value NaN with valid ts is allowed ("NaN"),
     # but unparseable value strings already got ts=-inf from the C side.
     return values, ts
+
+
+def bulk_parse_values(strings) -> tuple[np.ndarray, np.ndarray] | None:
+    """Parse bare metric-value strings with Go ParseFloat semantics in
+    one C call: ``(values[n] float64, ok[n] bool)``; unparseable entries
+    are (NaN, False). Returns None when the native library is
+    unavailable (callers fall back to the per-string Python parse)."""
+    lib = load_native()
+    if lib is None:
+        return None
+    n = len(strings)
+    values = np.empty((n,), dtype=np.float64)
+    ok = np.empty((n,), dtype=np.uint8)
+    if n == 0:
+        return values, ok.astype(bool)
+    # fast path: one join + one encode. Valid only when every string is
+    # ASCII (char offsets == byte offsets) — metric samples always are;
+    # a length mismatch detects any non-ASCII batch exactly.
+    joined = "".join(strings)
+    buffer = joined.encode("utf-8", "replace")
+    offsets = np.zeros((n + 1,), dtype=np.int64)
+    if len(buffer) == len(joined):
+        np.cumsum([len(s) for s in strings], out=offsets[1:])
+    else:
+        encoded = [s.encode("utf-8", "replace") for s in strings]
+        np.cumsum([len(b) for b in encoded], out=offsets[1:])
+        buffer = b"".join(encoded)
+    lib.crane_parse_values(
+        buffer,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return values, ok.astype(bool)
+
+
+def bulk_render_f5(vals: np.ndarray) -> list[str] | None:
+    """Render a float column with the Prometheus 5-decimal contract
+    (``format_metric_value``) in one C call; returns the string list, or
+    None when the native library is unavailable. Callers apply the
+    negative/NaN clamp first when modeling ``_render``."""
+    lib = load_native()
+    if lib is None:
+        return None
+    vals = np.ascontiguousarray(vals, dtype=np.float64)
+    n = len(vals)
+    buf = ctypes.create_string_buffer(n * 32)
+    offsets = np.empty((n + 1,), dtype=np.int64)
+    lib.crane_render_f5(
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        n,
+        buf,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    text = buf.raw[: offsets[n]].decode("ascii")
+    off = offsets.tolist()
+    out = [text[off[i]:off[i + 1]] for i in range(n)]
+    if "" in out:
+        # oversize entries (>31 chars, |v| >= ~1e25) come back empty —
+        # re-render those rows exactly in Python
+        from ..loadstore.codec import format_metric_value
+
+        for i, s in enumerate(out):
+            if not s:
+                out[i] = format_metric_value(float(vals[i]))
+    return out

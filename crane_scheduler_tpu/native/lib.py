@@ -44,6 +44,11 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.crane_parse_annotations.argtypes = [
         ctypes.c_char_p, p_i64, i64, i64, p_f64, p_f64,
     ]
+    lib.crane_parse_values.argtypes = [
+        ctypes.c_char_p, p_i64, i64, p_f64,
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.crane_render_f5.argtypes = [p_f64, i64, ctypes.c_char_p, p_i64]
     return lib
 
 

@@ -80,6 +80,7 @@ class Simulator:
         # for |nodes| x |metrics| streams; one count_pods_all per cluster
         # mutation generation replaces that many per-node lock hits
         self._counts_cache: tuple[int, dict[str, int]] | None = None
+        self._counts_vec_cache: tuple | None = None
 
         metric_names = {sp.name for sp in policy.spec.sync_period}
         self._pairs: list[tuple[str, str]] = []  # (name, ip), node order
@@ -100,6 +101,7 @@ class Simulator:
                 base = cpu_base if m.startswith("cpu") else mem_base
                 self._base[(name, m)] = base
                 self.metrics.set(m, ip, self._stream(name, m), by="ip")
+        self._ips = [ip for _, ip in self._pairs]
         for m in metric_names:
             # bulk sweeps read the whole column in one call instead of
             # |nodes| per-instance closures
@@ -131,26 +133,46 @@ class Simulator:
 
         return current
 
+    def _counts_vector(self):
+        """Bound-pod counts aligned with ``self._pairs`` (cached on the
+        cluster's mutation generation alongside ``_bound_counts``)."""
+        import numpy as np
+
+        version = self.cluster.sched_version
+        cache = self._counts_vec_cache
+        if cache is None or cache[0] != version:
+            counts = self._bound_counts()
+            get = counts.get
+            vec = np.fromiter(
+                (get(name, 0) for name, _ in self._pairs),
+                dtype=np.float64,
+                count=len(self._pairs),
+            )
+            cache = (version, vec)
+            self._counts_vec_cache = cache
+        return cache[1]
+
     def _column(self, metric: str):
-        """Whole-column load stream: one pass over all nodes, rendered
-        with the Prometheus contract (values are clamped to [0, 1] by the
-        load model, so the >= 0 clamp is inherent; 5-decimal fixed
-        rendering matches ``format_metric_value``)."""
+        """Whole-column load stream, vectorized: numpy load model + one
+        native render call (Prometheus contract — values clamp to [0, 1]
+        like ``_render``/``_stream``, 5-decimal fixed rendering matches
+        ``format_metric_value``)."""
+        import numpy as np
+
+        from ..loadstore.codec import format_metric_value
+        from ..native.codec import bulk_render_f5
+
+        base_vec = np.asarray(
+            [self._base[(name, metric)] for name, _ in self._pairs]
+        )
 
         def column() -> dict[str, str]:
-            counts = self._bound_counts()
-            base = self._base
-            per_pod = self.config.per_pod_load
-            counts_get = counts.get
-            out = {}
-            for name, ip in self._pairs:
-                load = base[(name, metric)] + per_pod * counts_get(name, 0)
-                if load > 1.0:
-                    load = 1.0
-                elif load < 0.0:  # same clamp as _render/_stream
-                    load = 0.0
-                out[ip] = f"{load:.5f}"
-            return out
+            loads = base_vec + self.config.per_pod_load * self._counts_vector()
+            np.clip(loads, 0.0, 1.0, out=loads)
+            rendered = bulk_render_f5(loads)
+            if rendered is None:  # no native lib: per-item fallback
+                rendered = [format_metric_value(v) for v in loads]
+            return dict(zip(self._ips, rendered))
 
         return column
 

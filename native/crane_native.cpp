@@ -20,6 +20,7 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <cstdio>
 #include <vector>
 
 extern "C" {
@@ -178,6 +179,37 @@ static int64_t parse_local_timestamp(const char* s, int64_t len,
   return days * 86400 + hour * 3600 + minute * 60 + second - utc_offset_seconds;
 }
 
+// strconv.ParseFloat(s, 64) equivalent over a non-terminated slice:
+// strtod accepts a superset of Go (hex floats, inf/nan); reject leading
+// whitespace, trailing garbage, and misplaced grouping underscores.
+static bool parse_go_float(const char* start, int64_t vlen, double* out) {
+  if (vlen == 0 || start[0] == ' ' || start[0] == '\t') return false;
+  char tmp[64];
+  if (vlen >= static_cast<int64_t>(sizeof(tmp))) return false;
+  std::memcpy(tmp, start, static_cast<size_t>(vlen));
+  tmp[vlen] = '\0';
+  // Go rejects underscores except between digits; strtod treats them as
+  // terminators. Strip valid grouping underscores first.
+  char cleaned[64];
+  int64_t ci = 0;
+  for (int64_t j = 0; j < vlen; ++j) {
+    if (tmp[j] == '_') {
+      const bool prev_digit = j > 0 && tmp[j - 1] >= '0' && tmp[j - 1] <= '9';
+      const bool next_digit =
+          j + 1 < vlen && tmp[j + 1] >= '0' && tmp[j + 1] <= '9';
+      if (!prev_digit || !next_digit) return false;
+      continue;  // drop grouping underscore
+    }
+    cleaned[ci++] = tmp[j];
+  }
+  cleaned[ci] = '\0';
+  char* end = nullptr;
+  const double v = std::strtod(cleaned, &end);
+  if (end == cleaned || (end != nullptr && *end != '\0')) return false;
+  *out = v;
+  return true;
+}
+
 // Parse n annotation strings packed into one buffer with offsets
 // (offsets[i]..offsets[i+1] delimit string i). Outputs per entry:
 //   values[i] = parsed float (NaN when the value part is invalid/missing)
@@ -210,47 +242,72 @@ void crane_parse_annotations(const char* buffer, const int64_t* offsets,
     // value part: strtod accepts a superset of Go (hex floats, inf/nan);
     // reject trailing garbage and leading whitespace to match ParseFloat.
     const int64_t vlen = comma - start;
-    if (vlen == 0 || start[0] == ' ' || start[0] == '\t') {
+    double v;
+    if (!parse_go_float(start, vlen, &v)) {
       ts[i] = neg_inf;  // unparseable value == structurally invalid
       continue;
     }
-    char tmp[64];
-    if (vlen >= static_cast<int64_t>(sizeof(tmp))) {
-      ts[i] = neg_inf;
-      continue;
-    }
-    std::memcpy(tmp, start, static_cast<size_t>(vlen));
-    tmp[vlen] = '\0';
-    // Go rejects underscores except between digits; strtod ignores them as
-    // terminators. Strip valid grouping underscores first.
-    char cleaned[64];
-    int64_t ci = 0;
-    bool bad_underscore = false;
-    for (int64_t j = 0; j < vlen; ++j) {
-      if (tmp[j] == '_') {
-        const bool prev_digit = j > 0 && tmp[j - 1] >= '0' && tmp[j - 1] <= '9';
-        const bool next_digit =
-            j + 1 < vlen && tmp[j + 1] >= '0' && tmp[j + 1] <= '9';
-        if (!prev_digit || !next_digit) {
-          bad_underscore = true;
-          break;
-        }
-        continue;  // drop grouping underscore
-      }
-      cleaned[ci++] = tmp[j];
-    }
-    if (bad_underscore) {
-      ts[i] = neg_inf;
-      continue;
-    }
-    cleaned[ci] = '\0';
-    char* end = nullptr;
-    const double v = std::strtod(cleaned, &end);
-    if (end == cleaned || (end != nullptr && *end != '\0')) {
-      ts[i] = neg_inf;
-      continue;
-    }
     values[i] = v;
+  }
+}
+
+// Parse n bare value strings (metric samples) with Go ParseFloat
+// semantics: values[i] = parsed float, ok[i] = 1 on success, else
+// (NaN, 0). One C call replaces a per-string Python parse in the
+// annotator's bulk sweep (|nodes| x |metrics| strings per cycle).
+void crane_parse_values(const char* buffer, const int64_t* offsets, int64_t n,
+                        double* values, uint8_t* ok) {
+  const double nan = 0.0 / 0.0;
+  for (int64_t i = 0; i < n; ++i) {
+    const char* start = buffer + offsets[i];
+    const int64_t len = offsets[i + 1] - offsets[i];
+    double v;
+    if (parse_go_float(start, len, &v)) {
+      values[i] = v;
+      ok[i] = 1;
+    } else {
+      values[i] = nan;
+      ok[i] = 0;
+    }
+  }
+}
+
+// Render n doubles with the Prometheus client's 5-decimal fixed
+// contract (ref: prometheus.go:124 FormatFloat(v, 'f', 5, 64); negative
+// and NaN clamp to 0 is the CALLER's job when modeling _render).
+// out buffer must hold >= n * 32 bytes; offsets[n+1] delimit entries.
+void crane_render_f5(const double* vals, int64_t n, char* out,
+                     int64_t* offsets) {
+  int64_t pos = 0;
+  offsets[0] = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const double v = vals[i];
+    int wrote;
+    if (v != v) {
+      std::memcpy(out + pos, "NaN", 3);
+      wrote = 3;
+    } else if (v > 1.7976931348623157e308) {
+      std::memcpy(out + pos, "+Inf", 4);
+      wrote = 4;
+    } else if (v < -1.7976931348623157e308) {
+      std::memcpy(out + pos, "-Inf", 4);
+      wrote = 4;
+    } else {
+      // render to a scratch sized for the %.5f worst case (~317 chars
+      // for DBL_MAX); entries that exceed the caller's 32-byte budget
+      // are emitted EMPTY (offsets[i] == offsets[i+1]) — "%.5f" never
+      // legitimately renders "" — so the caller can re-render those
+      // few rows itself instead of this function corrupting the heap.
+      char scratch[352];
+      wrote = std::snprintf(scratch, sizeof(scratch), "%.5f", v);
+      if (wrote < 0 || wrote > 31) {
+        wrote = 0;
+      } else {
+        std::memcpy(out + pos, scratch, static_cast<size_t>(wrote));
+      }
+    }
+    pos += wrote;
+    offsets[i + 1] = pos;
   }
 }
 

@@ -146,3 +146,49 @@ def test_annotator_uses_native_bindings_by_default():
         AnnotatorConfig(use_native_bindings=False),
     )
     assert isinstance(ann_py.binding_records, BindingRecords)
+
+
+def test_bulk_render_f5_matches_python_and_handles_oversize():
+    """Native 5-decimal render is bit-identical to format_metric_value,
+    including values whose rendering exceeds the 32-byte/entry budget
+    (review finding: these corrupted the heap before the fallback)."""
+    import numpy as np
+
+    from crane_scheduler_tpu.loadstore.codec import format_metric_value
+    from crane_scheduler_tpu.native.codec import bulk_render_f5
+
+    rng = np.random.default_rng(3)
+    vals = np.concatenate([
+        rng.uniform(0, 1, 5000),
+        [0.0, 1.0, 0.125, 2.5e-6, 1e30, 1.7e308,
+         float("nan"), float("inf"), float("-inf")],
+    ])
+    got = bulk_render_f5(vals)
+    if got is None:
+        import pytest
+
+        pytest.skip("native library unavailable")
+    assert got == [format_metric_value(float(v)) for v in vals]
+
+
+def test_bulk_parse_values_matches_go_parse_float():
+    import numpy as np
+
+    from crane_scheduler_tpu.loadstore.codec import go_parse_float
+    from crane_scheduler_tpu.native.codec import bulk_parse_values
+
+    cases = ["0.30000", "1e3", "NaN", "abc", "-0.5", "0x1p3", "1_0",
+             "_1", " 1", "12.", ".5", "", "inf", "Infinity", "1..2"]
+    parsed = bulk_parse_values(cases)
+    if parsed is None:
+        import pytest
+
+        pytest.skip("native library unavailable")
+    values, ok = parsed
+    for s, v, o in zip(cases, values, ok):
+        want = go_parse_float(s)
+        assert o == (want is not None), s
+        if want is not None and want == want:
+            assert v == want, s
+        elif want is not None:
+            assert v != v, s  # NaN
