@@ -1,10 +1,13 @@
-"""The five BASELINE.md benchmark configs, end to end.
+"""The five BASELINE.md benchmark configs plus two beyond-BASELINE
+full-loop configs, end to end.
 
   1. single cpu-stress pod, 3-node sim cluster, default policy
   2. 1k pods / 1k nodes, cpu+mem avg_5m priority weights only
   3. 10k pods / 10k nodes, full predicate+priority+hotValue policy
   4. 50k nodes with 12 syncPolicy metrics, streaming annotation refresh
   5. 100k-pod burst gang-schedule, mesh-sharded across all devices
+  6. full loop (columnar burst) at 10k AND 50k nodes, parity-gated
+  7. kube-boundary loop through a stub apiserver (mirror + patch storm)
 
 Each config reports a JSON line to stdout with wall-clock timings.
 Configs 1-3 run the full loop (annotator sync through real annotation
@@ -12,7 +15,7 @@ strings -> bulk ingest -> score -> assign -> bind). Config 4 measures the
 streaming refresh path (string parse + H2D) separately from the scoring
 step. Config 5 is the headline (same as bench.py).
 
-Usage: python bench_suite.py [--device cpu|default] [--configs 1,2,3,4,5]
+Usage: python bench_suite.py [--device cpu|default] [--configs 1,...,7]
 """
 
 from __future__ import annotations
@@ -363,10 +366,107 @@ def config6(dtype, rtt, node_scales=(10_000, 50_000)):
               "flush_ms_per_cycle": round(phase["flush"] / cycles * 1e3, 1)})
 
 
+def config7(dtype, rtt):
+    """Kube-boundary full loop: everything crosses a real HTTP apiserver
+    (the stub from tests/kube_stub.py). Reports the mirror costs the
+    reference pays through client-go — paginated list bootstrap,
+    rv-resumed reconnect (O(delta), no relist) — and a full cycle where
+    the annotator's sweep lands as per-node merge-PATCHes (the
+    reference's 2x|nodes|x|syncPolicy| patch storm collapses to one
+    PATCH per node per sweep via the bulk patch path) and every bind
+    POSTs the binding subresource. Numbers are bound by the
+    single-process Python stub, not the framework — the split is what
+    matters (ref: node.go:123-146, factory.go:16-33)."""
+    import importlib.util
+    import os
+
+    from crane_scheduler_tpu.annotator import AnnotatorConfig, NodeAnnotator
+    from crane_scheduler_tpu.cluster import Pod
+    from crane_scheduler_tpu.cluster.kube import KubeClusterClient
+    from crane_scheduler_tpu.framework.scheduler import BatchScheduler
+    from crane_scheduler_tpu.metrics import FakeMetricsSource
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+
+    stub_path = os.path.join(os.path.dirname(__file__), "tests", "kube_stub.py")
+    spec = importlib.util.spec_from_file_location("kube_stub", stub_path)
+    kube_stub = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(kube_stub)
+
+    n_nodes, pods_per_cycle, cycles = 5000, 500, 3
+    server = kube_stub.KubeStubServer().start()
+    try:
+        for i in range(n_nodes):
+            server.state.add_node(f"node-{i:05d}", f"10.{(i >> 16) & 255}.{(i >> 8) & 255}.{i & 255}")
+        client = KubeClusterClient(server.url)
+        t0 = time.perf_counter()
+        client.start()
+        bootstrap_ms = (time.perf_counter() - t0) * 1e3
+        relists_initial = client.relists
+
+        # rv-resumed reconnect cost: one delta, no relist
+        server.state.close_watches()
+        server.state.add_node("node-extra", "10.9.9.9")
+        t0 = time.perf_counter()
+        while client.get_node("node-extra") is None:
+            time.sleep(0.005)
+        reconnect_ms = (time.perf_counter() - t0) * 1e3
+
+        fake = FakeMetricsSource()
+        metric_names = [sp.name for sp in DEFAULT_POLICY.spec.sync_period]
+        for i in range(n_nodes):
+            ip = f"10.{(i >> 16) & 255}.{(i >> 8) & 255}.{i & 255}"
+            for m in metric_names:
+                fake.set(m, ip, 0.1 + 0.8 * (i % 97) / 97, by="ip")
+        ann = NodeAnnotator(client, fake, DEFAULT_POLICY,
+                            AnnotatorConfig(bulk_sync=True, direct_store=True))
+        ann.event_ingestor.start()
+        batch = BatchScheduler(client, DEFAULT_POLICY, dtype=dtype,
+                               snapshot_bucket=8192, refresh_from_cluster=False)
+        ann.attach_store(batch.store)
+        ann.sync_all_once_bulk()
+
+        patches_before = sum(1 for m, p in server.state.requests if m == "PATCH")
+        t0 = time.perf_counter()
+        ann.flush_annotations()  # one merge-PATCH per node
+        patch_s = time.perf_counter() - t0
+        patches = sum(1 for m, p in server.state.requests if m == "PATCH") - patches_before
+
+        seq = [0]
+        t0 = time.perf_counter()
+        assigned = 0
+        for _ in range(cycles):
+            ann.sync_all_once_bulk()
+            ann.flush_annotations()
+            names = [f"kube-{seq[0] * pods_per_cycle + i}" for i in range(pods_per_cycle)]
+            seq[0] += 1
+            pods = [Pod(name=n, namespace="bench") for n in names]
+            for pod in pods:
+                client.add_pod(pod)  # POST /pods (arrival through the API)
+            result = batch.schedule_batch(pods, bind=True)  # binding POSTs
+            assigned += len(result.assignments)
+        wall = time.perf_counter() - t0
+        client.stop()
+        emit({"config": 7,
+              "desc": "kube-boundary loop via stub apiserver "
+                      f"({n_nodes}-node mirror; {pods_per_cycle} pods/cycle "
+                      "through binding subresource)",
+              "mirror_bootstrap_ms": round(bootstrap_ms, 1),
+              "reconnect_delta_ms": round(reconnect_ms, 1),
+              "relists_after_reconnect": client.relists - relists_initial,
+              "annotation_patches_per_flush": patches,
+              "patches_per_sec": round(patches / patch_s) if patch_s else None,
+              "cycles": cycles,
+              "assigned": assigned,
+              "pods_per_sec_through_api": round(assigned / wall),
+              "note": "stub-apiserver-bound; framework split is the metric"})
+    finally:
+        server.stop()
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--device", choices=["cpu", "default"], default="default")
-    parser.add_argument("--configs", default="1,2,3,4,5,6")
+    parser.add_argument("--configs", default="1,2,3,4,5,6,7")
     parser.add_argument("--f64", action="store_true")
     args = parser.parse_args(argv)
 
@@ -393,6 +493,8 @@ def main(argv=None) -> int:
         config5(dtype, rtt)
     if 6 in todo:
         config6(dtype, rtt)
+    if 7 in todo:
+        config7(dtype, rtt)
     return 0
 
 

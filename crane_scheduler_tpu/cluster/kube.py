@@ -194,6 +194,8 @@ class KubeClusterClient:
         token: str | None = None,
         context: ssl.SSLContext | None = None,
         timeout: float = DEFAULT_TIMEOUT_SECONDS,
+        seen_events_cap: int = 65536,
+        list_page_limit: int = 500,
     ):
         self.base_url = base_url.rstrip("/")
         self._token = token
@@ -209,11 +211,24 @@ class KubeClusterClient:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self.watch_errors = 0
-        # bounded identity memory so a reconnected event watch replaying
-        # its backlog cannot double-count Scheduled events (hot values
-        # would inflate otherwise); keyed on apiserver-side identity
+        self.relists = 0  # full LISTs triggered by watch (re)connects
+        # reflector state: last-seen resourceVersion per resource (set by
+        # lists, advanced by watch deliveries incl. bookmarks); None =
+        # must relist before watching (client-go's reflector contract,
+        # which the reference gets from its informers — factory.go:16-33)
+        self._rvs: dict[str, str | None] = {}
+        self._list_page_limit = int(list_page_limit)
+        # bounded identity memory so an event watch replaying a backlog
+        # (e.g. after a 410 relist, where no rv continuation exists)
+        # cannot double-count Scheduled events (hot values would inflate
+        # otherwise); keyed on the apiserver resourceVersion when present
         self._seen_events: dict[tuple, None] = {}
-        self._seen_events_cap = 8192
+        self._seen_events_cap = int(seen_events_cap)
+        # rv watermark: a watch stream delivers events in resourceVersion
+        # order, so any event at or below the highest rv already applied
+        # is a replayed duplicate — exact dedup in O(1) memory, immune to
+        # backlogs larger than the content-key cap
+        self._event_rv_watermark = 0
         self._seen_lock = threading.Lock()
 
     # -- HTTP plumbing -----------------------------------------------------
@@ -247,6 +262,27 @@ class KubeClusterClient:
 
     # -- lifecycle ---------------------------------------------------------
 
+    def _list_all(self, path: str) -> tuple[list[dict], str | None]:
+        """Paginated LIST (``limit``/``continue``, like client-go's
+        paginated initial lists): returns every item plus the list's
+        resourceVersion — one bounded page per response instead of a
+        single O(cluster) JSON decode."""
+        items: list[dict] = []
+        sep = "&" if "?" in path else "?"
+        token = None
+        rv = None
+        while True:
+            url = f"{path}{sep}limit={self._list_page_limit}"
+            if token:
+                url += f"&continue={token}"
+            payload = self._get_json(url)
+            items.extend(payload.get("items", []))
+            meta = payload.get("metadata", {})
+            rv = meta.get("resourceVersion", rv)
+            token = meta.get("continue")
+            if not token:
+                return items, rv
+
     def _relist_nodes(self) -> None:
         """Resync nodes into the mirror (informer relist): adds/updates
         everything listed and prunes what disappeared, so deltas missed
@@ -255,53 +291,92 @@ class KubeClusterClient:
         thread calls this while ITS stream is down, so no concurrent
         node delivery can race the prune; other resources are never
         touched from here."""
-        nodes = [node_from_json(i) for i in self._get_json("/api/v1/nodes").get("items", [])]
+        self.relists += 1
+        raw, rv = self._list_all("/api/v1/nodes")
+        nodes = [node_from_json(i) for i in raw]
         for node in nodes:
             self._mirror.add_node(node)
         live = {n.name for n in nodes}
         for name in [n.name for n in self._mirror.list_nodes()]:
             if name not in live:
                 self._mirror.delete_node(name)
+        self._rvs["nodes"] = rv
 
     def _relist_pods(self) -> None:
         """Pod twin of ``_relist_nodes`` (called only by the pod watch
         thread while its own stream is down)."""
-        pods = [pod_from_json(i) for i in self._get_json("/api/v1/pods").get("items", [])]
+        self.relists += 1
+        raw, rv = self._list_all("/api/v1/pods")
+        pods = [pod_from_json(i) for i in raw]
         for pod in pods:
             self._mirror.add_pod(pod)
         live = {p.key() for p in pods}
         for key in [p.key() for p in self._mirror.list_pods()]:
             if key not in live:
                 self._mirror.delete_pod(key)
+        self._rvs["pods"] = rv
+
+    def _relist_events(self) -> None:
+        """Event twin: the reference's event informer also list+watches
+        (factory.go:25-33), so Scheduled events emitted while the watch
+        was down (or before start) are recovered by a list instead of
+        silently undercounting hot values. Entries sorted by rv before
+        ingestion — the dedup watermark assumes monotonic delivery, and a
+        list's iteration order is not rv order."""
+        self.relists += 1
+        raw, rv = self._list_all(
+            "/api/v1/events?fieldSelector=reason%3DScheduled%2Ctype%3DNormal"
+        )
+
+        def rv_of(obj) -> int:
+            try:
+                return int(obj.get("metadata", {}).get("resourceVersion", 0))
+            except (TypeError, ValueError):
+                return 0
+
+        for obj in sorted(raw, key=rv_of):
+            self._apply_event("ADDED", obj)
+        self._rvs["events"] = rv
 
     def _relist_nrt(self) -> None:
         """NRT CRD twin of ``_relist_nodes`` (NRT watch thread only)."""
-        items = [
-            nrt_from_json(i)
-            for i in self._get_json(NRT_API_PATH).get("items", [])
-        ]
+        self.relists += 1
+        raw, rv = self._list_all(NRT_API_PATH)
+        items = [nrt_from_json(i) for i in raw]
         for nrt in items:
             self.nrt_lister.upsert(nrt)
         live = {nrt.name for nrt in items}
         for name in [n for n in self.nrt_lister.names() if n not in live]:
             self.nrt_lister.delete(name)
+        self._rvs["nrts"] = rv
 
     def start(self) -> None:
         """Initial list of nodes + pods (+ NRT CRs when the CRD is
         installed), then watch threads for each resource plus Scheduled
-        events (server-side filtered). Events need no relist: missed
-        Scheduled events age out of the hot-value windows by design (the
-        reference's informer replay has the same bound)."""
+        events (server-side filtered; its list+watch recovers events
+        missed while disconnected, like the reference's event informer —
+        factory.go:25-33)."""
         self._relist_nodes()
         self._relist_pods()
         watches = [
-            ("/api/v1/nodes?watch=1", self._apply_node, self._relist_nodes),
-            ("/api/v1/pods?watch=1", self._apply_pod, self._relist_pods),
+            (
+                "/api/v1/nodes?watch=1",
+                self._apply_node,
+                self._relist_nodes,
+                "nodes",
+            ),
+            (
+                "/api/v1/pods?watch=1",
+                self._apply_pod,
+                self._relist_pods,
+                "pods",
+            ),
             (
                 "/api/v1/events?watch=1&fieldSelector="
                 "reason%3DScheduled%2Ctype%3DNormal",
                 self._apply_event,
-                None,
+                self._relist_events,
+                "events",
             ),
         ]
         crd_absent = False
@@ -322,12 +397,19 @@ class KubeClusterClient:
             t = threading.Thread(target=self._nrt_prober, daemon=True)
         else:
             watches.append(
-                (f"{NRT_API_PATH}?watch=1", self._apply_nrt, self._relist_nrt)
+                (
+                    f"{NRT_API_PATH}?watch=1",
+                    self._apply_nrt,
+                    self._relist_nrt,
+                    "nrts",
+                )
             )
             t = None
-        for path, apply, relist in watches:
+        for path, apply, relist, rv_key in watches:
             wt = threading.Thread(
-                target=self._watch_loop, args=(path, apply, relist), daemon=True
+                target=self._watch_loop,
+                args=(path, apply, relist, rv_key),
+                daemon=True,
             )
             wt.start()
             self._threads.append(wt)
@@ -351,7 +433,10 @@ class KubeClusterClient:
                 continue
             self._nrt_available = True
             self._watch_loop(
-                f"{NRT_API_PATH}?watch=1", self._apply_nrt, self._relist_nrt
+                f"{NRT_API_PATH}?watch=1",
+                self._apply_nrt,
+                self._relist_nrt,
+                "nrts",
             )
             return
 
@@ -369,21 +454,33 @@ class KubeClusterClient:
         path: str,
         apply: Callable[[str, dict], None],
         relist: Callable[[], None] | None,
+        rv_key: str,
     ) -> None:
+        """Reflector semantics (client-go's contract, which the reference
+        inherits from its informers — ref: factory.go:16-33): list once,
+        then watch from the list's resourceVersion with bookmarks;
+        reconnects resume from the last delivered rv (no relist); only a
+        410 Gone (resume point expired server-side) forces one relist."""
+        import time as _time
+
         failures = 0
+        delivered = False  # anything (incl. bookmarks) on the last stream
         while not self._stop.is_set():
+            delivered = False
+            connected_at = _time.monotonic()
             try:
+                if relist is not None and self._rvs.get(rv_key) is None:
+                    # first connect or post-410: one full (paginated)
+                    # list establishes the resume point; everything after
+                    # it arrives through the watch replay
+                    relist()
+                rv = self._rvs.get(rv_key)
+                url = path + "&allowWatchBookmarks=true"
+                if rv is not None:
+                    url += f"&resourceVersion={rv}"
                 with self._request(
-                    "GET", path, timeout=WATCH_TIMEOUT_SECONDS
+                    "GET", url, timeout=WATCH_TIMEOUT_SECONDS
                 ) as resp:
-                    # relist AFTER the watch stream is established (the
-                    # server registered this watcher before sending
-                    # headers): any delta between a previous list and
-                    # this connection — including the start() bootstrap
-                    # gap and everything missed while disconnected — is
-                    # reconciled, and nothing after it can be missed.
-                    if relist is not None:
-                        relist()
                     for line in resp:
                         if self._stop.is_set():
                             return
@@ -391,7 +488,22 @@ class KubeClusterClient:
                         if not line:
                             continue
                         change = json.loads(line)
-                        apply(change.get("type", ""), change.get("object", {}))
+                        change_type = change.get("type", "")
+                        obj = change.get("object", {})
+                        if change_type == "ERROR":
+                            if obj.get("code") == 410:
+                                # resume window expired: relist once
+                                self._rvs[rv_key] = None
+                            else:
+                                self.watch_errors += 1
+                                failures += 1
+                            break
+                        obj_rv = obj.get("metadata", {}).get("resourceVersion")
+                        if change_type != "BOOKMARK":
+                            apply(change_type, obj)
+                        if obj_rv is not None:
+                            self._rvs[rv_key] = obj_rv
+                        delivered = True
                         # reset only on DELIVERED events, not on mere
                         # connection establishment: a flapping apiserver
                         # that accepts watches then fails the stream must
@@ -403,13 +515,25 @@ class KubeClusterClient:
                 # NOT a failure; escalating here would delay delivery of
                 # the next real event by up to the backoff cap
                 pass
+            except urllib.error.HTTPError as e:
+                if e.code == 410:
+                    self._rvs[rv_key] = None  # relist on reconnect
+                else:
+                    self.watch_errors += 1
+                    failures += 1
             except (urllib.error.URLError, OSError, json.JSONDecodeError):
                 self.watch_errors += 1
                 failures += 1
-            # backoff on clean stream end too (a proxy that closes
-            # watches immediately must not induce a tight relist loop);
-            # exponential while the apiserver keeps failing, so an
-            # outage isn't hammered at 1 rps per watcher forever
+            # a healthy LONG-LIVED stream (delivered something, incl.
+            # bookmarks, and stayed up a while) reconnects immediately —
+            # an rv-resumed reconnect is cheap and waiting here delays
+            # the next delta. Short-lived streams back off exponentially
+            # even when they delivered (a server answering each watch
+            # with one bookmark then EOF must not drive a zero-delay
+            # reconnect hot loop), as does anything that failed.
+            lived = _time.monotonic() - connected_at
+            if delivered and failures == 0 and lived >= 1.0:
+                continue
             if self._stop.wait(timeout=min(30.0, 1.0 * (2 ** min(failures, 5)))):
                 return
 
@@ -438,23 +562,40 @@ class KubeClusterClient:
         if change_type == "DELETED":
             return
         event = event_from_json(obj)
-        # replayed backlogs after a reconnect must not double-count:
-        # dedup on apiserver-side identity (the mirror assigns its own
-        # resourceVersion, so that can't serve as the key)
-        key = (
-            event.namespace,
-            event.name,
-            event.count,
-            event.last_timestamp,
-            event.event_time,
-            event.message,
-        )
-        with self._seen_lock:
-            if key in self._seen_events:
-                return
-            if len(self._seen_events) >= self._seen_events_cap:
-                self._seen_events.pop(next(iter(self._seen_events)))
-            self._seen_events[key] = None
+        # replayed backlogs (a no-rv connect or post-410 restart) must
+        # not double-count. Primary dedup: the apiserver resourceVersion
+        # watermark — streams deliver in rv order, so rv <= watermark is
+        # a replay; exact in O(1) memory regardless of backlog size.
+        # Fallback for rv-less/non-integer-rv servers: bounded content
+        # identity (the mirror assigns its own resourceVersion, so that
+        # can't serve as a key).
+        server_rv = obj.get("metadata", {}).get("resourceVersion")
+        rv_int = None
+        if server_rv is not None:
+            try:
+                rv_int = int(server_rv)
+            except (TypeError, ValueError):
+                rv_int = None
+        if rv_int is not None:
+            with self._seen_lock:
+                if rv_int <= self._event_rv_watermark:
+                    return
+                self._event_rv_watermark = rv_int
+        else:
+            key = (
+                event.namespace,
+                event.name,
+                event.count,
+                event.last_timestamp,
+                event.event_time,
+                event.message,
+            )
+            with self._seen_lock:
+                if key in self._seen_events:
+                    return
+                if len(self._seen_events) >= self._seen_events_cap:
+                    self._seen_events.pop(next(iter(self._seen_events)))
+                self._seen_events[key] = None
         self._mirror.emit_event(event)
 
     # -- reads: the informer mirror ---------------------------------------

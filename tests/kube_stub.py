@@ -1,12 +1,15 @@
 """Stub kube-apiserver speaking the wire subset KubeClusterClient uses.
 
-In-memory nodes/pods/events behind the real HTTP endpoints: list,
-newline-delimited JSON watch streams (with fieldSelector filtering for
-events), strategic-merge annotation patches, pod create, and the
-``binding`` subresource — which, like the real apiserver, emits the
-``Scheduled`` event whose message the annotator parses. This is the
-test double standing where `gocrane`'s fake clientset stood in the
-reference's tests (ref: filter_test.go:366-367), but at the HTTP layer.
+In-memory nodes/pods/events behind the real HTTP endpoints: paginated
+lists (``limit``/``continue``) stamped with resourceVersions,
+newline-delimited JSON watch streams with ``resourceVersion=`` resume,
+watch bookmarks, 410 Gone for expired resume points (as an ERROR watch
+event, like the real apiserver), fieldSelector filtering for events,
+strategic-merge annotation patches, pod create, and the ``binding``
+subresource — which, like the real apiserver, emits the ``Scheduled``
+event whose message the annotator parses. This is the test double
+standing where `gocrane`'s fake clientset stood in the reference's tests
+(ref: filter_test.go:366-367), but at the HTTP layer.
 """
 
 from __future__ import annotations
@@ -14,10 +17,15 @@ from __future__ import annotations
 import json
 import queue
 import threading
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
 class KubeStubState:
+    # history entries older than this are compacted away; a watch resume
+    # from before the window gets 410 Gone like a real apiserver
+    HISTORY_CAP = 512
+
     def __init__(self):
         self.lock = threading.RLock()
         self.nodes: dict[str, dict] = {}
@@ -29,55 +37,83 @@ class KubeStubState:
         self.events: list[dict] = []
         self.watchers: list[tuple[str, queue.Queue]] = []  # (kind, q)
         self.requests: list[tuple[str, str]] = []  # (method, path) log
+        self._rv = 0  # global resourceVersion counter (like etcd's)
+        # bounded change history for watch resume: (rv, kind, type, obj);
+        # _evicted_rv = newest rv no longer replayable (resumes at or
+        # below it get 410 Gone)
+        self.history: deque[tuple[int, str, str, dict]] = deque(
+            maxlen=self.HISTORY_CAP
+        )
+        self._evicted_rv = 0
+        # pagination tokens -> (remaining items, snapshot rv)
+        self._continues: dict[str, tuple[list[dict], str]] = {}
+        self._continue_seq = 0
+
+    # -- mutations (each stamps a resourceVersion + history entry) ---------
+
+    def _stamp(self, obj: dict) -> dict:
+        self._rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+        return obj
+
+    @property
+    def resource_version(self) -> int:
+        with self.lock:
+            return self._rv
 
     def add_node(self, name: str, ip: str, annotations: dict | None = None):
         with self.lock:
-            self.nodes[name] = {
+            self.nodes[name] = self._stamp({
                 "metadata": {"name": name, "annotations": dict(annotations or {})},
                 "status": {"addresses": [{"type": "InternalIP", "address": ip}]},
-            }
+            })
             self._notify("nodes", "ADDED", self.nodes[name])
 
     def delete_node(self, name: str):
         with self.lock:
             obj = self.nodes.pop(name, None)
             if obj is not None:
+                self._stamp(obj)
                 self._notify("nodes", "DELETED", obj)
 
     def add_nrt(self, name: str, cpu_manager_policy: str = "Static",
                 topology_manager_policy: str = "None",
                 zones: list | None = None):
         with self.lock:
-            self.nrts[name] = {
+            self.nrts[name] = self._stamp({
                 "metadata": {"name": name},
                 "craneManagerPolicy": {
                     "cpuManagerPolicy": cpu_manager_policy,
                     "topologyManagerPolicy": topology_manager_policy,
                 },
                 "zones": list(zones or []),
-            }
+            })
             self._notify("nrts", "ADDED", self.nrts[name])
 
     def add_pod(self, namespace: str, name: str, spec: dict | None = None,
                 annotations: dict | None = None):
         with self.lock:
             key = f"{namespace}/{name}"
-            self.pods[key] = {
+            self.pods[key] = self._stamp({
                 "metadata": {
                     "name": name,
                     "namespace": namespace,
                     "annotations": dict(annotations or {}),
                 },
                 "spec": dict(spec or {}),
-            }
+            })
             self._notify("pods", "ADDED", self.pods[key])
 
     def emit_event(self, obj: dict):
         with self.lock:
+            self._stamp(obj)
             self.events.append(obj)
             self._notify("events", "ADDED", obj)
 
     def _notify(self, kind: str, change_type: str, obj: dict):
+        if len(self.history) == self.history.maxlen:
+            self._evicted_rv = self.history[0][0]
+        self.history.append((self._rv, kind, change_type, json.loads(json.dumps(obj))))
         for wkind, q in list(self.watchers):
             if wkind == kind:
                 q.put({"type": change_type, "object": obj})
@@ -87,6 +123,13 @@ class KubeStubState:
         with self.lock:
             for _, q in list(self.watchers):
                 q.put(None)
+
+    def compact_history(self):
+        """Drop the replay window (forces 410 on any rv-resumed watch)."""
+        with self.lock:
+            self.history.clear()
+            self._rv += 1  # resumes from the pre-compaction rv are stale
+            self._evicted_rv = self._rv
 
 
 def _make_handler(state: KubeStubState):
@@ -108,22 +151,88 @@ def _make_handler(state: KubeStubState):
             n = int(self.headers.get("Content-Length") or 0)
             return json.loads(self.rfile.read(n)) if n else {}
 
+        def _query(self) -> dict:
+            _, _, query = self.path.partition("?")
+            out = {}
+            for part in query.split("&"):
+                if part:
+                    k, _, v = part.partition("=")
+                    out[k] = v
+            return out
+
+        def _list(self, items: list[dict], snapshot_rv: str):
+            """Paginated list (limit/continue). Every page — including
+            continue pages — is stamped with the resourceVersion of the
+            snapshot the FIRST page was taken at, like a real apiserver's
+            consistent list: a watch resumed from it replays every change
+            after the snapshot, pagination races included."""
+            q = self._query()
+            token = q.get("continue")
+            with state.lock:
+                rv = snapshot_rv
+                if token:
+                    pending_entry = state._continues.pop(token, None)
+                    if pending_entry is None:
+                        return self._json(
+                            410, {"kind": "Status", "code": 410,
+                                  "message": "continue token expired"}
+                        )
+                    pending, rv = pending_entry
+                else:
+                    pending = list(items)
+                limit = int(q.get("limit") or 0)
+                payload = {"metadata": {"resourceVersion": rv}, "items": pending}
+                if limit and len(pending) > limit:
+                    state._continue_seq += 1
+                    token = f"c{state._continue_seq}"
+                    state._continues[token] = (pending[limit:], rv)
+                    payload = {
+                        "metadata": {"resourceVersion": rv, "continue": token},
+                        "items": pending[:limit],
+                    }
+            return self._json(200, payload)
+
         def _watch(self, kind: str, event_filter=None):
+            q_params = self._query()
+            since = q_params.get("resourceVersion")
+            bookmarks = q_params.get("allowWatchBookmarks") == "true"
             q: queue.Queue = queue.Queue()
             with state.lock:
-                state.watchers.append((kind, q))
                 backlog = []
-                if kind == "events":
-                    backlog = [
-                        {"type": "ADDED", "object": o} for o in state.events
-                    ]
+                if since is not None and since != "":
+                    since_rv = int(since)
+                    if since_rv < state._evicted_rv:
+                        # resume point fell out of the replay window:
+                        # 410 Gone as an ERROR watch event, like the
+                        # real apiserver
+                        backlog = [{
+                            "type": "ERROR",
+                            "object": {
+                                "kind": "Status", "code": 410,
+                                "message": "too old resource version",
+                            },
+                        }]
+                    else:
+                        backlog = [
+                            {"type": t, "object": o}
+                            for rv, k, t, o in state.history
+                            if rv > since_rv and k == kind
+                        ]
+                # no resume point: like the real apiserver, the watch
+                # starts at the CURRENT state — the client is expected
+                # to list first
+                state.watchers.append((kind, q))
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
 
             def send(change):
-                if event_filter and not event_filter(change["object"]):
+                if (
+                    event_filter
+                    and change["type"] not in ("ERROR", "BOOKMARK")
+                    and not event_filter(change["object"])
+                ):
                     return
                 data = (json.dumps(change) + "\n").encode()
                 self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
@@ -132,10 +241,22 @@ def _make_handler(state: KubeStubState):
             try:
                 for change in backlog:
                     send(change)
+                    if change["type"] == "ERROR":
+                        return
                 while True:
                     try:
                         change = q.get(timeout=30.0)
                     except queue.Empty:
+                        if bookmarks:
+                            send({
+                                "type": "BOOKMARK",
+                                "object": {
+                                    "kind": kind,
+                                    "metadata": {
+                                        "resourceVersion": str(state._rv)
+                                    },
+                                },
+                            })
                         break
                     if change is None:  # close_watches sentinel
                         break
@@ -144,7 +265,8 @@ def _make_handler(state: KubeStubState):
                 pass
             finally:
                 with state.lock:
-                    state.watchers.remove((kind, q))
+                    if (kind, q) in state.watchers:
+                        state.watchers.remove((kind, q))
 
         def do_GET(self):
             state.requests.append(("GET", self.path))
@@ -154,19 +276,25 @@ def _make_handler(state: KubeStubState):
                 if watching:
                     return self._watch("nodes")
                 with state.lock:
-                    return self._json(200, {"items": list(state.nodes.values())})
+                    items = list(state.nodes.values())
+                    rv = str(state._rv)
+                return self._list(items, rv)
             if path == "/api/v1/pods":
                 if watching:
                     return self._watch("pods")
                 with state.lock:
-                    return self._json(200, {"items": list(state.pods.values())})
+                    items = list(state.pods.values())
+                    rv = str(state._rv)
+                return self._list(items, rv)
             if path == "/apis/topology.crane.io/v1alpha1/noderesourcetopologies":
                 if not state.serve_nrt:
                     return self._json(404, {"message": "CRD not installed"})
                 if watching:
                     return self._watch("nrts")
                 with state.lock:
-                    return self._json(200, {"items": list(state.nrts.values())})
+                    items = list(state.nrts.values())
+                    rv = str(state._rv)
+                return self._list(items, rv)
             if "/leases/" in path:
                 with state.lock:
                     key = "/".join(path.strip("/").split("/")[-3::2])
@@ -174,7 +302,7 @@ def _make_handler(state: KubeStubState):
                     if lease is None:
                         return self._json(404, {"message": "lease not found"})
                     return self._json(200, lease)
-            if path == "/api/v1/events" and watching:
+            if path == "/api/v1/events":
                 flt = None
                 if "fieldSelector=" in query:
                     def flt(obj):
@@ -182,7 +310,12 @@ def _make_handler(state: KubeStubState):
                             obj.get("reason") == "Scheduled"
                             and obj.get("type") == "Normal"
                         )
-                return self._watch("events", flt)
+                if watching:
+                    return self._watch("events", flt)
+                with state.lock:
+                    items = [o for o in state.events if flt is None or flt(o)]
+                    rv = str(state._rv)
+                return self._list(items, rv)
             return self._json(404, {"message": f"not found: {path}"})
 
         def do_PATCH(self):
@@ -210,6 +343,7 @@ def _make_handler(state: KubeStubState):
                     if node is None:
                         return self._json(404, {"message": "node not found"})
                     node["metadata"].setdefault("annotations", {}).update(annotations)
+                    state._stamp(node)
                     state._notify("nodes", "MODIFIED", node)
                     return self._json(200, node)
                 if "/pods/" in self.path:
@@ -218,6 +352,7 @@ def _make_handler(state: KubeStubState):
                     if pod is None:
                         return self._json(404, {"message": "pod not found"})
                     pod["metadata"].setdefault("annotations", {}).update(annotations)
+                    state._stamp(pod)
                     state._notify("pods", "MODIFIED", pod)
                     return self._json(200, pod)
             return self._json(404, {"message": "bad patch path"})
@@ -248,6 +383,7 @@ def _make_handler(state: KubeStubState):
                         return self._json(404, {"message": "pod not found"})
                     node_name = body.get("target", {}).get("name", "")
                     pod["spec"]["nodeName"] = node_name
+                    state._stamp(pod)
                     state._notify("pods", "MODIFIED", pod)
                     # the apiserver-side Scheduled event (ref: SURVEY §3.4)
                     state.emit_event({

@@ -298,11 +298,12 @@ def test_lease_leader_election_single_winner_and_failover(stub):
         e.stop()
 
 
-def test_watch_reconnect_relists_and_dedups_events(stub, client):
-    """A dropped watch must not lose deltas or double-count events: on
-    reconnect the client relists (a node deleted while disconnected
-    leaves the mirror) and replayed Scheduled-event backlogs dedup (hot
-    values must not inflate)."""
+def test_watch_reconnect_resumes_without_relist(stub, client):
+    """Reflector semantics: a dropped watch reconnects from its last
+    resourceVersion — deltas missed while disconnected arrive through
+    the server's watch replay, with NO relist and no double-counted
+    events (ref: the client-go informer machinery the reference leans
+    on, factory.go:16-33)."""
     from crane_scheduler_tpu.annotator.bindings import BindingRecords
     from crane_scheduler_tpu.annotator.events import EventIngestor
 
@@ -317,21 +318,82 @@ def test_watch_reconnect_relists_and_dedups_events(stub, client):
     assert _wait_until(
         lambda: records.get_last_node_binding_count("node-a", 600.0, NOW + 10) == 1
     )
+    relists_before = client.relists
 
     # drop every watch; delete a node while the client is disconnected
     stub.state.close_watches()
     stub.state.delete_node("node-b")
-    # reconnect relist prunes the dead node from the mirror
+    # the rv-resumed watch replays the missed DELETED — no relist
     assert _wait_until(lambda: client.get_node("node-b") is None, timeout=10.0)
-    # the replayed event backlog did not double-count the binding
+    # the resumed event watch did not double-count the binding
     time.sleep(0.3)  # allow any duplicate delivery to land
     assert records.get_last_node_binding_count("node-a", 600.0, NOW + 10) == 1
-    # the reconnect really relisted (>= 2 node LISTs: start + reconnect)
+    assert client.relists == relists_before
+
+
+def test_watch_410_relists_exactly_once(stub, client):
+    """A resume point that fell out of the server's replay window (410
+    Gone) forces ONE relist; the mirror converges on the post-compaction
+    state."""
+    stub.state.add_node("node-a", "10.0.0.1")
+    client.start()
+    assert _wait_until(lambda: client.get_node("node-a") is not None)
+    relists_before = client.relists
+
+    # disconnect, mutate, and expire the replay window
+    stub.state.close_watches()
+    stub.state.delete_node("node-a")
+    stub.state.add_node("node-c", "10.0.0.3")
+    stub.state.compact_history()
+
+    assert _wait_until(lambda: client.get_node("node-c") is not None, timeout=10.0)
+    assert _wait_until(lambda: client.get_node("node-a") is None, timeout=10.0)
+    # exactly one node relist recovered the gap (other watches may have
+    # relisted their own resource; count node LISTs)
+    assert _wait_until(lambda: client.relists > relists_before, timeout=10.0)
     node_lists = [
         p for m, p in stub.state.requests
-        if m == "GET" and p == "/api/v1/nodes"
+        if m == "GET" and p.startswith("/api/v1/nodes?") and "watch=1" not in p
     ]
-    assert len(node_lists) >= 2
+    # initial paginated list + exactly one post-410 relist
+    assert len(node_lists) == 2
+
+
+def test_idle_watch_expiry_does_not_relist(stub, client):
+    """A bookmark-terminated idle watch reconnects with its rv and never
+    relists (the round-2 design relisted on every idle expiry — an
+    O(cluster) decode per watcher per idle window at 50k nodes)."""
+    stub.state.add_node("node-a", "10.0.0.1")
+    client.start()
+    relists_before = client.relists
+    # simulate idle expiries: close the streams repeatedly with no
+    # intervening mutations; each reconnect resumes from the same rv
+    for _ in range(3):
+        stub.state.close_watches()
+        time.sleep(0.1)
+    time.sleep(1.2)  # allow reconnect cycles (1s backoff)
+    assert client.relists == relists_before
+    assert client.get_node("node-a") is not None
+
+
+def test_paginated_list_covers_all_items(stub):
+    """The initial list paginates (limit/continue) and still mirrors
+    every item."""
+    for i in range(23):
+        stub.state.add_node(f"node-{i:03d}", f"10.0.0.{i}")
+    client = KubeClusterClient(stub.url, list_page_limit=5)
+    try:
+        client.start()
+        assert len(client.list_nodes()) == 23
+        # the node list really paginated: >= ceil(23/5) LIST requests
+        node_lists = [
+            p for m, p in stub.state.requests
+            if m == "GET" and p.startswith("/api/v1/nodes?") and "watch=1" not in p
+        ]
+        assert len(node_lists) >= 5
+        assert any("continue=" in p for p in node_lists)
+    finally:
+        client.stop()
 
 
 def test_annotation_patch_true_despite_mirror_lag(stub, client):
@@ -345,3 +407,38 @@ def test_annotation_patch_true_despite_mirror_lag(stub, client):
     assert client.patch_node_annotation("node-a", "k", "v") is True
     assert stub.state.pods["default/p1"]["metadata"]["annotations"]["k"] == "v"
     assert stub.state.nodes["node-a"]["metadata"]["annotations"]["k"] == "v"
+
+
+def test_event_replay_larger_than_cap_does_not_double_count(stub):
+    """A full event-backlog replay (post-410, no rv continuation) larger
+    than the content-dedup cap must not inflate hot values — the rv
+    watermark dedups exactly regardless of backlog size (round-2 VERDICT
+    item: the fixed 8192 cap double-counted backlogs beyond it)."""
+    from crane_scheduler_tpu.annotator.bindings import BindingRecords
+    from crane_scheduler_tpu.annotator.events import EventIngestor
+
+    stub.state.add_node("node-a", "10.0.0.1")
+    n_events = 40
+    client = KubeClusterClient(stub.url, seen_events_cap=8)  # cap << backlog
+    try:
+        client.start()
+        records = BindingRecords(1024, 600.0)
+        EventIngestor(client, records).start()
+        for i in range(n_events):
+            stub.state.add_pod("default", f"p{i}")
+            client.bind_pod(f"default/p{i}", "node-a")
+        assert _wait_until(
+            lambda: records.get_last_node_binding_count(
+                "node-a", 600.0, NOW + 10
+            ) == n_events
+        )
+        # force a full replay: expire the resume window and reconnect
+        stub.state.compact_history()
+        stub.state.close_watches()
+        time.sleep(1.5)  # reconnect + replayed backlog delivery
+        assert (
+            records.get_last_node_binding_count("node-a", 600.0, NOW + 10)
+            == n_events
+        )
+    finally:
+        client.stop()
