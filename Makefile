@@ -1,9 +1,15 @@
 # crane-scheduler-tpu build/test entrypoints
-# (equivalent of the reference Makefile's scheduler/controller/test targets)
+# (equivalent of the reference Makefile's scheduler/controller/test/images
+# targets)
 
 PYTHON ?= python
+REGISTRY ?= crane-scheduler-tpu
+GIT_VERSION ?= $(shell git describe --tags --always --dirty 2>/dev/null || echo dev)
+IMAGE_ANNOTATOR := $(REGISTRY)/crane-annotator-tpu:$(GIT_VERSION)
+IMAGE_SCHEDULER := $(REGISTRY)/crane-scheduler-tpu:$(GIT_VERSION)
 
-.PHONY: all native test test-fast bench sim e2e clean
+.PHONY: all native test test-fast bench sim e2e clean \
+	images image-annotator image-scheduler push-images
 
 all: native test
 
@@ -24,6 +30,25 @@ sim:
 
 e2e:
 	$(PYTHON) examples/run_cpu_stress.py
+
+# -- images (one parameterized Dockerfile per binary, like the
+# reference's ARG PKGNAME build; ref: Makefile images target) ----------
+
+images: image-annotator image-scheduler
+
+image-annotator:
+	docker build \
+	  --build-arg ENTRYPOINT_MODULE=crane_scheduler_tpu.cli.annotator_main \
+	  -t $(IMAGE_ANNOTATOR) .
+
+image-scheduler:
+	docker build \
+	  --build-arg ENTRYPOINT_MODULE=crane_scheduler_tpu.cli.scheduler_main \
+	  -t $(IMAGE_SCHEDULER) .
+
+push-images: images
+	docker push $(IMAGE_ANNOTATOR)
+	docker push $(IMAGE_SCHEDULER)
 
 clean:
 	$(MAKE) -C native clean

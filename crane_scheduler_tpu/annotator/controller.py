@@ -80,6 +80,23 @@ def _meta_key(node_name: str, metric_name: str) -> str:
     return f"{node_name}/{metric_name}"
 
 
+def _index_samples_by_host(samples: dict) -> dict:
+    """Index metric samples by exact instance AND by host with the port
+    stripped (the reference matches ``instance=~"IP"`` then
+    ``instance=~"IP:.+"``, prometheus.go:50-67). Built only when some
+    instance actually carries a port; a bare-IP sample set (the common
+    case) is returned as-is, skipping a full-dict rebuild."""
+    if not any(":" in k for k in samples):
+        return samples
+    by_host: dict[str, str] = {}
+    for instance, value in samples.items():
+        by_host.setdefault(instance, value)
+        host = instance.rsplit(":", 1)[0]
+        if host != instance:
+            by_host.setdefault(host, value)
+    return by_host
+
+
 class NodeAnnotator:
     def __init__(
         self,
@@ -364,18 +381,7 @@ class NodeAnnotator:
             return 0
         import numpy as np
 
-        # index samples by exact instance and by host (port stripped) —
-        # needed only when instances carry ports; a bare-IP sample set
-        # (the common case) is used as-is, skipping a full-dict rebuild
-        if any(":" in k for k in samples):
-            by_host: dict[str, str] = {}
-            for instance, value in samples.items():
-                by_host.setdefault(instance, value)
-                host = instance.rsplit(":", 1)[0]
-                if host != instance:
-                    by_host.setdefault(host, value)
-        else:
-            by_host = samples
+        by_host = _index_samples_by_host(samples)
         direct = self._store is not None and self.config.direct_store
         if hot_by_node is self._HOT_UNSET:
             hot_by_node = self.hot_values_batch(now)
@@ -498,6 +504,64 @@ class NodeAnnotator:
             return
         self._store.prune_absent(self.cluster.node_names())
         self._last_prune_state = (state[0], self._store.layout_version)
+
+    def backfill_once(self, offset_seconds: float, now: float | None = None) -> int:
+        """Cold-start backfill: seed MISSING metric annotations with each
+        metric's value one ``offset`` ago, timestamped ``now - offset``
+        so the staleness windows see exactly how old the data is.
+
+        This wires the reference's defined-but-never-called offset query
+        (ref: prometheus.go:82-98) into the one place history genuinely
+        helps: a fresh cluster (or brand-new nodes) gets load-aware
+        scoring immediately instead of scheduling blind until the first
+        sync tick per metric lands. Existing annotations are never
+        overwritten — live data always wins — and hot values are not
+        backfilled (the binding heap rebuilds from the event replay).
+        Returns the number of (node, metric) cells seeded. Sources
+        without bulk offset support are skipped.
+        """
+        if now is None:
+            now = time.time()
+        query_all = getattr(self.metrics, "query_all_by_metric", None)
+        if query_all is None:
+            return 0
+        offset_str = f"{int(offset_seconds)}s"
+        stamp = now - offset_seconds
+        ts_str = format_local_time(stamp)
+        direct = self._store is not None and self.config.direct_store
+        per_node: dict[str, dict[str, str]] = {}
+        for sp in self.policy.spec.sync_period:
+            try:
+                samples = query_all(sp.name, offset=offset_str)
+            except MetricsQueryError:
+                continue
+            except TypeError:  # source has no offset support
+                return 0
+            by_host_get = _index_samples_by_host(samples).get
+            for name, ip in self._node_pairs():
+                node = self.cluster.get_node(name)
+                if node is None or sp.name in (node.annotations or {}):
+                    continue  # never overwrite live data
+                value = by_host_get(ip) or by_host_get(name)
+                if not value:
+                    continue
+                per_node.setdefault(name, {})[sp.name] = f"{value},{ts_str}"
+        if not per_node:
+            return 0
+        # one PATCH per node (a 50k x 12 cold start must not issue 600k
+        # round-trips); fall back to per-cell patches without bulk support
+        bulk = getattr(self.cluster, "patch_node_annotations_bulk", None)
+        if bulk is not None:
+            bulk(per_node)
+        else:
+            for name, kv in per_node.items():
+                for key, anno in kv.items():
+                    self.cluster.patch_node_annotation(name, key, anno)
+        if direct:
+            for name, kv in per_node.items():
+                for key, anno in kv.items():
+                    self._store.ingest_annotation(name, key, anno)
+        return sum(len(kv) for kv in per_node.values())
 
     def sync_all_once_bulk(self, now: float | None = None) -> None:
         """Deterministic bulk pass over syncPolicy metrics. Each node's

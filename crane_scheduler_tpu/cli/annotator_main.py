@@ -43,6 +43,10 @@ def main(argv=None) -> int:
     parser.add_argument("--demo-nodes", type=int, default=0)
     parser.add_argument("--leader-elect", action="store_true")
     parser.add_argument("--lock-file", default="/tmp/crane-annotator.lock")
+    parser.add_argument("--backfill-offset", default=None,
+                        help="cold-start: seed missing annotations from a "
+                             "historical offset query, e.g. 3m (wires the "
+                             "reference's unused offset API)")
     parser.add_argument("--run-seconds", type=float, default=0.0,
                         help="exit after N seconds (0 = run forever)")
     args = parser.parse_args(argv)
@@ -114,6 +118,17 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, lambda *a: stop.set())
 
     def run_annotator(stop_event):
+        # backfill runs ONLY on the elected leader (standbys must not
+        # patch annotations — the active/passive contract), and before
+        # the sync tickers so live data immediately overwrites it
+        if args.backfill_offset:
+            from ..utils import parse_go_duration
+
+            seeded = annotator.backfill_once(
+                parse_go_duration(args.backfill_offset)
+            )
+            print(f"backfill: seeded {seeded} annotations "
+                  f"from offset {args.backfill_offset}", flush=True)
         annotator.start()
         stop_event.wait()
         annotator.stop()

@@ -30,6 +30,8 @@ class FakeMetricsSource:
         # vectorized load model; per-instance closures cost ~5us x
         # |nodes| x |metrics| per sweep)
         self._columns: dict[str, Callable[[], dict[str, str]]] = {}
+        # (metric, offset) -> {instance: rendered value} historical data
+        self._offset_columns: dict[tuple[str, str], dict[str, str]] = {}
         self.ip_queries = 0
         self.name_queries = 0
 
@@ -73,8 +75,22 @@ class FakeMetricsSource:
             value = 0.0
         return format_metric_value(value)
 
-    def query_all_by_metric(self, metric_name: str) -> dict:
+    def set_offset_column(self, metric: str, offset: str, values: dict) -> None:
+        """Historical column for ``query_all_by_metric(offset=...)``:
+        ``{instance: float}`` as the value one ``offset`` ago."""
+        self._offset_columns[(metric, offset)] = {
+            inst: self._render(v) for inst, v in values.items()
+        }
+
+    def query_all_by_metric(self, metric_name: str, offset: str | None = None) -> dict:
         """Bulk variant: every known instance's value for one metric."""
+        if offset is not None:
+            column = self._offset_columns.get((metric_name, offset))
+            if column is None:
+                raise MetricsQueryError(
+                    f"no offset data for {metric_name} offset {offset}"
+                )
+            return dict(column)
         fail = self._fail_ip
         column = self._columns.get(metric_name)
         if column is not None:

@@ -52,7 +52,7 @@ class PrometheusClient:
             return result
         raise MetricsQueryError(f"no data for {metric_name}{{instance=~{name}}}")
 
-    def query_all_by_metric(self, metric_name: str) -> dict:
+    def query_all_by_metric(self, metric_name: str, offset: str | None = None) -> dict:
         """One unfiltered instant query: every instance's value at once.
 
         The bulk-refresh path the reference lacks — it issues
@@ -61,9 +61,17 @@ class PrometheusClient:
         {instance_label: value_string} with the same clamping and
         5-decimal rendering; the instance label may carry a port suffix
         (callers strip it when matching node IPs).
+
+        ``offset``: PromQL offset modifier (e.g. ``"3m"``) — the bulk
+        form of the reference's defined-but-never-called offset query
+        (prometheus.go:82-98), used by the annotator's cold-start
+        backfill.
         """
+        promql = f"{metric_name} /100"
+        if offset:
+            promql = f"{metric_name} offset {offset} /100"
         url = f"{self.address}/api/v1/query?" + urllib.parse.urlencode(
-            {"query": f"{metric_name} /100"}
+            {"query": promql}
         )
         try:
             with urllib.request.urlopen(url, timeout=self.timeout) as resp:

@@ -667,3 +667,62 @@ def test_direct_store_queue_path_preserves_unflushed_values():
     assert store.values[row, col_a] == 0.25
     assert store.values[row, col_b] == 0.55  # B survived, never flushed
     assert np.isfinite(store.ts[row, col_b])
+
+
+def test_backfill_once_seeds_missing_annotations_only():
+    """Cold-start backfill (the reference's unused offset query, wired):
+    missing metric annotations seed from the offset column stamped at
+    now-offset; live annotations are never overwritten; hot values stay
+    untouched."""
+    from crane_scheduler_tpu.annotator import AnnotatorConfig, NodeAnnotator
+    from crane_scheduler_tpu.cluster import ClusterState, Node, NodeAddress
+    from crane_scheduler_tpu.loadstore.codec import decode_annotation
+    from crane_scheduler_tpu.metrics import FakeMetricsSource
+    from crane_scheduler_tpu.policy.types import (
+        DynamicSchedulerPolicy, PolicySpec, PriorityPolicy, SyncPolicy,
+    )
+
+    policy = DynamicSchedulerPolicy(spec=PolicySpec(
+        sync_period=(SyncPolicy("m1", 60.0), SyncPolicy("m2", 60.0)),
+        priority=(PriorityPolicy("m1", 1.0),),
+    ))
+    cluster = ClusterState()
+    cluster.add_node(Node(name="fresh", addresses=(NodeAddress("InternalIP", "10.0.0.1"),)))
+    cluster.add_node(Node(
+        name="live",
+        annotations={"m1": "0.11111,2026-07-30T00:00:00Z"},
+        addresses=(NodeAddress("InternalIP", "10.0.0.2"),),
+    ))
+    metrics = FakeMetricsSource()
+    metrics.set_offset_column("m1", "180s", {"10.0.0.1": 0.4, "10.0.0.2": 0.9})
+    metrics.set_offset_column("m2", "180s", {"10.0.0.1": 0.5, "10.0.0.2": 0.6})
+    ann = NodeAnnotator(cluster, metrics, policy, AnnotatorConfig())
+    now = 1753776000.0
+    seeded = ann.backfill_once(180.0, now=now)
+    assert seeded == 3  # fresh/m1, fresh/m2, live/m2 (live/m1 untouched)
+    fresh = cluster.get_node("fresh").annotations
+    v, ts = decode_annotation(fresh["m1"])
+    assert v == 0.4
+    assert ts == now - 180.0  # stamped at its true age
+    assert cluster.get_node("live").annotations["m1"].startswith("0.11111")
+    # staleness semantics: with syncPeriod 60s + 5m grace, a 180s-old
+    # sample is still active for scoring
+    from crane_scheduler_tpu.scorer import oracle
+
+    score = oracle.score_node(dict(fresh), policy.spec, now)
+    assert score == 60  # (1 - 0.4) * 100
+
+
+def test_backfill_skips_sources_without_offset_support():
+    from crane_scheduler_tpu.annotator import AnnotatorConfig, NodeAnnotator
+    from crane_scheduler_tpu.cluster import ClusterState, Node, NodeAddress
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+
+    class NoOffsetSource:
+        def query_all_by_metric(self, metric):  # no offset kwarg
+            return {}
+
+    cluster = ClusterState()
+    cluster.add_node(Node(name="n", addresses=(NodeAddress("InternalIP", "10.0.0.1"),)))
+    ann = NodeAnnotator(cluster, NoOffsetSource(), DEFAULT_POLICY, AnnotatorConfig())
+    assert ann.backfill_once(180.0, now=1753776000.0) == 0
