@@ -608,6 +608,13 @@ class KubeClusterClient:
     def node_set_version(self) -> int:
         return self._mirror.node_set_version
 
+    @property
+    def pod_version(self) -> int:
+        return self._mirror.pod_version
+
+    def pod_changes_since(self, version: int):
+        return self._mirror.pod_changes_since(version)
+
     def list_nodes(self):
         return self._mirror.list_nodes()
 

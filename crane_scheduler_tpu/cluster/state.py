@@ -159,6 +159,16 @@ class ClusterState:
         # incrementally on bind/retire (a per-call rescan would grow
         # with total burst history)
         self._burst_bound_counts: dict[str, int] = {}
+        # pod-change journal: which NODES had bound-pod/membership
+        # changes, per pod_version — lets NUMA-vector caches rebuild
+        # O(changed nodes) instead of O(all nodes) per bind pass.
+        # Annotation sweeps bump sched_version but NOT pod_version.
+        # Columnar-burst binds are excluded by design: burst rows are
+        # bare pods (no containers, no annotations), invisible to NUMA
+        # accounting (helper.add_pod no-ops on them).
+        self._pod_version = 0
+        self._pod_change_log: deque[tuple[int, str]] = deque(maxlen=8192)
+        self._pod_log_floor = 0  # oldest version NOT fully covered
         # batch handlers that also accept columnar delivery (parallel to
         # _batch_handlers; None = must materialize events for this one)
         self._batch_columnar: list[Callable | None] = []
@@ -173,6 +183,34 @@ class ClusterState:
         reuse a cached snapshot."""
         with self._lock:
             return self._sched_version
+
+    @property
+    def pod_version(self) -> int:
+        """Bumps on bound-pod set/placement/annotation changes and node
+        membership — the inputs NUMA wrapper state derives from. Node
+        ANNOTATION patches (the annotator's sweep) do not bump it."""
+        with self._lock:
+            return self._pod_version
+
+    def _note_pod_change_locked(self, node_name: str) -> None:
+        """Journal a NUMA-relevant change on ``node_name`` (caller holds
+        the lock)."""
+        self._pod_version += 1
+        log = self._pod_change_log
+        if len(log) == log.maxlen:
+            self._pod_log_floor = log[0][0]
+        log.append((self._pod_version, node_name))
+
+    def pod_changes_since(self, version: int):
+        """Node names with bound-pod changes after ``version``, or None
+        when the journal no longer covers the interval (caller must do a
+        full rebuild)."""
+        with self._lock:
+            if version < self._pod_log_floor:
+                return None
+            return {
+                name for v, name in self._pod_change_log if v > version
+            }
 
     @property
     def node_set_version(self) -> int:
@@ -194,9 +232,13 @@ class ClusterState:
             # (name, ip) pair caches keyed on node_set_version
             if prev is None or prev.addresses != node.addresses:
                 self._node_set_version += 1
+            if prev is None:
+                self._note_pod_change_locked(node.name)  # new node row
 
     def delete_node(self, name: str) -> None:
         with self._lock:
+            if name in self._nodes:
+                self._note_pod_change_locked(name)
             self._nodes.pop(name, None)
             self._sched_version += 1
             self._node_set_version += 1
@@ -299,6 +341,23 @@ class ClusterState:
             or prev_burst_bound
         ):
             self._sched_version += 1
+        # journal only REAL changes: a kube relist re-adding identical
+        # bound pods (410 recovery at 50k nodes) must not flood the
+        # journal and defeat the incremental NUMA path it feeds
+        same = (
+            prev is not None
+            and prev.node_name == pod.node_name
+            and prev.annotations == pod.annotations
+            and prev.containers == pod.containers
+        )
+        if pod.node_name and not same:
+            self._note_pod_change_locked(pod.node_name)
+        if (
+            prev is not None
+            and prev.node_name
+            and prev.node_name != pod.node_name
+        ):
+            self._note_pod_change_locked(prev.node_name)
 
     def add_pod(self, pod: Pod) -> None:
         with self._lock:
@@ -329,6 +388,7 @@ class ClusterState:
                 self._index_remove(pod)
             if pod is not None and pod.node_name:
                 self._sched_version += 1
+                self._note_pod_change_locked(pod.node_name)
 
     def get_pod(self, key: str) -> Pod | None:
         with self._lock:
@@ -389,6 +449,9 @@ class ClusterState:
             self._pods[key] = replace(pod, annotations=anno)
             if pod.node_name:
                 self._sched_version += 1
+                # a bound pod's annotations feed NUMA usage
+                # reconstruction (topology-result annotation)
+                self._note_pod_change_locked(pod.node_name)
             return True
 
     def bind_pod(self, pod_key: str, node_name: str, now: float | None = None) -> bool:
@@ -442,6 +505,7 @@ class ClusterState:
                     per_node = pods_by_node[node_name] = {}
                 per_node[pod_key] = None
                 self._sched_version += 1
+                self._note_pod_change_locked(node_name)
                 bound.append(pod_key)
                 event = Event(
                     namespace=pod.namespace,

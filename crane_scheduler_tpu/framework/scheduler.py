@@ -322,10 +322,11 @@ class BatchScheduler:
         self.scorer = self._sharded.scorer
         self.gang = self._sharded.gang
         self._combined = {}  # (dyn_w, topo_w) -> combined-score step
-        # (class sig, versions) -> (offsets, capacity): _numa_vectors is
+        # (class sig, versions) -> cached NUMA vectors: _numa_vectors is
         # O(N) Python wrapper building — at 50k nodes ~1s — so repeated
-        # gang cycles against an unchanged cluster must not re-pay it
+        # gang cycles re-derive only journaled (changed) rows
         self._numa_cache = {}
+        self.numa_incremental_rows = 0  # diagnostics: rows re-derived
         # device-resident snapshot cache: (store version, padded N) it was
         # built from; an unchanged store re-dispatches with zero uploads
         self._prepared = None
@@ -704,20 +705,24 @@ class BatchScheduler:
         import weakref
 
         # cache on the exact inputs the vectors derive from: the CR set
-        # (lister version), assumed pods (cache version), bound pods +
-        # node set (sched_version), the snapshot row order (store version
-        # key), and the request class. Building wrappers is O(N) Python
-        # (~1s at 50k nodes); repeated cycles against an unchanged
-        # cluster must not re-pay it.
+        # (lister version), the request class, the row layout, and the
+        # weight. Bound-pod churn is handled INCREMENTALLY: the cluster's
+        # pod-change journal names the nodes whose accounting moved, so a
+        # bind/recovery pass re-derives O(changed) rows instead of the
+        # O(N) Python wrapper rebuild (~1s at 50k nodes) every pass.
+        # Assume-cache REMOVALS (forget/expiry) lack node attribution and
+        # force a full rebuild (shrink_version); additions surface
+        # through journaled binds.
         lister_version = getattr(topology.lister, "version", None)
+        pod_version = getattr(self.cluster, "pod_version", None)
+        changes_since = getattr(self.cluster, "pod_changes_since", None)
+        shrink = getattr(topology.cache, "shrink_version", None)
         cache_key = None
         if lister_version is not None:
             cache_key = (
                 id(topology),
                 lister_version,
-                topology.cache.version,  # assumed pods feed NUMA usage
-                self.cluster.sched_version,
-                self._prepared_key,
+                getattr(self.store, "layout_version", None),
                 n,
                 topology_weight,
                 self._class_key(template, topology),
@@ -726,8 +731,24 @@ class BatchScheduler:
             # the weakref identity check defeats id() recycling: a new
             # TopologyMatch allocated at a freed one's address (with a
             # fresh lister also starting at version 0) must not hit
-            if hit is not None and hit[0]() is topology:
-                return hit[1].copy(), hit[2].copy()
+            if (
+                hit is not None
+                and hit["ref"]() is topology
+                and hit["shrink"] == shrink
+                and pod_version is not None
+            ):
+                if hit["pod_version"] == pod_version:
+                    return hit["offsets"].copy(), hit["capacity"].copy()
+                changed = (
+                    changes_since(hit["pod_version"]) if changes_since else None
+                )
+                if changed is not None:
+                    self._numa_rows_update(
+                        template, topology, topology_weight,
+                        hit, changed, names, n,
+                    )
+                    hit["pod_version"] = pod_version
+                    return hit["offsets"].copy(), hit["capacity"].copy()
 
         offsets, capacity = self._numa_vectors_uncached(
             template, topology, topology_weight, names, n
@@ -735,45 +756,76 @@ class BatchScheduler:
         if cache_key is not None:
             while len(self._numa_cache) >= 8:
                 self._numa_cache.pop(next(iter(self._numa_cache)))
-            self._numa_cache[cache_key] = (
-                weakref.ref(topology),
-                offsets.copy(),
-                capacity.copy(),
-            )
+            self._numa_cache[cache_key] = {
+                "ref": weakref.ref(topology),
+                "offsets": offsets.copy(),
+                "capacity": capacity.copy(),
+                "pod_version": pod_version,
+                "shrink": shrink,
+                "row_of": None,  # built lazily on first incremental pass
+            }
         return offsets, capacity
 
-    def _numa_vectors_uncached(self, template, topology, topology_weight, names, n):
+    def _numa_rows_update(
+        self, template, topology, topology_weight, hit, changed, names, n
+    ) -> None:
+        """Re-derive the NUMA vectors for ``changed`` node names only,
+        updating the cached master arrays in place. Shares the one
+        row-derivation implementation with the full build
+        (``_numa_derive_rows``), so it is bit-identical to a rebuild by
+        construction: wrappers carry no cross-node state — a row depends
+        only on its own node's CR, bound pods, and assumed entries."""
+        self.numa_incremental_rows += len(changed)
+        row_of = hit["row_of"]
+        if row_of is None:
+            row_of = hit["row_of"] = {
+                name: i for i, name in enumerate(names[:n])
+            }
+        rows = [(row_of[name], name) for name in changed if name in row_of]
+        if not rows:
+            return
+        self._numa_derive_rows(
+            template,
+            topology,
+            topology_weight,
+            rows,
+            self.cluster.list_pods,  # O(pods on node) per changed row
+            hit["offsets"],
+            hit["capacity"],
+        )
+
+    def _numa_derive_rows(
+        self, template, topology, topology_weight, rows, pods_for,
+        offsets, capacity, node_for=None,
+    ) -> None:
+        """THE per-row NUMA derivation (full builds and incremental
+        updates both run exactly this): write each ``(row, node name)``'s
+        combined-score offset and copy capacity into the given arrays.
+        ``pods_for(name)`` resolves the node's bound pods; ``node_for``
+        defaults to per-row cluster lookups (full builds pass a
+        one-pass index to avoid |N| lock hits)."""
         import numpy as np
 
         from ..framework.types import CycleState, NodeInfo
-        from ..topology.batched import (
-            copies_capacity,
-            evaluate_topology_batch,
-        )
+        from ..topology.batched import copies_capacity, evaluate_topology_batch
+        from ..topology.types import CPU_MANAGER_POLICY_STATIC
 
-        offsets = np.zeros((n,), dtype=np.int32)
-        capacity = np.full((n,), 1 << 30, dtype=np.int64)
         state = CycleState()
         topology.pre_filter(state, template)
         s = topology._get_state(state)
-        if (
-            s is None
-            or template.is_daemonset_pod()
-            or not s.target_container_indices
-        ):
-            return offsets, capacity  # plugin no-ops for this pod
-
-        from ..topology.types import CPU_MANAGER_POLICY_STATIC
-
-        pods_by_node: dict[str, list] = {}
-        for pod in self.cluster.list_pods():
-            if pod.node_name:
-                pods_by_node.setdefault(pod.node_name, []).append(pod)
-        nodes_by_name = {node.name: node for node in self.cluster.list_nodes()}
-
-        enforced: list[tuple[int, object]] = []  # (row, wrapper)
-        for i, name in enumerate(names[:n]):
-            node = nodes_by_name.get(name)
+        if s is None or template.is_daemonset_pod() or not s.target_container_indices:
+            # plugin no-ops for this pod class: default vectors
+            for i, _ in rows:
+                offsets[i] = 0
+                capacity[i] = 1 << 30
+            return
+        enforced: list[tuple[int, object]] = []
+        if node_for is None:
+            node_for = self.cluster.get_node
+        for i, name in rows:
+            offsets[i] = 0
+            capacity[i] = 1 << 30
+            node = node_for(name)
             if node is None:
                 capacity[i] = 0
                 continue
@@ -785,20 +837,18 @@ class BatchScheduler:
             if nrt.crane_manager_policy.cpu_manager_policy != CPU_MANAGER_POLICY_STATIC:
                 continue  # kubelet handles cpuset; plugin no-op
             nw = topology._initialize_node_wrapper(
-                s, NodeInfo(node=node, pods=pods_by_node.get(name, [])), nrt
+                s, NodeInfo(node=node, pods=pods_for(name)), nrt
             )
             enforced.append((i, nw))
         if not enforced:
-            return offsets, capacity
-
+            return
         request = s.target_container_resource
-        rows = [i for i, _ in enforced]
+        idx = [i for i, _ in enforced]
         wrappers = [nw for _, nw in enforced]
         aware_mask = np.array([nw.aware for nw in wrappers], dtype=bool)
         ev = evaluate_topology_batch(wrappers, request)
         aware_fits = np.asarray(ev.aware_fits)
         numa_scores = np.asarray(ev.scores)
-
         caps = copies_capacity(wrappers, request, aware=aware_mask).astype(np.int64)
         caps = np.where(aware_mask & ~aware_fits, 0, caps)
         # aware pods take one whole zone: plugin score 100 (ref: helper.go
@@ -807,8 +857,32 @@ class BatchScheduler:
             aware_mask, 100 * int(topology_weight),
             numa_scores.astype(np.int64) * int(topology_weight),
         )
-        offsets[rows] = offs.astype(np.int32)
-        capacity[rows] = caps
+        offsets[idx] = offs.astype(np.int32)
+        capacity[idx] = caps
+
+    def _numa_vectors_uncached(self, template, topology, topology_weight, names, n):
+        """Full build: the shared per-row derivation over every row, with
+        the bound-pod index built in ONE list_pods pass (per-row lookups
+        would take the cluster lock |N| times)."""
+        import numpy as np
+
+        offsets = np.zeros((n,), dtype=np.int32)
+        capacity = np.full((n,), 1 << 30, dtype=np.int64)
+        pods_by_node: dict[str, list] = {}
+        for pod in self.cluster.list_pods():
+            if pod.node_name:
+                pods_by_node.setdefault(pod.node_name, []).append(pod)
+        nodes_by_name = {node.name: node for node in self.cluster.list_nodes()}
+        self._numa_derive_rows(
+            template,
+            topology,
+            topology_weight,
+            list(enumerate(names[:n])),
+            lambda name: pods_by_node.get(name, []),
+            offsets,
+            capacity,
+            node_for=nodes_by_name.get,
+        )
         return offsets, capacity
 
     def schedule_gang(

@@ -44,15 +44,25 @@ class BatchedTopologyResult:
     scores: Any  # [N] int32 — 100 // zones_used (0 when nothing packs)
 
 
+def _pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
 def pack_node_wrappers(wrappers: list[NodeWrapper], max_zones: int | None = None):
-    """[N, Z, R] allocatable + requested tensors (+validity) from
+    """[Npad, Zpad, R] allocatable + requested tensors (+validity) from
     per-node wrappers (allocatable kept raw: the greedy pack floors CPU,
-    the aware fit check does not — ref: helper.go:194 vs :230-282)."""
+    the aware fit check does not — ref: helper.go:194 vs :230-282).
+
+    Node and zone axes pad to power-of-two buckets (pad rows/zones are
+    ``valid=False``) so the jitted kernels compile once per bucket, not
+    once per batch size — incremental row updates evaluate tiny batches
+    that must not each pay a fresh trace+compile."""
     n = len(wrappers)
     z = max(max_zones or max((len(w.numa_nodes) for w in wrappers), default=1), 1)
-    alloc = np.zeros((n, z, _R), dtype=np.float64)
-    used = np.zeros((n, z, _R), dtype=np.float64)
-    valid = np.zeros((n, z), dtype=bool)
+    npad, zpad = _pow2(max(n, 1)), _pow2(z)
+    alloc = np.zeros((npad, zpad, _R), dtype=np.float64)
+    used = np.zeros((npad, zpad, _R), dtype=np.float64)
+    valid = np.zeros((npad, zpad), dtype=bool)
     for i, w in enumerate(wrappers):
         for j, nn in enumerate(w.numa_nodes[:z]):
             alloc[i, j] = (
@@ -130,6 +140,7 @@ def _evaluate(alloc, used, valid, request):
 def evaluate_topology_batch(
     wrappers: list[NodeWrapper], request: Resource
 ) -> BatchedTopologyResult:
+    n = len(wrappers)
     alloc, used, valid = pack_node_wrappers(wrappers)
     out = _evaluate(
         jnp.asarray(alloc),
@@ -137,7 +148,7 @@ def evaluate_topology_batch(
         jnp.asarray(valid),
         jnp.asarray(request_vector(request)),
     )
-    return BatchedTopologyResult(*out)
+    return BatchedTopologyResult(*(np.asarray(o)[:n] for o in out))
 
 
 @jax.jit
@@ -197,14 +208,17 @@ def copies_capacity(
     ``aware`` is a scalar bool or an [N] mask (per-node awareness); the
     kernel computes both bounds and selects per node in one dispatch.
     """
+    n = len(wrappers)
     alloc, used, valid = pack_node_wrappers(wrappers)
     aware = np.asarray(aware, dtype=bool)
+    aware_pad = np.zeros((alloc.shape[0],), dtype=bool)
+    aware_pad[:n] = aware if aware.shape else np.full((n,), bool(aware))
     return np.asarray(
         _copies_capacity(
             jnp.asarray(alloc),
             jnp.asarray(used),
             jnp.asarray(valid),
             jnp.asarray(request_vector(request)),
-            jnp.asarray(aware),
+            jnp.asarray(aware_pad),
         )
-    )
+    )[:n]

@@ -28,6 +28,7 @@ class PodTopologyCache:
         self._cleaner: threading.Thread | None = None
         self._stop = threading.Event()
         self._version = 0
+        self._shrink_version = 0
 
     @property
     def version(self) -> int:
@@ -36,6 +37,16 @@ class PodTopologyCache:
         cache against it."""
         with self._lock:
             return self._version
+
+    @property
+    def shrink_version(self) -> int:
+        """Bumps only on REMOVALS (forget/expiry). Additions become
+        visible to NUMA accounting only through a bound pod — which the
+        cluster's pod-change journal records — so incremental
+        wrapper-cache maintenance needs a full rebuild only when entries
+        disappear without a corresponding bind journal entry."""
+        with self._lock:
+            return self._shrink_version
 
     def assume_pod(self, pod: Pod, zones: list[Zone], now: float | None = None) -> None:
         """ref: cache.go:53-69 — double-assume is an error."""
@@ -54,6 +65,7 @@ class PodTopologyCache:
         with self._lock:
             if self._topology.pop(pod.key(), None) is not None:
                 self._version += 1
+                self._shrink_version += 1
             self._deadline.pop(pod.key(), None)
 
     def pod_count(self) -> int:
@@ -76,6 +88,7 @@ class PodTopologyCache:
                 self._deadline.pop(k, None)
             if expired:
                 self._version += 1
+                self._shrink_version += 1
 
     def start_cleaner(self) -> None:
         if self._cleaner is not None:

@@ -758,17 +758,25 @@ def test_numa_vectors_cache_reuse_and_invalidation(monkeypatch):
     r2 = batch.schedule_gang(template, 2, topology=topology, bind=False)
     assert builds["n"] == 1  # unchanged cluster: cache hit
     assert r1.assignments == r2.assignments
-    # the solve inside a bind=True cycle still hits (binds land after),
-    # but the NEXT cycle sees the moved sched_version and rebuilds
+    # binds move the pod-change journal: the next cycle updates ONLY the
+    # bound-to rows (incremental), never re-paying the O(N) build — and
+    # the updated vectors equal a from-scratch rebuild
     batch.schedule_gang(template, 1, topology=topology, bind=True)
-    assert builds["n"] == 1
     batch.schedule_gang(template, 1, topology=topology, bind=False)
-    builds_after_bind = builds["n"]
-    assert builds_after_bind == 2
-    # a CR change invalidates
+    assert builds["n"] == 1
+    assert batch.numa_incremental_rows > 0
+    offsets, capacity = batch._numa_vectors(
+        template, topology, 2, batch._prepared_names, batch._prepared_n
+    )
+    want_offsets, want_capacity = real(
+        template, topology, 2, batch._prepared_names, batch._prepared_n
+    )
+    np.testing.assert_array_equal(offsets, want_offsets)
+    np.testing.assert_array_equal(capacity, want_capacity)
+    # a CR change invalidates fully
     lister.upsert(lister.get(sim.cluster.list_nodes()[0].name))
     batch.schedule_gang(template, 1, topology=topology, bind=False)
-    assert builds["n"] == builds_after_bind + 1
+    assert builds["n"] == 2
 
 
 def test_schedule_gang_over_admission_recovers(monkeypatch):

@@ -1,0 +1,168 @@
+"""Incremental NUMA-vector maintenance: a bind/recovery pass re-derives
+only journaled (changed) rows, bit-identical to a full rebuild.
+
+Round-2 VERDICT item 4: the vector cache keyed on sched_version was
+invalidated by every bind AND every annotation sweep, re-paying an O(N)
+Python wrapper build per recovery pass / per class at 50k nodes. The
+cache now keys on the pod-change journal (``ClusterState.pod_version`` /
+``pod_changes_since``) and updates changed rows in place.
+"""
+
+import numpy as np
+
+from tests.test_framework_e2e import _nrt_fixture, make_sim
+
+
+def _fresh_vectors(sim, batch, topology, template, weight=2):
+    """Ground truth: a full uncached rebuild on the current state."""
+    return batch._numa_vectors_uncached(
+        template, topology, weight, batch._prepared_names, batch._prepared_n
+    )
+
+
+def _setup(n_nodes=12, seed=31):
+    from crane_scheduler_tpu.topology import TopologyMatch
+
+    sim = make_sim(n_nodes, seed=seed)
+    batch = sim.build_batch_scheduler()
+    lister = _nrt_fixture(sim, [[4000, 4000]] * n_nodes)
+    topology = TopologyMatch(lister, cluster=sim.cluster)
+    template = sim.make_pod(cpu_milli=1000, mem=1 << 28)
+    sim.cluster.delete_pod(template.key())
+    return sim, batch, topology, template
+
+
+def test_incremental_rows_match_full_rebuild():
+    sim, batch, topology, template = _setup()
+    # populate the cache (full build)
+    r0 = batch.schedule_gang(template, 4, topology=topology, bind=False)
+    assert batch.numa_incremental_rows == 0
+
+    # bind gang copies through the plugin path (annotations + assume
+    # cache + journal all move)
+    batch.schedule_gang(template, 5, topology=topology, bind=True)
+
+    # next cycle: the cache must take the incremental path...
+    before = batch.numa_incremental_rows
+    r2 = batch.schedule_gang(template, 3, topology=topology, bind=False)
+    changed_rows = batch.numa_incremental_rows - before
+    assert 0 < changed_rows < len(sim.cluster.list_nodes())
+
+    # ...and produce vectors bit-identical to a from-scratch rebuild
+    offsets, capacity = batch._numa_vectors(
+        template, topology, 2, batch._prepared_names, batch._prepared_n
+    )
+    want_offsets, want_capacity = _fresh_vectors(sim, batch, topology, template)
+    np.testing.assert_array_equal(offsets, want_offsets)
+    np.testing.assert_array_equal(capacity, want_capacity)
+    assert r2.assignments  # still placing
+
+
+def test_annotation_sweep_does_not_invalidate_numa_cache():
+    """The annotator's node-annotation sweep bumps sched_version but not
+    pod_version — NUMA vectors must come straight from cache (zero
+    incremental rows, zero rebuilds)."""
+    sim, batch, topology, template = _setup()
+    batch.schedule_gang(template, 2, topology=topology, bind=False)
+
+    calls = {"full": 0}
+    real = batch._numa_vectors_uncached
+
+    def counting(*a, **k):
+        calls["full"] += 1
+        return real(*a, **k)
+
+    batch._numa_vectors_uncached = counting
+    before = batch.numa_incremental_rows
+    sim.clock.advance(30)
+    sim.sync_metrics()  # annotation sweep: sched_version moves
+    batch.schedule_gang(template, 2, topology=topology, bind=False)
+    assert calls["full"] == 0
+    assert batch.numa_incremental_rows == before
+
+
+def test_assume_cache_expiry_forces_full_rebuild():
+    """Removals from the assume cache carry no node attribution: the
+    next vector build must be a full rebuild, and match ground truth."""
+    sim, batch, topology, template = _setup(n_nodes=6, seed=32)
+    batch.schedule_gang(template, 3, topology=topology, bind=True)
+    batch.schedule_gang(template, 1, topology=topology, bind=False)  # cache warm
+
+    calls = {"full": 0}
+    real = batch._numa_vectors_uncached
+
+    def counting(*a, **k):
+        calls["full"] += 1
+        return real(*a, **k)
+
+    batch._numa_vectors_uncached = counting
+    import time as _time
+
+    # assume deadlines stamp from the real wall clock (reserve passes no
+    # explicit now); expire relative to it
+    topology.cache.cleanup(now=_time.time() + 10 * 3600)
+    assert topology.cache.pod_count() == 0  # everything expired
+    offsets, capacity = batch._numa_vectors(
+        template, topology, 2, batch._prepared_names, batch._prepared_n
+    )
+    assert calls["full"] == 1
+    batch._numa_vectors_uncached = real
+    want_offsets, want_capacity = _fresh_vectors(sim, batch, topology, template)
+    np.testing.assert_array_equal(offsets, want_offsets)
+    np.testing.assert_array_equal(capacity, want_capacity)
+
+
+def test_journal_overflow_falls_back_to_full_rebuild():
+    """A change burst larger than the journal window must not serve a
+    stale incremental view."""
+    sim, batch, topology, template = _setup(n_nodes=4, seed=33)
+    batch.schedule_gang(template, 2, topology=topology, bind=True)
+    batch.schedule_gang(template, 1, topology=topology, bind=False)  # cache warm
+
+    # blow the journal: more bound-pod changes than the log retains
+    cap = sim.cluster._pod_change_log.maxlen
+    node = sim.cluster.list_nodes()[0].name
+    from crane_scheduler_tpu.cluster import Pod
+
+    for i in range(cap + 10):
+        sim.cluster.add_pod(Pod(name=f"filler-{i}", namespace="x", node_name=node))
+    assert sim.cluster.pod_changes_since(0) is None  # window exceeded
+
+    calls = {"full": 0}
+    real = batch._numa_vectors_uncached
+
+    def counting(*a, **k):
+        calls["full"] += 1
+        return real(*a, **k)
+
+    batch._numa_vectors_uncached = counting
+    offsets, capacity = batch._numa_vectors(
+        template, topology, 2, batch._prepared_names, batch._prepared_n
+    )
+    batch._numa_vectors_uncached = real
+    assert calls["full"] == 1
+    want_offsets, want_capacity = _fresh_vectors(sim, batch, topology, template)
+    np.testing.assert_array_equal(offsets, want_offsets)
+    np.testing.assert_array_equal(capacity, want_capacity)
+
+
+def test_incremental_scales_o_changed_not_o_nodes():
+    """The measured criterion: at a larger node count, a recovery-style
+    re-derive touches only the bound-to nodes."""
+    sim, batch, topology, template = _setup(n_nodes=400, seed=34)
+    batch.schedule_gang(template, 4, topology=topology, bind=False)  # warm
+
+    calls = {"full": 0}
+    real = batch._numa_vectors_uncached
+
+    def counting(*a, **k):
+        calls["full"] += 1
+        return real(*a, **k)
+
+    batch._numa_vectors_uncached = counting
+    before = batch.numa_incremental_rows
+    batch.schedule_gang(template, 6, topology=topology, bind=True)
+    batch.schedule_gang(template, 2, topology=topology, bind=False)
+    assert calls["full"] == 0  # never rebuilt all 400 nodes
+    touched = batch.numa_incremental_rows - before
+    assert 0 < touched <= 30  # only the handful of bound-to nodes
