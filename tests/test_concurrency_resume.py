@@ -90,13 +90,11 @@ def test_concurrent_annotator_scheduler_store_refresh():
     assert ann.synced > 0
 
 
-def test_soak_pipelined_scheduler_with_threaded_direct_annotator():
-    """Round-2 paths under concurrency: a threaded bulk annotator owning
-    a shared direct-mode store, a pipelined batch scheduler consuming it
-    (refresh_from_cluster=False), and node churn — all racing. The
-    invariants: no exceptions, every assignment lands on a live-at-bind
-    node, batch-bound pods really bind, deleted nodes drain from the
-    store within the sync cadence."""
+
+def _soak_fixture():
+    """Shared soak topology: 16 nodes with synthetic load streams, a
+    threaded-capable direct-store annotator, and a batch scheduler
+    consuming the shared store."""
     from crane_scheduler_tpu.framework.scheduler import BatchScheduler
     from crane_scheduler_tpu.metrics import FakeMetricsSource
 
@@ -114,11 +112,20 @@ def test_soak_pipelined_scheduler_with_threaded_direct_annotator():
         cluster, fake, policy,
         AnnotatorConfig(concurrent_syncs=2, bulk_sync=True, direct_store=True),
     )
-    batch = BatchScheduler(
-        cluster, policy, store=None, refresh_from_cluster=False,
-    )
+    batch = BatchScheduler(cluster, policy, refresh_from_cluster=False)
     ann.attach_store(batch.store)
     ann.sync_all_once_bulk(NOW)
+    return cluster, fake, ann, batch
+
+
+def test_soak_pipelined_scheduler_with_threaded_direct_annotator():
+    """Round-2 paths under concurrency: a threaded bulk annotator owning
+    a shared direct-mode store, a pipelined batch scheduler consuming it
+    (refresh_from_cluster=False), and node churn — all racing. The
+    invariants: no exceptions, every assignment lands on a live-at-bind
+    node, batch-bound pods really bind, deleted nodes drain from the
+    store within the sync cadence."""
+    cluster, fake, ann, batch = _soak_fixture()
 
     errors: list = []
     stop = threading.Event()
@@ -254,3 +261,110 @@ def test_scheduler_cli_main(capsys):
     ) == 0
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["scheduled"] == 20
+
+
+def test_soak_burst_mode_with_threaded_annotator_and_churn():
+    """The round-3 columnar paths under concurrency: a threaded bulk
+    annotator (direct store, column-log replay feeding the device
+    refresh), pipelined COLUMNAR bursts binding through bind_burst,
+    object-path mutations racing the burst rows (copy-on-write), and
+    node churn. Invariants: no exceptions, burst placements land and are
+    visible through every read API, hot values flow from columnar event
+    delivery, counts stay consistent."""
+    from crane_scheduler_tpu.framework.scheduler import BatchScheduler
+    from crane_scheduler_tpu.metrics import FakeMetricsSource
+
+    cluster = ClusterState()
+    fake = FakeMetricsSource()
+    for i in range(16):
+        name, ip = f"node-{i:03d}", f"10.1.0.{i}"
+        cluster.add_node(Node(name=name, addresses=(NodeAddress("InternalIP", ip),)))
+        fake.set("cpu_usage_avg_5m", ip, lambda i=i: 0.1 + (i % 5) * 0.15, by="ip")
+    policy = DynamicSchedulerPolicy(spec=PolicySpec(
+        sync_period=(SyncPolicy("cpu_usage_avg_5m", 0.02),),
+        hot_value=(HotValuePolicy(300.0, 2),),
+    ))
+    ann = NodeAnnotator(
+        cluster, fake, policy,
+        AnnotatorConfig(concurrent_syncs=2, bulk_sync=True, direct_store=True),
+    )
+    batch = BatchScheduler(cluster, policy, refresh_from_cluster=False)
+    ann.attach_store(batch.store)
+    ann.sync_all_once_bulk(NOW)
+
+    errors: list = []
+    stop = threading.Event()
+    results = []
+
+    def burst_loop():
+        seq = 0
+        try:
+            while not stop.is_set():
+                def stream():
+                    nonlocal seq
+                    for _ in range(3):
+                        base = seq
+                        seq += 8
+                        yield ("b", [f"bp{base + i}" for i in range(8)])
+                for result in batch.schedule_bursts_pipelined(stream(), bind=True):
+                    results.append(result)
+                time.sleep(0.005)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def mutator():
+        """Object-path operations racing the burst rows."""
+        j = 0
+        try:
+            while not stop.is_set():
+                j += 1
+                # churn a node
+                cluster.add_node(Node(
+                    name=f"extra-{j % 2}",
+                    addresses=(NodeAddress("InternalIP", f"10.2.0.{j % 2}"),),
+                ))
+                time.sleep(0.005)
+                cluster.delete_node(f"extra-{j % 2}")
+                # copy-on-write races: patch/delete/get random burst keys
+                cluster.patch_pod_annotation(f"b/bp{j * 7 % 200}", "k", "v")
+                cluster.delete_pod(f"b/bp{j * 11 % 200}")
+                cluster.get_pod(f"b/bp{j * 13 % 200}")
+                cluster.count_pods_all()
+                time.sleep(0.005)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    ann.start()
+    threads = [threading.Thread(target=f, daemon=True) for f in (burst_loop, mutator)]
+    for t in threads:
+        t.start()
+    time.sleep(1.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5.0)
+        assert not t.is_alive(), "soak thread did not stop"
+    ann.stop()
+    assert not errors, errors
+    assert results, "burst scheduler made no progress"
+    # placements visible through the read APIs (minus racing deletes)
+    placed = checked = 0
+    for result in results[-5:]:
+        for key, node in result.assignments.items():
+            checked += 1
+            pod = cluster.get_pod(key)
+            if pod is not None and pod.node_name:
+                assert pod.node_name == node
+                placed += 1
+    assert checked and placed > 0
+    # hot values flowed through columnar event delivery
+    total = sum(
+        ann.binding_records.get_last_node_binding_count(
+            f"node-{i:03d}", 3000.0, time.time() + 5
+        )
+        for i in range(16)
+    )
+    assert total > 0
+    # count consistency: count_pods_all equals per-node counts
+    counts = cluster.count_pods_all()
+    for name, c in list(counts.items())[:8]:
+        assert cluster.count_pods(name) == c
