@@ -224,16 +224,15 @@ def config4(dtype, rtt):
 
     def sweep(t):
         for metric in tensors.metric_names:
-            store.bulk_set_by_name(
-                metric, node_names, rng2.uniform(0, 1, n), np.full(n, t)
-            )
+            # scalar ts: bulk_set_by_name broadcasts it (uniform sweep)
+            store.bulk_set_by_name(metric, node_names, rng2.uniform(0, 1, n), t)
 
     def column_entries(v):
         # guarded like the production path (scheduler._prepare): a broken
         # version chain or layout change means no column replay
         cols = store.column_delta_since(v)
         assert cols is not None, "column log chain broke mid-bench"
-        new_v, layout, entries = cols
+        _, layout, entries = cols
         assert layout == store.layout_version
         return entries
 

@@ -112,5 +112,145 @@ def main() -> int:
     return 0
 
 
+# -- full-loop mode (spawned with: <pid> <port> full_loop <stub_url>) -------
+
+LOOP_NODES = 32
+LOOP_PODS = 48
+LOOP_CYCLES = 2
+
+
+def _wait(predicate, timeout=30.0):
+    import time
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def full_loop(process_id: int, port: str, stub_url: str) -> int:
+    """The COMPLETE loop over DCN + the kube boundary: worker 0 runs the
+    annotator (the elected leader) patching annotations through the
+    apiserver and binds through the binding subresource; BOTH workers
+    mirror the cluster, ingest their OWN node shard into a local store,
+    and run the sharded solve over the global mesh — the replicated
+    packed result must be identical on both, and cycle 2 must see cycle
+    1's hot-value feedback."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", LOCAL_DEVICES)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from crane_scheduler_tpu.annotator import AnnotatorConfig, NodeAnnotator
+    from crane_scheduler_tpu.cluster.kube import KubeClusterClient
+    from crane_scheduler_tpu.loadstore import NodeLoadStore
+    from crane_scheduler_tpu.metrics import FakeMetricsSource
+    from crane_scheduler_tpu.parallel import (
+        ShardedScheduleStep,
+        global_node_mesh,
+        initialize,
+        partition_nodes,
+        prepare_from_local_shard,
+    )
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY, compile_policy
+    from crane_scheduler_tpu.utils import format_local_time
+
+    initialize(f"127.0.0.1:{port}", NUM_PROCESSES, process_id)
+
+    client = KubeClusterClient(stub_url)
+    client.start()
+    all_names = sorted(n.name for n in client.list_nodes())
+    assert len(all_names) == LOOP_NODES
+    mine = partition_nodes(all_names, NUM_PROCESSES, process_id)
+
+    tensors = compile_policy(DEFAULT_POLICY)
+    leader = process_id == 0
+    annotator = None
+    if leader:
+        fake = FakeMetricsSource()
+        for name in all_names:
+            gidx = int(name.split("-")[1])
+            node = client.get_node(name)
+            for j, m in enumerate(tensors.metric_names):
+                fake.set(m, node.internal_ip(),
+                         ((gidx * 7 + j * 13) % 80) / 100.0, by="ip")
+        annotator = NodeAnnotator(
+            client, fake, DEFAULT_POLICY, AnnotatorConfig(bulk_sync=True)
+        )
+        annotator.event_ingestor.start()
+
+    mesh = global_node_mesh()
+    step = ShardedScheduleStep(
+        tensors, mesh, dtype=jnp.float64, dynamic_weight=3
+    )
+    store = NodeLoadStore(tensors)
+
+    packed_per_cycle = []
+    bound_so_far = 0
+    for cycle in range(LOOP_CYCLES):
+        cycle_now = NOW + 100.0 * cycle
+        if leader:
+            # the leader's sweep patches every node through the API
+            annotator.sync_all_once_bulk(cycle_now)
+        # every worker waits until ITS mirror shows the sweep's
+        # timestamp on EVERY synced annotation of every shard node —
+        # metrics land in sweep order and node_hot_value last, so
+        # checking only the first metric would race the rest
+        ts_str = format_local_time(cycle_now)
+        wanted_keys = list(tensors.metric_names) + ["node_hot_value"]
+
+        def swept():
+            for name in mine:
+                anno = client.get_node(name).annotations or {}
+                for key in wanted_keys:
+                    if not anno.get(key, "").endswith(ts_str):
+                        return False
+            return True
+
+        assert _wait(swept), f"p{process_id}: sweep did not propagate"
+
+        # shard-local ingest -> global arrays -> replicated solve
+        store.bulk_ingest(
+            (name, client.get_node(name).annotations) for name in mine
+        )
+        snap = store.snapshot(bucket=len(mine))
+        prepared = prepare_from_local_shard(step, snap, cycle_now + 1.0)
+        packed = np.asarray(step.packed(prepared, LOOP_PODS))
+        packed_per_cycle.append(packed.tolist())
+
+        # the leader applies the (replicated) placements: stable
+        # score-descending expansion over the GLOBAL name order
+        schedulable, scores, counts, unassigned, _ = step.unpack(
+            packed, LOOP_NODES
+        )
+        if leader:
+            by_score = np.argsort(-np.asarray(scores), kind="stable")
+            order = np.repeat(by_score, np.asarray(counts)[by_score])
+            for k, node_row in enumerate(order):
+                key = f"default/p{cycle}-{k}"
+                assert client.bind_pod(key, all_names[int(node_row)])
+            bound_so_far += len(order)
+            # hot-value feedback must land before the next sweep
+            assert _wait(
+                lambda: annotator.event_ingestor.translated >= bound_so_far
+            ), "events did not reach the binding heap"
+
+    print(json.dumps({
+        "process": process_id,
+        "cycles": packed_per_cycle,
+    }), flush=True)
+    client.stop()
+    return 0
+
+
 if __name__ == "__main__":
+    if len(sys.argv) > 3 and sys.argv[3] == "full_loop":
+        raise SystemExit(full_loop(int(sys.argv[1]), sys.argv[2], sys.argv[4]))
     raise SystemExit(main())
