@@ -231,12 +231,17 @@ def full_loop(process_id: int, port: str, stub_url: str) -> int:
             packed, LOOP_NODES
         )
         if leader:
-            by_score = np.argsort(-np.asarray(scores), kind="stable")
-            order = np.repeat(by_score, np.asarray(counts)[by_score])
-            for k, node_row in enumerate(order):
-                key = f"default/p{cycle}-{k}"
-                assert client.bind_pod(key, all_names[int(node_row)])
-            bound_so_far += len(order)
+            # the canonical stable expansion (all placement paths MUST
+            # share it — see its docstring)
+            from crane_scheduler_tpu.framework.scheduler import BatchScheduler
+
+            keys = [f"default/p{cycle}-{k}" for k in range(int(np.asarray(counts).sum()))]
+            assignments, _ = BatchScheduler._expand_counts(
+                scores, counts, all_names, keys
+            )
+            for key, node_name in assignments.items():
+                assert client.bind_pod(key, node_name)
+            bound_so_far += len(assignments)
             # hot-value feedback must land before the next sweep
             assert _wait(
                 lambda: annotator.event_ingestor.translated >= bound_so_far

@@ -108,13 +108,8 @@ def test_two_process_full_loop_over_kube_boundary():
     packed results on both hosts each cycle, binds landed in the
     apiserver, and cycle 2's solve differs from cycle 1's (the
     hot-value/load feedback made it through the full loop)."""
-    import importlib.util as _ilu
+    from tests.test_kube_client import kube_stub  # shared stub loader
 
-    spec = _ilu.spec_from_file_location(
-        "kube_stub", os.path.join(os.path.dirname(__file__), "kube_stub.py")
-    )
-    kube_stub = _ilu.module_from_spec(spec)
-    spec.loader.exec_module(kube_stub)
     w = _load_worker_module()
 
     server = kube_stub.KubeStubServer().start()
