@@ -237,3 +237,50 @@ def test_hot_value_written_for_node_missing_first_metric():
     node = cluster.get_node("n1")
     assert "m2" in node.annotations
     assert NODE_HOT_VALUE_KEY in node.annotations
+
+
+def test_fuzz_burst_equals_object_path_across_random_clusters():
+    """Randomized equivalence: across random cluster sizes, load
+    distributions, burst sizes, and interleaved feedback cycles, the
+    columnar burst path must produce exactly the object path's
+    placements and leave identical cluster observables."""
+    rng = np.random.default_rng(1234)
+    for trial in range(6):
+        n_nodes = int(rng.integers(3, 24))
+        seed = int(rng.integers(0, 10_000))
+        sims = [make_sim(n_nodes, seed=seed) for _ in range(2)]
+        batches = [s.build_batch_scheduler() for s in sims]
+        for cycle in range(int(rng.integers(1, 4))):
+            count = int(rng.integers(1, 64))
+            names = [f"t{trial}c{cycle}p{i}" for i in range(count)]
+            # object path
+            pods = [Pod(name=n, namespace="fz") for n in names]
+            sims[0].cluster.add_pods(pods)
+            res_obj = batches[0].schedule_batch(pods)
+            # burst path
+            res_burst = batches[1].schedule_pod_burst("fz", names)
+            assert res_burst.assignments == res_obj.assignments, (
+                trial, cycle, n_nodes, seed
+            )
+            assert res_burst.unassigned == res_obj.unassigned
+            assert (
+                sims[0].cluster.count_pods_all()
+                == sims[1].cluster.count_pods_all()
+            )
+            assert (
+                sims[0].cluster.sched_version
+                == sims[1].cluster.sched_version
+            )
+            # the hot-value heap saw the same multiset of bindings
+            probe_now = sims[0].clock() + 5
+            for node in set(res_obj.assignments.values()):
+                assert sims[1].annotator.binding_records.get_last_node_binding_count(
+                    node, 3600.0, probe_now
+                ) == sims[0].annotator.binding_records.get_last_node_binding_count(
+                    node, 3600.0, probe_now
+                )
+            # feedback: advance virtual time and re-sync both worlds so
+            # the next cycle scores against hot-value-updated annotations
+            for s in sims:
+                s.clock.advance(15.0)
+                s.sync_metrics()
