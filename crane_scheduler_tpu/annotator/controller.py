@@ -381,7 +381,6 @@ class NodeAnnotator:
             return 0
         import numpy as np
 
-        by_host = _index_samples_by_host(samples)
         direct = self._store is not None and self.config.direct_store
         if hot_by_node is self._HOT_UNSET:
             hot_by_node = self.hot_values_batch(now)
@@ -398,12 +397,12 @@ class NodeAnnotator:
         stale = shared_ts == neg_inf
         pairs, all_names, all_ips = self._node_tables()
         # bulk column providers return {ip: value} in node order — when
-        # the key sequence matches exactly, take the values as-is instead
-        # of |nodes| dict lookups
-        if by_host is samples and list(samples) == all_ips:
+        # the key sequence matches exactly, take the values as-is and
+        # skip both the host-alias scan and |nodes| dict lookups
+        if list(samples) == all_ips:
             vals = list(samples.values())
         else:
-            by_host_get = by_host.get
+            by_host_get = _index_samples_by_host(samples).get
             vals = [by_host_get(ip) or by_host_get(name) for name, ip in pairs]
         if all(vals):
             names = all_names

@@ -327,6 +327,16 @@ class BatchScheduler:
         # gang cycles re-derive only journaled (changed) rows
         self._numa_cache = {}
         self.numa_incremental_rows = 0  # diagnostics: rows re-derived
+        # refresh-path observability: which upload path served each
+        # _prepare call (the judge of steady-state health at scale —
+        # `full` climbing in production means the column/delta paths are
+        # being defeated by foreign store mutations)
+        self.refresh_stats = {
+            "hit": 0,  # unchanged store, resident snapshot reused
+            "columns": 0,  # column-log replay ([N] vectors per column)
+            "delta": 0,  # row-delta scatter
+            "full": 0,  # full snapshot + H2D upload
+        }
         # device-resident snapshot cache: (store version, padded N) it was
         # built from; an unchanged store re-dispatches with zero uploads
         self._prepared = None
@@ -379,11 +389,13 @@ class BatchScheduler:
         )
         if self._prepared is not None and self._prepared_key == key:
             if self._hybrid:
+                self.refresh_stats["hit"] += 1
                 self._prepared = self._sharded.with_overrides(
                     self._prepared, self._prepared_snap, now
                 )
                 return self._prepared
             if not stale_epoch:
+                self.refresh_stats["hit"] += 1
                 return self._prepared
 
         if (
@@ -399,6 +411,7 @@ class BatchScheduler:
             if cols is not None:
                 new_key, layout, entries = cols
                 if layout == self._prepared_layout and entries:
+                    self.refresh_stats["columns"] += 1
                     self._prepared = self._sharded.apply_columns(
                         self._prepared, entries, self._prepared_n
                     )
@@ -425,6 +438,7 @@ class BatchScheduler:
                 layout == self._prepared_layout
                 and 0 < len(rows) <= max(1, int(self._prepared_n * self._DELTA_MAX_FRACTION))
             ):
+                self.refresh_stats["delta"] += 1
                 self._prepared = self._sharded.apply_delta(
                     self._prepared, rows, values_rows, ts_rows,
                     hot_rows, hot_ts_rows,
@@ -445,6 +459,7 @@ class BatchScheduler:
                     )
                 return self._prepared
 
+        self.refresh_stats["full"] += 1
         snap = self.store.snapshot(bucket=self._bucket)
         self._prepared = self._sharded.prepare(snap, now)
         self._prepared_key = key

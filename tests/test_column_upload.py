@@ -179,3 +179,44 @@ def test_batch_scheduler_uses_column_path(monkeypatch):
     r_cold = cold.schedule_pod_burst("b2-cold", names, bind=False)
     assert list(np.asarray(r.scores_row)) == list(np.asarray(r_cold.scores_row))
     assert list(np.asarray(r.node_idx)) == list(np.asarray(r_cold.node_idx))
+
+
+def test_refresh_stats_track_upload_paths():
+    """The refresh-path counters attribute each _prepare to the path
+    that served it (hit / columns / delta / full)."""
+    from crane_scheduler_tpu.framework.scheduler import BatchScheduler
+    from crane_scheduler_tpu.sim import SimConfig, Simulator
+
+    sim = Simulator(SimConfig(n_nodes=6, seed=9))
+    sim.sync_metrics()
+    ann = sim.annotator
+    ann.config.bulk_sync = True
+    ann.config.direct_store = True
+    batch = BatchScheduler(
+        sim.cluster, sim.policy, dtype=jnp.float32, clock=sim.clock,
+        snapshot_bucket=16, refresh_from_cluster=False,
+    )
+    ann.attach_store(batch.store)
+    ann.sync_all_once_bulk(sim.clock())
+
+    names = [f"p{i}" for i in range(4)]
+    batch.schedule_pod_burst("s", names)
+    assert batch.refresh_stats["full"] == 1
+
+    batch.schedule_pod_burst("s2", names, bind=False)
+    assert batch.refresh_stats["hit"] == 1
+
+    sim.clock.advance(30.0)
+    ann.sync_all_once_bulk(sim.clock())  # column sweep
+    batch.schedule_pod_burst("s3", names, bind=False)
+    assert batch.refresh_stats["columns"] == 1
+
+    # a foreign single-row mutation breaks the column chain but keeps
+    # the layout: the row-delta path serves it
+    node = batch.store.node_names[0]
+    batch.store.set_metric(
+        node, batch.tensors.metric_names[0], 0.5, sim.clock()
+    )
+    batch.schedule_pod_burst("s4", names, bind=False)
+    assert batch.refresh_stats["delta"] == 1
+    assert batch.refresh_stats["full"] == 1  # never re-paid
