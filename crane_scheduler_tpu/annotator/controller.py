@@ -141,9 +141,8 @@ class NodeAnnotator:
         # re-syncs between flushes collapse to one patch
         self._anno_pending: dict[str, dict[str, str]] = {}
         self._anno_lock = threading.Lock()
-        # (node_set_version, [(name, ip)]) — a bulk sweep re-reads the
-        # same pair list |metrics| times per cycle
-        # (node_set_version, [(name, ip)], [name], [ip]) — see _node_tables
+        # (node_set_version, [(name, ip)], [name], [ip]) — a bulk sweep
+        # re-reads the same tables |metrics| times per cycle (_node_tables)
         self._node_pairs_cache: tuple | None = None
         self._last_prune_state: tuple | None = None
 
@@ -180,6 +179,20 @@ class NodeAnnotator:
         """(name, internal_ip) per node (see ``_node_tables``)."""
         return self._node_tables()[0]
 
+    def _patch_per_node(self, per_node: dict) -> None:
+        """Apply assembled ``{node: {key: raw}}`` patches through the
+        cluster's per-node bulk primitive when present (one lock/HTTP
+        PATCH per node), else per-(node, key). The ONE write-dispatch
+        implementation for flush/sweep/backfill."""
+        bulk = getattr(self.cluster, "patch_node_annotations_bulk", None)
+        if bulk is not None:
+            bulk(per_node)
+            return
+        patch = self.cluster.patch_node_annotation
+        for node_name, kv in per_node.items():
+            for key, raw in kv.items():
+                patch(node_name, key, raw)
+
     def flush_annotations(self) -> int:
         """Apply deferred annotation patches (direct mode writes the store
         first; the annotation contract catches up here — from the emitter
@@ -191,21 +204,14 @@ class NodeAnnotator:
         if not pending:
             return 0
         total = sum(len(sub) for sub in pending.values())
-        bulk = getattr(self.cluster, "patch_node_annotations_bulk", None)
-        if bulk is not None:
-            per_node: dict[str, dict[str, str]] = {}
-            for key, sub in pending.items():
-                for node_name, raw in sub.items():
-                    d = per_node.get(node_name)
-                    if d is None:
-                        d = per_node[node_name] = {}
-                    d[key] = raw
-            bulk(per_node)
-        else:
-            patch = self.cluster.patch_node_annotation
-            for key, sub in pending.items():
-                for node_name, raw in sub.items():
-                    patch(node_name, key, raw)
+        per_node: dict[str, dict[str, str]] = {}
+        for key, sub in pending.items():
+            for node_name, raw in sub.items():
+                d = per_node.get(node_name)
+                if d is None:
+                    d = per_node[node_name] = {}
+                d[key] = raw
+        self._patch_per_node(per_node)
         return total
 
     # -- core sync logic ---------------------------------------------------
@@ -478,11 +484,19 @@ class NodeAnnotator:
                         None, hot_names, None, None, hot_vals, hot_ts_arr
                     )
         else:
-            patch = self.cluster.patch_node_annotation
-            for name, anno in zip(names, annos):
-                patch(name, metric_name, anno)
+            # write-through mode (e.g. --master): coalesce this tick's
+            # metric + hot writes into ONE patch per node when the
+            # cluster supports it — the reference pays a separate PATCH
+            # round-trip per (node, metric) AND per hot re-patch
+            # (ref: node.go:101-121); against a real apiserver that is
+            # 2x|nodes| HTTP calls per tick collapsed to |nodes|
+            per_node = {
+                name: {metric_name: anno}
+                for name, anno in zip(names, annos)
+            }
             for name, hot_anno in zip(hot_names, hot_annos):
-                patch(name, NODE_HOT_VALUE_KEY, hot_anno)
+                per_node.setdefault(name, {})[NODE_HOT_VALUE_KEY] = hot_anno
+            self._patch_per_node(per_node)
         return patched
 
     def _prune_direct_store(self) -> None:
@@ -548,14 +562,8 @@ class NodeAnnotator:
         if not per_node:
             return 0
         # one PATCH per node (a 50k x 12 cold start must not issue 600k
-        # round-trips); fall back to per-cell patches without bulk support
-        bulk = getattr(self.cluster, "patch_node_annotations_bulk", None)
-        if bulk is not None:
-            bulk(per_node)
-        else:
-            for name, kv in per_node.items():
-                for key, anno in kv.items():
-                    self.cluster.patch_node_annotation(name, key, anno)
+        # round-trips); per-cell fallback without bulk support
+        self._patch_per_node(per_node)
         if direct:
             for name, kv in per_node.items():
                 for key, anno in kv.items():
