@@ -951,21 +951,11 @@ class BatchScheduler:
             )
         return result
 
-    def _bind_assignments(self, pods_for, assignments, topology, now: float):
-        """Shared bind loop for gang copies and pending pods: drive the
-        topology plugin's Filter -> Reserve -> PreBind per pod, then bind
-        (ref: reserver.go, binder.go). A pod the plugin's Filter rejects
-        (the copies-capacity estimate over-admitted) is NOT bound — blind
-        binding would silently violate the NUMA contract the plugin
-        enforces (ref: filter.go:45-86).
-
-        ``pods_for(key) -> (pod | None, create)`` resolves each key;
-        ``create`` means the pod must be added to the cluster before
-        binding (the gang path creates copies from a template). Returns
-        ``(bound, rejected, rejecting, dropped)``: ``rejected`` keys were
-        Filter-rejected on their node and can re-solve elsewhere;
-        ``dropped`` keys cannot bind at all (pod missing from the
-        resolver or the cluster) and go straight to unassigned."""
+    def _bind_assignments_sequential(self, pods_for, assignments, topology, now):
+        """The reference-shaped per-pod bind loop: drive the topology
+        plugin's Filter -> Reserve -> PreBind per pod, then bind (ref:
+        reserver.go, binder.go). Kept as the semantic twin the grouped
+        path (``_bind_assignments``) is equivalence-tested against."""
         from ..framework.types import CycleState, NodeInfo
 
         nodes_by_name = {node.name: node for node in self.cluster.list_nodes()}
@@ -999,6 +989,118 @@ class BatchScheduler:
                 dropped.append(pod_key)
                 continue
             bound[pod_key] = node_name
+        return bound, rejected, rejecting, dropped
+
+    def _bind_assignments(self, pods_for, assignments, topology, now: float):
+        """Shared bind application for gang copies and pending pods,
+        grouped BY NODE: the plugin evaluates its Filter gates once per
+        node group (``TopologyMatch.group_context``) and assigns each
+        accepted copy against the group's evolving wrapper
+        (``group_assign``) — exactly the accounting a per-pod wrapper
+        rebuild would derive from the previous copies' result
+        annotations, since in-gang usage is monotone and wrapper state
+        is per-node. All copies of one ``_bind_recover_loop`` pass share
+        a scheduling class (``_class_key``). Binds apply as one
+        ``bind_pods`` transaction per node group (event multiset and
+        hot-value feedback identical to per-pod binds).
+
+        Semantics pinned bit-for-bit against the sequential twin
+        (``_bind_assignments_sequential``) by randomized tests
+        (tests/test_bind_grouped.py): placements, rejections,
+        zone-result annotations, assume-cache contents, counts. One
+        deliberate divergence: a copy whose BIND fails (transient API
+        error) has already been accounted against its node's remaining
+        NUMA capacity for later copies of the same group — the
+        conservative direction (never over-admits).
+
+        ``pods_for(key) -> (pod | None, create)`` resolves each key;
+        ``create`` means the pod must be added to the cluster before
+        binding (the gang path creates copies from a template). Returns
+        ``(bound, rejected, rejecting, dropped)``: ``rejected`` keys were
+        Filter-rejected on their node and can re-solve elsewhere;
+        ``dropped`` keys cannot bind at all and go straight to
+        unassigned."""
+        from dataclasses import replace as _replace
+
+        from ..topology.types import (
+            ANNOTATION_POD_TOPOLOGY_RESULT,
+            zones_to_json,
+        )
+
+        nodes_by_name = {node.name: node for node in self.cluster.list_nodes()}
+        bound: dict[str, str] = {}
+        rejected: list[str] = []
+        rejecting: set[str] = set()
+        dropped: list[str] = []
+
+        by_node: dict[str, list[str]] = {}
+        for pod_key, node_name in assignments.items():
+            by_node.setdefault(node_name, []).append(pod_key)
+
+        for node_name, keys in by_node.items():
+            node = nodes_by_name.get(node_name)
+            resolved = [(key, *pods_for(key)) for key in keys]
+            ctx = None
+            if topology is not None and node is not None:
+                template = next(
+                    (pod for _, pod, _ in resolved if pod is not None), None
+                )
+                if template is not None:
+                    ctx = topology.group_context(
+                        template, node, self.cluster.list_pods(node_name)
+                    )
+            if ctx == "missing_nrt":  # the whole group is Unschedulable
+                for key, pod, _ in resolved:
+                    if pod is None:
+                        dropped.append(key)  # unresolvable either way
+                    else:
+                        rejected.append(key)
+                        rejecting.add(node_name)
+                continue
+
+            to_create: list = []
+            to_bind: list[tuple[str, str]] = []
+            assumed: list = []
+            for pod_key, pod, create in resolved:
+                if pod is None:
+                    dropped.append(pod_key)
+                    continue
+                if ctx is not None:
+                    result = topology.group_assign(ctx)
+                    if result is None:
+                        rejected.append(pod_key)
+                        rejecting.add(node_name)
+                        continue
+                    if result:
+                        # Reserve (assume) + PreBind annotation; created
+                        # copies carry the annotation from birth
+                        raw = zones_to_json(result)
+                        if create:
+                            anno = dict(pod.annotations)
+                            anno[ANNOTATION_POD_TOPOLOGY_RESULT] = raw
+                            pod = _replace(pod, annotations=anno)
+                        assumed.append((pod, result, raw, create))
+                if create:
+                    to_create.append(pod)
+                to_bind.append((pod_key, node_name))
+
+            for pod, result, raw, create in assumed:
+                try:
+                    topology.cache.assume_pod(pod, result)
+                except KeyError:
+                    continue  # double-assume: reserve would have errored
+                if not create:
+                    self.cluster.patch_pod_annotation(
+                        pod.key(), ANNOTATION_POD_TOPOLOGY_RESULT, raw
+                    )
+            if to_create:
+                self.cluster.add_pods(to_create)
+            bound_keys = set(self.cluster.bind_pods(to_bind, now))
+            for pod_key, node_name2 in to_bind:
+                if pod_key in bound_keys:
+                    bound[pod_key] = node_name2
+                else:
+                    dropped.append(pod_key)
         return bound, rejected, rejecting, dropped
 
     def _bind_gang(self, template, assignments, topology, now: float):

@@ -53,6 +53,15 @@ DEFAULT_TOPOLOGY_AWARE_RESOURCES = frozenset({"cpu"})  # ref: v1beta2/defaults.g
 
 
 @dataclass
+class _GroupContext:
+    """State for one node's grouped bind (see ``group_context``)."""
+
+    s: "_StateData"
+    nw: NodeWrapper
+    cr_order: list
+
+
+@dataclass
 class _StateData:
     """ref: plugin.go:93-122."""
 
@@ -169,6 +178,69 @@ class TopologyMatch:
             return Status.unschedulable(ERR_NUMA_INSUFFICIENT)
         nw.numa_nodes = fitting
         return None
+
+    # -- grouped binds (the batch scheduler's per-node fast path) ----------
+
+    def group_context(self, template: Pod, node, pods):
+        """Filter-gate evaluation ONCE for a class-homogeneous group of
+        pods headed to one node (every pod shares the template's
+        guaranteed-CPU request and awareness — the scheduler groups by
+        ``_class_key``). Returns:
+
+        - ``None`` — the plugin no-ops for this class or node (DaemonSet
+          / no guaranteed-CPU containers / non-Static policy), exactly
+          the per-pod Filter's early successes (filter.go:60-71);
+        - ``"missing_nrt"`` — Unschedulable for the whole group
+          (filter.go:56-58);
+        - a context for ``group_assign`` otherwise.
+
+        The semantics here ARE the per-pod Filter's, restructured so the
+        node wrapper builds once; ``group_assign`` then evolves it copy
+        by copy. Equivalence with per-pod Filter->Reserve is pinned by
+        randomized tests (tests/test_bind_grouped.py)."""
+        state = CycleState()
+        self.pre_filter(state, template)
+        s = self._get_state(state)
+        if s is None or template.is_daemonset_pod() or not s.target_container_indices:
+            return None
+        try:
+            nrt = self.lister.get(node.name)
+        except KeyError:
+            return "missing_nrt"
+        if nrt.crane_manager_policy.cpu_manager_policy != CPU_MANAGER_POLICY_STATIC:
+            return None
+        nw = self._initialize_node_wrapper(
+            s, NodeInfo(node=node, pods=pods), nrt
+        )
+        # a fresh per-pod rebuild starts from the CR's zone order and the
+        # greedy sort is STABLE — keep the CR order so ties break like a
+        # rebuild would
+        return _GroupContext(s=s, nw=nw, cr_order=list(nw.numa_nodes))
+
+    def group_assign(self, ctx) -> list | None:
+        """One copy's Filter-fit + zone assignment against the group's
+        evolving wrapper: None = Unschedulable (ERR_NUMA_INSUFFICIENT),
+        else the zone result — already folded into the wrapper's usage,
+        which is exactly what the next per-pod rebuild would read back
+        from this copy's result annotation."""
+        s, nw = ctx.s, ctx.nw
+        if nw.aware:
+            fitting = [
+                nn
+                for nn in ctx.cr_order
+                if not fits_request_for_numa_node(s.target_container_resource, nn)
+            ]
+            if not fitting:
+                return None
+            nw.numa_nodes = fitting
+        else:
+            nw.numa_nodes = list(ctx.cr_order)
+        nw.result = []
+        assign_topology_result(nw, s.target_container_resource.clone())
+        result = list(nw.result)
+        if result:
+            nw.add_numa_resources(result)
+        return result
 
     # -- Score (ref: scorer.go:11-29) --------------------------------------
 

@@ -1,0 +1,153 @@
+"""Grouped-by-node bind application vs the sequential per-pod twin.
+
+`BatchScheduler._bind_assignments` folds each accepted copy's zone
+result into one per-node wrapper instead of rebuilding the wrapper per
+pod through the plugin's Filter. These tests pin bit-for-bit equivalence
+with `_bind_assignments_sequential` (the reference-shaped path) across
+randomized NUMA topologies, loads, and gang shapes: identical
+placements, rejections, pod annotations, assume-cache contents, and
+bound counts.
+"""
+
+import numpy as np
+
+from tests.test_framework_e2e import _nrt_fixture, make_sim
+
+
+def _run(sim, sequential: bool, template_cpu, count, aware, zones_by_node):
+    from crane_scheduler_tpu.topology import TopologyMatch
+    from crane_scheduler_tpu.topology.types import (
+        ANNOTATION_POD_TOPOLOGY_AWARENESS,
+    )
+
+    batch = sim.build_batch_scheduler()
+    if sequential:
+        batch._bind_assignments = batch._bind_assignments_sequential
+    lister = _nrt_fixture(sim, zones_by_node)
+    topology = TopologyMatch(lister, cluster=sim.cluster)
+    template = sim.make_pod(cpu_milli=template_cpu, mem=1 << 28)
+    sim.cluster.delete_pod(template.key())
+    if aware:
+        template.annotations[ANNOTATION_POD_TOPOLOGY_AWARENESS] = "true"
+    result = batch.schedule_gang(template, count, topology=topology, bind=True)
+    return batch, topology, result
+
+
+def _observables(sim, topology):
+    pods = {}
+    for pod in sim.cluster.list_pods():
+        pods[pod.key()] = (pod.node_name, dict(pod.annotations))
+    assumed = {
+        key: [(z.name, dict(z.resources.capacity or {}))
+              for z in zones]
+        for key, zones in topology.cache._topology.items()
+    }
+    return pods, assumed, sim.cluster.count_pods_all()
+
+
+def test_grouped_equals_sequential_randomized():
+    rng = np.random.default_rng(77)
+    for trial in range(8):
+        n_nodes = int(rng.integers(2, 8))
+        seed = int(rng.integers(0, 10_000))
+        zones_by_node = [
+            [int(rng.integers(1, 9)) * 1000
+             for _ in range(int(rng.integers(1, 4)))]
+            for _ in range(n_nodes)
+        ]
+        template_cpu = int(rng.integers(1, 4)) * 1000
+        count = int(rng.integers(1, 24))
+        aware = bool(rng.integers(0, 2))
+
+        sims = [make_sim(n_nodes, seed=seed) for _ in range(2)]
+        outs = []
+        for sim, sequential in zip(sims, (False, True)):
+            batch, topology, result = _run(
+                sim, sequential, template_cpu, count, aware, zones_by_node
+            )
+            outs.append((result, _observables(sim, topology)))
+        (r_grp, obs_grp), (r_seq, obs_seq) = outs
+        ctx = (trial, n_nodes, seed, zones_by_node, template_cpu, count, aware)
+        assert r_grp.assignments == r_seq.assignments, ctx
+        assert sorted(r_grp.unassigned) == sorted(r_seq.unassigned), ctx
+        assert obs_grp == obs_seq, ctx
+
+
+def test_grouped_missing_nrt_rejects_like_sequential():
+    """A node whose NRT CR is missing must reject its copies
+    (ERR_FAILED_TO_GET_NRT, filter.go:56-58) on both paths."""
+    from crane_scheduler_tpu.topology import TopologyMatch
+
+    for sequential in (False, True):
+        sim = make_sim(2, seed=5)
+        batch = sim.build_batch_scheduler()
+        if sequential:
+            batch._bind_assignments = batch._bind_assignments_sequential
+        lister = _nrt_fixture(sim, [[4000]])  # only node 0 has a CR
+        topology = TopologyMatch(lister, cluster=sim.cluster)
+        template = sim.make_pod(cpu_milli=1000, mem=1 << 28)
+        sim.cluster.delete_pod(template.key())
+        result = batch.schedule_gang(template, 6, topology=topology, bind=True)
+        placed_nodes = set(result.assignments.values())
+        assert placed_nodes <= {sim.cluster.list_nodes()[0].name}, sequential
+
+
+def test_grouped_equals_sequential_mixed_existing_pods():
+    """The create=False arm (_bind_existing, schedule_batch_mixed):
+    PENDING pods with NUMA requests bind identically on both paths —
+    placements, result annotations (patched, not baked), assume cache,
+    and counts."""
+    from crane_scheduler_tpu.cluster import (
+        Container,
+        Pod,
+        ResourceRequirements,
+    )
+    from crane_scheduler_tpu.topology import TopologyMatch
+    from crane_scheduler_tpu.topology.types import (
+        ANNOTATION_POD_TOPOLOGY_AWARENESS,
+    )
+
+    rng = np.random.default_rng(31)
+    for trial in range(4):
+        n_nodes = int(rng.integers(2, 6))
+        seed = int(rng.integers(0, 10_000))
+        zones_by_node = [
+            [int(rng.integers(2, 8)) * 1000
+             for _ in range(int(rng.integers(1, 3)))]
+            for _ in range(n_nodes)
+        ]
+        count = int(rng.integers(4, 20))
+        aware = bool(rng.integers(0, 2))
+
+        outs = []
+        for sequential in (False, True):
+            sim = make_sim(n_nodes, seed=seed)
+            batch = sim.build_batch_scheduler()
+            if sequential:
+                batch._bind_assignments = batch._bind_assignments_sequential
+            lister = _nrt_fixture(sim, zones_by_node)
+            topology = TopologyMatch(lister, cluster=sim.cluster)
+            pods = []
+            for i in range(count):
+                anno = {}
+                if aware:
+                    anno[ANNOTATION_POD_TOPOLOGY_AWARENESS] = "true"
+                pod = Pod(
+                    name=f"mx{i}", namespace="m", annotations=anno,
+                    containers=(Container(
+                        "main",
+                        ResourceRequirements(
+                            requests={"cpu": "1000m", "memory": "64Mi"},
+                            limits={"cpu": "1000m", "memory": "64Mi"},
+                        ),
+                    ),),
+                )
+                sim.cluster.add_pod(pod)
+                pods.append(pod)
+            result = batch.schedule_batch_mixed(pods, topology=topology)
+            outs.append((result, _observables(sim, topology)))
+        (r_grp, obs_grp), (r_seq, obs_seq) = outs
+        ctx = (trial, n_nodes, seed, zones_by_node, count, aware)
+        assert r_grp.assignments == r_seq.assignments, ctx
+        assert sorted(r_grp.unassigned) == sorted(r_seq.unassigned), ctx
+        assert obs_grp == obs_seq, ctx
