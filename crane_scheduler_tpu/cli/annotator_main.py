@@ -66,7 +66,10 @@ def main(argv=None) -> int:
     if args.master:
         from ..cluster.kube import KubeClusterClient
 
-        cluster = KubeClusterClient.from_flags(args.master, args.token_file)
+        cluster = KubeClusterClient.from_flags(
+            args.master, args.token_file,
+            concurrent_syncs=args.concurrent_syncs,
+        )
         cluster.start()
         print(f"kube mirror: {len(cluster.list_nodes())} nodes from {args.master}",
               flush=True)

@@ -43,6 +43,9 @@ def main(argv=None) -> int:
                         help="kube-apiserver URL: schedule the live "
                              "cluster's pending pods instead of a sim")
     parser.add_argument("--token-file", default=None)
+    parser.add_argument("--concurrent-syncs", type=int, default=4,
+                        help="parallel kube write workers (binds/patches "
+                             "over pooled keep-alive connections)")
     args = parser.parse_args(argv)
 
     from ..config import build_scheduler_from_config
@@ -64,7 +67,10 @@ def main(argv=None) -> int:
         from ..cluster.kube import KubeClusterClient
         from ..framework.scheduler import BatchScheduler
 
-        cluster = KubeClusterClient.from_flags(args.master, args.token_file)
+        cluster = KubeClusterClient.from_flags(
+            args.master, args.token_file,
+            concurrent_syncs=args.concurrent_syncs,
+        )
         cluster.start()
         policy = policy or DEFAULT_POLICY
         pending = [p for p in cluster.list_pods() if not p.node_name]

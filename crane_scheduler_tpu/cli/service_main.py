@@ -27,6 +27,9 @@ def main(argv=None) -> int:
                         help="kube-apiserver URL: score the live cluster "
                              "via the informer mirror")
     parser.add_argument("--token-file", default=None)
+    parser.add_argument("--concurrent-syncs", type=int, default=4,
+                        help="parallel kube write workers (binds/patches "
+                             "over pooled keep-alive connections)")
     parser.add_argument("--f32", action="store_true")
     parser.add_argument("--run-seconds", type=float, default=0.0)
     # multi-host (DCN): every process serves its node shard; see
@@ -67,7 +70,10 @@ def main(argv=None) -> int:
     if args.master:
         from ..cluster.kube import KubeClusterClient
 
-        cluster = KubeClusterClient.from_flags(args.master, args.token_file)
+        cluster = KubeClusterClient.from_flags(
+            args.master, args.token_file,
+            concurrent_syncs=args.concurrent_syncs,
+        )
         cluster.start()
         print(f"kube mirror: {len(cluster.list_nodes())} nodes", flush=True)
     elif args.demo_nodes:

@@ -16,7 +16,12 @@ same architecture in stdlib Python:
   (``{"metadata":{"annotations":{...}}}``), ``bind_pod(s)`` POSTs the
   ``binding`` subresource like the real scheduler; the mirror applies
   the change optimistically so the writer immediately observes its own
-  write (client-go's informer eventually reflects it too).
+  write (client-go's informer eventually reflects it too). All writes
+  ride a pool of ``concurrent_syncs`` keep-alive workers routed by
+  object key (per-object FIFO ordering, cross-object parallelism) —
+  the stdlib equivalent of the reference's ``--concurrent-syncs``
+  workqueue workers over client-go's pooled HTTP/2 transport
+  (ref: controller.go:74-77, node.go:29-42).
 - **Events**: the watch is filtered server-side with
   ``fieldSelector=reason=Scheduled,type=Normal`` and feeds the same
   subscriber interface the in-memory cluster exposes, so the annotator's
@@ -31,12 +36,16 @@ Tested against a stub apiserver speaking the same wire protocol
 
 from __future__ import annotations
 
+import http.client
 import json
+import queue
 import ssl
 import threading
 import urllib.error
 import urllib.request
+from concurrent.futures import Future
 from typing import Callable
+from urllib.parse import urlsplit
 
 from .state import (
     ClusterState,
@@ -115,6 +124,205 @@ def _parse_wall_time(value) -> float:
 
 NRT_API_PATH = "/apis/topology.crane.io/v1alpha1/noderesourcetopologies"
 
+# merge-patches are idempotent (last write wins byte-for-byte), so a
+# response-phase transport failure can be blindly retried; the binding
+# subresource POST is NOT (a duplicate bind 409s on a real apiserver and
+# double-emits the Scheduled event on permissive servers), so POSTs only
+# retry when the failure happened before a full request reached the wire
+_IDEMPOTENT_METHODS = frozenset({"GET", "PUT", "PATCH", "DELETE"})
+
+
+class _RawHTTPConnection:
+    """Hand-rolled HTTP/1.1 keep-alive connection for the plain-http
+    write path. http.client routes every response's headers through
+    email.feedparser (~100us of pure-Python work per response), which
+    at annotation-storm rates makes the CLIENT the throughput cap; this
+    builds each request in one ``sendall`` and parses responses with a
+    minimal reader. Exposes the http.client subset ``_PooledWriter``
+    uses (``request``/``getresponse``/``close``); https keeps
+    http.client + TLS."""
+
+    def __init__(self, host: str, port: int | None, timeout: float):
+        import socket
+
+        self._sock = socket.create_connection(
+            (host, port or 80), timeout=timeout
+        )
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._rf = self._sock.makefile("rb")
+        self._host_hdr = f"{host}:{port}" if port else host
+
+    def request(self, method: str, path: str, body=None, headers=None):
+        data = body or b""
+        lines = [
+            f"{method} {path} HTTP/1.1",
+            f"Host: {self._host_hdr}",
+            f"Content-Length: {len(data)}",
+        ]
+        for k, v in (headers or {}).items():
+            lines.append(f"{k}: {v}")
+        self._sock.sendall(
+            ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1") + data
+        )
+
+    def getresponse(self):
+        line = self._rf.readline(65537)
+        if not line:
+            raise http.client.BadStatusLine("connection closed")
+        status = int(line.split(None, 2)[1])
+        length = None
+        chunked = False
+        close = False
+        while True:
+            h = self._rf.readline(65537)
+            if h in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = h.partition(b":")
+            k, v = k.strip().lower(), v.strip()
+            if k == b"content-length":
+                length = int(v)
+            elif k == b"connection" and v.lower() == b"close":
+                close = True
+            elif k == b"transfer-encoding" and b"chunked" in v.lower():
+                chunked = True
+        # drain the body now so the connection is immediately reusable
+        if chunked:
+            while True:
+                size = int(self._rf.readline(65537).strip() or b"0", 16)
+                if size == 0:
+                    self._rf.readline(65537)  # blank line after last chunk
+                    break
+                self._rf.read(size)
+                self._rf.readline(65537)  # chunk-trailing CRLF
+        elif length is not None:
+            self._rf.read(length)
+        else:
+            close = True  # read-to-EOF body: not reusable
+
+        class _Resp:
+            pass
+
+        resp = _Resp()
+        resp.status = status
+        resp.will_close = close
+        resp.read = lambda: b""  # already drained
+        return resp
+
+    def close(self):
+        try:
+            self._rf.close()
+        finally:
+            self._sock.close()
+
+
+class _PooledWriter(threading.Thread):
+    """One write worker: a FIFO queue drained over a single persistent
+    keep-alive connection.
+
+    The pool routes every write for a given object key to the same
+    worker, so writes to one node/pod stay FIFO-ordered process-wide
+    while distinct objects patch/bind in parallel — the ordering
+    contract the reference gets from client-go's workqueue (at most one
+    item per key in flight: node.go:52-70) combined with its pooled
+    HTTP/2 transport (``--concurrent-syncs`` workers,
+    ref: controller.go:74-77, node.go:29-42). Connection reuse is the
+    other half of the win: the round-3 write path paid TCP setup +
+    teardown per PATCH through fresh urllib requests.
+    """
+
+    def __init__(
+        self,
+        base_url: str,
+        token: str | None,
+        context: ssl.SSLContext | None,
+        timeout: float,
+    ):
+        super().__init__(daemon=True)
+        u = urlsplit(base_url)
+        self._scheme = u.scheme
+        self._host = u.hostname or "127.0.0.1"
+        self._port = u.port
+        self._token = token
+        self._context = context
+        self._timeout = timeout
+        self._conn: http.client.HTTPConnection | None = None
+        self.queue: queue.SimpleQueue = queue.SimpleQueue()
+
+    def _connect(self):
+        import socket
+
+        if self._scheme == "https":
+            conn = http.client.HTTPSConnection(
+                self._host, self._port, timeout=self._timeout,
+                context=self._context,
+            )
+            conn.connect()
+            # keep-alive + Nagle + delayed ACK = ~40ms/request stalls;
+            # every production HTTP client (client-go included, via Go's
+            # net.Dial defaults) disables Nagle on pooled connections
+            conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return conn
+        return _RawHTTPConnection(self._host, self._port, self._timeout)
+
+    def run(self) -> None:
+        while True:
+            item = self.queue.get()
+            if item is None:
+                if self._conn is not None:
+                    self._conn.close()
+                return
+            method, path, body, content_type, fut = item
+            try:
+                ok = self._do(method, path, body, content_type)
+            except Exception:  # noqa: BLE001 — a worker must never die
+                self._drop_conn()
+                ok = False
+            fut.set_result(ok)
+
+    def _drop_conn(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+
+    def _do(self, method: str, path: str, body, content_type: str) -> bool:
+        data = None if body is None else json.dumps(body).encode()
+        headers = {}
+        if data is not None:
+            headers["Content-Type"] = content_type
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        for attempt in (0, 1):
+            if self._conn is None:
+                self._conn = self._connect()
+            try:
+                self._conn.request(method, path, body=data, headers=headers)
+            except (http.client.HTTPException, OSError):
+                # send-phase failure: the server never saw a complete
+                # request (the classic case is a keep-alive connection
+                # the server idle-closed between our writes) — always
+                # safe to reconnect and retry once, POSTs included
+                self._drop_conn()
+                if attempt:
+                    return False
+                continue
+            try:
+                resp = self._conn.getresponse()
+                resp.read()  # drain so the connection can be reused
+            except (http.client.HTTPException, OSError):
+                # response-phase failure: the request may have been
+                # processed — retry only idempotent methods
+                self._drop_conn()
+                if attempt or method not in _IDEMPOTENT_METHODS:
+                    return False
+                continue
+            if resp.will_close:
+                self._drop_conn()
+            return 200 <= resp.status < 400
+        return False
+
 
 def nrt_from_json(obj: dict):
     """gocrane NodeResourceTopology CR -> topology model (ref: the
@@ -167,7 +375,10 @@ class KubeClusterClient:
 
     @classmethod
     def from_flags(
-        cls, master: str, token_file: str | None = None
+        cls,
+        master: str,
+        token_file: str | None = None,
+        concurrent_syncs: int = 4,
     ) -> "KubeClusterClient":
         """CLI/in-cluster construction: bearer token from ``token_file``
         or the mounted service-account token, and the in-cluster CA
@@ -186,7 +397,10 @@ class KubeClusterClient:
         context = None
         if os.path.exists(SERVICE_ACCOUNT_CA):
             context = ssl.create_default_context(cafile=SERVICE_ACCOUNT_CA)
-        return cls(master, token=token, context=context)
+        return cls(
+            master, token=token, context=context,
+            concurrent_syncs=concurrent_syncs,
+        )
 
     def __init__(
         self,
@@ -196,6 +410,7 @@ class KubeClusterClient:
         timeout: float = DEFAULT_TIMEOUT_SECONDS,
         seen_events_cap: int = 65536,
         list_page_limit: int = 500,
+        concurrent_syncs: int = 4,
     ):
         self.base_url = base_url.rstrip("/")
         self._token = token
@@ -227,9 +442,23 @@ class KubeClusterClient:
         # rv watermark: a watch stream delivers events in resourceVersion
         # order, so any event at or below the highest rv already applied
         # is a replayed duplicate — exact dedup in O(1) memory, immune to
-        # backlogs larger than the content-key cap
+        # backlogs larger than the content-key cap. The API contract only
+        # promises rvs are opaque, so the watermark is guarded: an rv
+        # DECREASE on a live stream (outside the replay window that
+        # follows a (re)connect or relist) is a monotonicity violation —
+        # the server's integer rvs aren't etcd-ordered — and rv dedup is
+        # permanently disabled in favor of the content-key map (which is
+        # maintained in parallel the whole time, so the downgrade loses
+        # no dedup continuity). Round-4 VERDICT item 6.
         self._event_rv_watermark = 0
+        self._event_rv_trusted = True
+        self._event_expect_replay = True  # initial list = a replay window
         self._seen_lock = threading.Lock()
+        # write pool: --concurrent-syncs keep-alive workers, spawned on
+        # first write (read-only clients never pay the threads)
+        self._write_workers = max(1, int(concurrent_syncs))
+        self._pool: list[_PooledWriter] = []
+        self._pool_lock = threading.Lock()
 
     # -- HTTP plumbing -----------------------------------------------------
 
@@ -259,6 +488,46 @@ class KubeClusterClient:
     def _get_json(self, path: str) -> dict:
         with self._request("GET", path) as resp:
             return json.loads(resp.read())
+
+    def _submit_write(
+        self,
+        key: str,
+        method: str,
+        path: str,
+        body,
+        content_type: str = "application/json",
+    ) -> Future:
+        """Route a write to the pool worker owning ``key``. All writes
+        for one object land on one worker's FIFO queue, so per-object
+        ordering is preserved no matter how many caller threads write
+        concurrently; distinct objects spread across the pool."""
+        if not self._pool:
+            with self._pool_lock:
+                if not self._pool:
+                    workers = []
+                    for _ in range(self._write_workers):
+                        w = _PooledWriter(
+                            self.base_url, self._token, self._context,
+                            self._timeout,
+                        )
+                        w.start()
+                        workers.append(w)
+                    # single assignment: no partially-built pool visible
+                    self._pool = workers
+        fut: Future = Future()
+        worker = self._pool[hash(key) % len(self._pool)]
+        worker.queue.put((method, path, body, content_type, fut))
+        return fut
+
+    def _write(
+        self,
+        key: str,
+        method: str,
+        path: str,
+        body,
+        content_type: str = "application/json",
+    ) -> bool:
+        return self._submit_write(key, method, path, body, content_type).result()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -334,6 +603,7 @@ class KubeClusterClient:
             except (TypeError, ValueError):
                 return 0
 
+        self._mark_event_stream_restart()  # the list IS a replay
         for obj in sorted(raw, key=rv_of):
             self._apply_event("ADDED", obj)
         self._rvs["events"] = rv
@@ -448,6 +718,12 @@ class KubeClusterClient:
         for t in self._threads:
             t.join(timeout=0.2)
         self._threads.clear()
+        with self._pool_lock:
+            pool, self._pool = self._pool, []
+        for w in pool:
+            w.queue.put(None)  # drains queued writes first (FIFO)
+        for w in pool:
+            w.join(timeout=2.0)
 
     def _watch_loop(
         self,
@@ -558,6 +834,13 @@ class KubeClusterClient:
         else:
             self.nrt_lister.upsert(nrt)
 
+    def _mark_event_stream_restart(self) -> None:
+        """A new events stream (watch (re)connect or relist) may replay
+        a prefix of already-applied history: rvs at or below the
+        watermark inside this window are replays, not violations."""
+        with self._seen_lock:
+            self._event_expect_replay = True
+
     def _apply_event(self, change_type: str, obj: dict) -> None:
         if change_type == "DELETED":
             return
@@ -566,9 +849,10 @@ class KubeClusterClient:
         # not double-count. Primary dedup: the apiserver resourceVersion
         # watermark — streams deliver in rv order, so rv <= watermark is
         # a replay; exact in O(1) memory regardless of backlog size.
-        # Fallback for rv-less/non-integer-rv servers: bounded content
-        # identity (the mirror assigns its own resourceVersion, so that
-        # can't serve as a key).
+        # The content-key map runs in PARALLEL (bounded identity; the
+        # mirror assigns its own resourceVersion, so that can't key):
+        # it is the only dedup for rv-less/non-integer-rv servers, and
+        # the fallback when the monotonicity guard trips (see __init__).
         server_rv = obj.get("metadata", {}).get("resourceVersion")
         rv_int = None
         if server_rv is not None:
@@ -576,27 +860,46 @@ class KubeClusterClient:
                 rv_int = int(server_rv)
             except (TypeError, ValueError):
                 rv_int = None
-        if rv_int is not None:
-            with self._seen_lock:
+        key = (
+            event.namespace,
+            event.name,
+            event.count,
+            event.last_timestamp,
+            event.event_time,
+            event.message,
+        )
+        deliver = False
+        with self._seen_lock:
+            if rv_int is not None and self._event_rv_trusted:
                 if rv_int <= self._event_rv_watermark:
-                    return
-                self._event_rv_watermark = rv_int
-        else:
-            key = (
-                event.namespace,
-                event.name,
-                event.count,
-                event.last_timestamp,
-                event.event_time,
-                event.message,
-            )
-            with self._seen_lock:
+                    if self._event_expect_replay:
+                        return  # replayed prefix after a (re)connect
+                    # rv went BACKWARD on a live stream: the server's
+                    # integer rvs are not monotonic — never trust them
+                    # again; this event falls through to content dedup
+                    # (so it is NOT dropped if genuinely fresh)
+                    self._event_rv_trusted = False
+                else:
+                    self._event_rv_watermark = rv_int
+                    # past the watermark => past any replayed prefix
+                    self._event_expect_replay = False
+                    self._record_seen_locked(key)
+                    deliver = True  # fresh rv wins even on a content
+                    # collision: monotonic rvs mean new, and identical
+                    # payloads DO recur (informers deliver them too)
+            if not deliver:
+                # content-key path: rv-less, non-integer, or untrusted
                 if key in self._seen_events:
                     return
-                if len(self._seen_events) >= self._seen_events_cap:
-                    self._seen_events.pop(next(iter(self._seen_events)))
-                self._seen_events[key] = None
+                self._record_seen_locked(key)
         self._mirror.emit_event(event)
+
+    def _record_seen_locked(self, key: tuple) -> None:
+        if key in self._seen_events:
+            return
+        if len(self._seen_events) >= self._seen_events_cap:
+            self._seen_events.pop(next(iter(self._seen_events)))
+        self._seen_events[key] = None
 
     # -- reads: the informer mirror ---------------------------------------
 
@@ -654,21 +957,21 @@ class KubeClusterClient:
     # annotator's worker/ticker threads rely on skip-and-retry — an
     # escaping URLError would silently kill them for the process
     # lifetime. HTTP errors, refused connections, and timeouts all
-    # report False (the workqueue backs off and retries).
-    _WRITE_ERRORS = (urllib.error.URLError, OSError)
+    # report False (the workqueue backs off and retries). Every write
+    # rides the keep-alive worker pool (``concurrent_syncs`` workers,
+    # ref: controller.go:74-77), routed by object key so per-object
+    # ordering holds while distinct objects write in parallel.
 
     def patch_node_annotation(self, name: str, key: str, value: str) -> bool:
         """Annotation merge-patch (ref: node.go:123-146)."""
         body = {"metadata": {"annotations": {key: value}}}
-        try:
-            with self._request(
-                "PATCH",
-                f"/api/v1/nodes/{name}",
-                body,
-                content_type="application/merge-patch+json",
-            ):
-                pass
-        except self._WRITE_ERRORS:
+        if not self._write(
+            name,
+            "PATCH",
+            f"/api/v1/nodes/{name}",
+            body,
+            "application/merge-patch+json",
+        ):
             return False
         # optimistic local apply: the writer's next read sees its write
         # (the watch will deliver the authoritative object too). The API
@@ -683,37 +986,43 @@ class KubeClusterClient:
         whole sweep's keys (vs one HTTP round-trip per (node, key) — the
         reference pays 2x|nodes|x|syncPolicy| PATCHes per cycle,
         ref: node.go:123-146; batching them per node is the rebuild's
-        sync-path win)."""
-        patched = 0
+        sync-path win). All nodes are submitted to the pool up front and
+        gathered after, so a sweep flush runs ``concurrent_syncs``-wide
+        over pooled connections instead of one fresh round-trip at a
+        time (the reference's concurrent-syncs workers over client-go's
+        shared transport, node.go:29-42)."""
+        futs = []
         for name, kv in per_node.items():
             body = {"metadata": {"annotations": dict(kv)}}
-            try:
-                with self._request(
+            futs.append((
+                name,
+                kv,
+                self._submit_write(
+                    name,
                     "PATCH",
                     f"/api/v1/nodes/{name}",
                     body,
-                    content_type="application/merge-patch+json",
-                ):
-                    pass
-            except self._WRITE_ERRORS:
-                continue
-            self._mirror.patch_node_annotations_bulk({name: kv})
-            patched += 1
+                    "application/merge-patch+json",
+                ),
+            ))
+        patched = 0
+        for name, kv, fut in futs:
+            if fut.result():
+                self._mirror.patch_node_annotations_bulk({name: kv})
+                patched += 1
         return patched
 
     def patch_pod_annotation(self, key: str, anno_key: str, value: str) -> bool:
         """PreBind's pod-annotation patch (ref: binder.go:19-65)."""
         namespace, name = key.split("/", 1)
         body = {"metadata": {"annotations": {anno_key: value}}}
-        try:
-            with self._request(
-                "PATCH",
-                f"/api/v1/namespaces/{namespace}/pods/{name}",
-                body,
-                content_type="application/merge-patch+json",
-            ):
-                pass
-        except self._WRITE_ERRORS:
+        if not self._write(
+            key,
+            "PATCH",
+            f"/api/v1/namespaces/{namespace}/pods/{name}",
+            body,
+            "application/merge-patch+json",
+        ):
             return False
         # API write succeeded; mirror apply is best-effort (watch lag —
         # the pod may not have reached the mirror yet).
@@ -751,36 +1060,27 @@ class KubeClusterClient:
                 ],
             },
         }
-        try:
-            with self._request(
-                "POST", f"/api/v1/namespaces/{pod.namespace}/pods", body
-            ):
-                pass
-        except self._WRITE_ERRORS:
+        if not self._write(
+            pod.key(), "POST", f"/api/v1/namespaces/{pod.namespace}/pods", body
+        ):
             # never raise (ClusterState.add_pod cannot fail); the pod is
             # simply not created — counted like any other failed write
             self.watch_errors += 1
             return
         self._mirror.add_pod(pod)
 
-    def bind_pod(self, pod_key: str, node_name: str, now: float | None = None) -> bool:
-        """POST the ``binding`` subresource — the scheduler's bind call.
-        The apiserver emits the Scheduled event; it reaches subscribers
-        through the event watch (the closed loop of SURVEY §3.4)."""
+    @staticmethod
+    def _binding_request(pod_key: str, node_name: str) -> tuple[str, dict]:
         namespace, name = pod_key.split("/", 1)
-        body = {
-            "metadata": {"name": name, "namespace": namespace},
-            "target": {"kind": "Node", "name": node_name},
-        }
-        try:
-            with self._request(
-                "POST",
-                f"/api/v1/namespaces/{namespace}/pods/{name}/binding",
-                body,
-            ):
-                pass
-        except self._WRITE_ERRORS:
-            return False
+        return (
+            f"/api/v1/namespaces/{namespace}/pods/{name}/binding",
+            {
+                "metadata": {"name": name, "namespace": namespace},
+                "target": {"kind": "Node", "name": node_name},
+            },
+        )
+
+    def _apply_bound(self, pod_key: str, node_name: str) -> None:
         # optimistic placement apply (no event emission here — the event
         # is the apiserver's, delivered by the watch)
         pod = self._mirror.get_pod(pod_key)
@@ -788,14 +1088,37 @@ class KubeClusterClient:
             from dataclasses import replace
 
             self._mirror.add_pod(replace(pod, node_name=node_name))
+
+    def bind_pod(self, pod_key: str, node_name: str, now: float | None = None) -> bool:
+        """POST the ``binding`` subresource — the scheduler's bind call.
+        The apiserver emits the Scheduled event; it reaches subscribers
+        through the event watch (the closed loop of SURVEY §3.4)."""
+        path, body = self._binding_request(pod_key, node_name)
+        if not self._write(pod_key, "POST", path, body):
+            return False
+        self._apply_bound(pod_key, node_name)
         return True
 
     def bind_pods(self, assignments, now: float | None = None) -> list[str]:
+        """Bind a batch: all binding POSTs are submitted to the write
+        pool up front (``concurrent_syncs`` parallel workers over
+        keep-alive connections — the kube-scheduler framework binds from
+        parallel goroutines the same way), then gathered in input order
+        so the returned bound-key list is deterministic."""
         items = (
             assignments.items() if hasattr(assignments, "items") else assignments
         )
-        return [
-            pod_key
-            for pod_key, node_name in items
-            if self.bind_pod(pod_key, node_name, now)
-        ]
+        futs = []
+        for pod_key, node_name in items:
+            path, body = self._binding_request(pod_key, node_name)
+            futs.append((
+                pod_key,
+                node_name,
+                self._submit_write(pod_key, "POST", path, body),
+            ))
+        bound = []
+        for pod_key, node_name, fut in futs:
+            if fut.result():
+                self._apply_bound(pod_key, node_name)
+                bound.append(pod_key)
+        return bound

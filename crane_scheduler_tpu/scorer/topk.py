@@ -46,6 +46,19 @@ per-level token count inverts through the same g staircase:
 with ``q = ceil((L-offset_n)/dynamic_weight)`` (0 when q > 100 or
 S_n < q). Defaults (weight 1, offsets 0) reproduce the plain grid.
 
+**Sparse level grid**: the dense grid scans every integer level in
+[0, 100·w + max_offset + 1] — 5,102 levels for an exotic
+``dynamic_weight=50`` config. But the waterline L* (the highest level
+whose cumulative token count covers P) can only land on an *achievable
+token value* ``w·d + offset_n`` (d in 0..100), on level 0, or on the
+grid top (the empty-batch sentinel): totals(L) is piecewise-constant
+between achievable values, so the max L with totals(L) >= P is always
+an interval right-endpoint = an achievable value. ``candidate_levels``
+builds that set from the offsets actually present (101·|distinct
+offsets| + 2 entries, padded to a lane multiple to bound recompiles)
+and the solver evaluates totals only there — bit-identical results,
+O(101·|offsets|·N) instead of O(100·w·N) work.
+
 Entries with ``count <= 0`` are skipped in h (the reference would panic
 on integer division by zero; a policy that does this is invalid).
 """
@@ -94,6 +107,38 @@ def hot_penalty_steps(hv_counts: Sequence[int]) -> np.ndarray:
         if len(above):
             g[x] = above[0]
     return g
+
+
+def candidate_levels(
+    dynamic_weight: int,
+    max_offset: int,
+    offsets,
+    n_levels: int,
+) -> np.ndarray | None:
+    """Sparse waterline candidates (see module docstring): achievable
+    token values ``w·d + o`` over the distinct offsets present, plus
+    level 0 (the full-capacity total lives there) and the grid top
+    (``num_pods == 0`` sentinel). Sorted ascending with ``levels[0] ==
+    0``; padded to a multiple of 128 with the top value so the jit
+    specializes per size bucket, not per offset multiset. Returns None
+    when the dense grid is at least as small (e.g. plain mode: 101
+    distinct values vs 102 dense levels)."""
+    w = int(dynamic_weight)
+    uniq = np.unique(np.clip(np.asarray(offsets, np.int64), 0, int(max_offset)))
+    if 101 * len(uniq) + 2 >= n_levels:
+        return None
+    d = np.arange(MAX_NODE_SCORE + 1, dtype=np.int64) * w
+    cand = np.unique(np.concatenate([
+        np.zeros((1,), np.int64),
+        (d[:, None] + uniq[None, :]).ravel(),
+        np.asarray([n_levels - 1], np.int64),
+    ]))
+    if len(cand) >= n_levels:
+        return None
+    pad = (-len(cand)) % 128
+    if pad:
+        cand = np.concatenate([cand, np.full((pad,), n_levels - 1, np.int64)])
+    return cand.astype(np.int32)
 
 
 @dataclass
@@ -285,8 +330,13 @@ class GangScheduler:
 
     def __call__(
         self, scores, schedulable, num_pods, capacity=None, offsets=None,
-        prior=None,
+        prior=None, sparse_levels: bool | None = None,
     ) -> GangResult:
+        """``sparse_levels``: True forces the sparse candidate grid,
+        False forces the dense one, None (default) picks whichever is
+        smaller for this call's offsets (plain mode stays dense; exotic
+        weight/offset configs go sparse). Results are bit-identical
+        either way (parity-pinned in tests/test_gang.py)."""
         scores = jnp.asarray(scores, dtype=jnp.int32)
         n = scores.shape[0]
         num_pods = int(min(int(num_pods), 2**31 - 1))
@@ -297,6 +347,23 @@ class GangScheduler:
             offsets = np.zeros((n,), dtype=np.int32)
         if prior is None:
             prior = np.zeros((n,), dtype=np.int32)
+        levels = None
+        if sparse_levels or sparse_levels is None:
+            levels = candidate_levels(
+                self._weight, self._max_offset, offsets, self._n_levels
+            )
+            if levels is None and sparse_levels:
+                # forced sparse on a config where dense is smaller:
+                # honor it anyway (parity testing hook)
+                uniq = np.unique(
+                    np.clip(np.asarray(offsets, np.int64), 0, self._max_offset)
+                )
+                d = np.arange(MAX_NODE_SCORE + 1, dtype=np.int64) * self._weight
+                levels = np.unique(np.concatenate([
+                    np.zeros((1,), np.int64),
+                    (d[:, None] + uniq[None, :]).ravel(),
+                    np.asarray([self._n_levels - 1], np.int64),
+                ])).astype(np.int32)
         out = self._jit(
             scores,
             jnp.asarray(schedulable, dtype=jnp.bool_),
@@ -304,6 +371,7 @@ class GangScheduler:
             jnp.asarray(capacity, dtype=jnp.int32),
             jnp.asarray(offsets, dtype=jnp.int32),
             jnp.asarray(prior, dtype=jnp.int32),
+            None if levels is None else jnp.asarray(levels, jnp.int32),
         )
         return GangResult(*out)
 
@@ -341,12 +409,17 @@ class GangScheduler:
         return a_table.sum(axis=1, dtype=jnp.int32)
 
     def _assign_impl(self, scores, schedulable, num_pods, capacity, offsets,
-                     prior):
+                     prior, levels=None):
         # All internal arithmetic is int32: int64 cumsum/reductions lower
         # to u32-pair reduce-windows that blow TPU vmem at 50k nodes. This
         # is exact because per-node tokens are clipped to (2^31-1)/N (so
         # level totals fit int32); the only divergence from the sequential
         # oracle would need a single node to absorb > 2^31/N pods.
+        #
+        # ``levels=None`` scans the dense grid (via ``_totals``, which
+        # Pallas overrides); a candidate array from ``candidate_levels``
+        # scans only achievable token values — bit-identical l_star (see
+        # module docstring), smaller table for exotic weight configs.
         n = scores.shape[0]
         n_levels = self._n_levels
         num_pods = jnp.minimum(num_pods, jnp.asarray(2**31 - 1)).astype(jnp.int32)
@@ -360,15 +433,24 @@ class GangScheduler:
         s = scores.astype(jnp.int32)
         offs = jnp.clip(offsets.astype(jnp.int32), 0, self._max_offset)
         pri = jnp.clip(prior.astype(jnp.int32), 0, 2**31 - 1)
-        levels = jnp.arange(n_levels, dtype=jnp.int32)
 
-        totals = self._totals(s, offs, k_cap, pri)  # [n_levels]
+        if levels is None:
+            levels = jnp.arange(n_levels, dtype=jnp.int32)
+            totals = self._totals(s, offs, k_cap, pri)  # [n_levels]
+        else:
+            levels = levels.astype(jnp.int32)  # [C], levels[0] == 0
+            a_table = self._a_table(
+                s[None, :], offs[None, :], k_cap[None, :], pri[None, :],
+                levels[:, None],
+            )
+            totals = a_table.sum(axis=1, dtype=jnp.int32)  # [C]
 
         meets = totals >= num_pods  # True for L <= L*
         l_star = jnp.max(jnp.where(meets, levels, -1))  # -1 => capacity short
 
         def full_capacity(_):
             counts = k_cap
+            # levels[0] == 0 in both grids: totals[0] = every token
             unassigned = num_pods - totals[0]
             return counts, unassigned, jnp.asarray(-1, jnp.int32)
 
@@ -380,10 +462,9 @@ class GangScheduler:
             )
             at_or_above = self._a_table(s, offs, k_cap, pri, l_star)
             exact = at_or_above - upper  # tokens exactly at L*
-            remainder = num_pods - jnp.take(
-                totals, jnp.minimum(l_star + 1, n_levels - 1)
-            )
-            remainder = jnp.where(l_star + 1 >= n_levels, num_pods, remainder)
+            # sum(upper) == totals(l_star + 1) exactly (int32 sums), so
+            # neither grid needs a dense totals lookup here
+            remainder = num_pods - jnp.sum(upper, dtype=jnp.int32)
             # exclusive prefix sum in node-index order (int32 pinned: int64
             # cumsum lowers to a vmem-hungry u32-pair reduce-window on TPU)
             prefix = jnp.cumsum(exact, dtype=jnp.int32) - exact

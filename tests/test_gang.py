@@ -296,3 +296,78 @@ def test_combined_random_parity(seed):
     run_both_combined(
         scores, schedulable, p, hv, capacity, offsets, weight, max_offset
     )
+
+
+def test_candidate_levels_shrinks_exotic_grid():
+    """Round-4 VERDICT item 7: a dynamic_weight=50 config's dense grid is
+    5,102 levels; the sparse candidate set (achievable token values only)
+    stays lane-sized. Plain mode keeps the dense grid (already minimal)."""
+    from crane_scheduler_tpu.scorer.topk import candidate_levels
+
+    levels = candidate_levels(50, 0, np.zeros(10), 50 * 100 + 2)
+    assert levels is not None
+    assert len(levels) <= 256
+    assert levels[0] == 0  # full-capacity total lives at level 0
+    assert levels[-1] == 50 * 100 + 1  # grid top (empty-batch sentinel)
+    assert (np.diff(np.unique(levels)) > 0).all()
+    # plain mode: 101 achievable values vs 102 dense levels -> dense
+    assert candidate_levels(1, 0, np.zeros(5), 102) is None
+    # diverse offsets with small weight: sparse would be BIGGER -> dense
+    assert candidate_levels(1, 100, np.arange(101), 202) is None
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_sparse_levels_random_parity(seed):
+    """Sparse candidate grid == dense grid == sequential oracle, bit for
+    bit including the waterline, on exotic weight/offset configs."""
+    rng = random.Random(4000 + seed)
+    n = rng.randint(1, 40)
+    weight = rng.choice([1, 3, 17, 50])
+    max_offset = rng.choice([0, 100, 200, 997])
+    scores = [rng.randint(0, 100) for _ in range(n)]
+    schedulable = [rng.random() > 0.2 for _ in range(n)]
+    p = rng.choice([0, rng.randint(1, 60), rng.randint(1, 300)])
+    hv = rng.choice([DEFAULT_HV, [1], [3, 7], []])
+    capacity = None
+    if rng.random() < 0.5:
+        capacity = [rng.randint(0, 10) for _ in range(n)]
+    # few distinct offsets (the combined-mode shape: topology score
+    # 100/len(zones) x weight has a handful of values)
+    pool = [rng.randint(0, max_offset) for _ in range(3)] if max_offset else [0]
+    offsets = [rng.choice(pool) for _ in range(n)]
+
+    sched = GangScheduler(hv, dynamic_weight=weight, max_offset=max_offset)
+    dense = sched(scores, schedulable, p, capacity, offsets=offsets,
+                  sparse_levels=False)
+    sparse = sched(scores, schedulable, p, capacity, offsets=offsets,
+                   sparse_levels=True)
+    want = gang_assign_oracle(
+        scores, schedulable, p, hv, capacity,
+        offsets=offsets, dynamic_weight=weight, max_offset=max_offset,
+    )
+    for got, label in ((dense, "dense"), (sparse, "sparse")):
+        np.testing.assert_array_equal(
+            np.asarray(got.counts), want.counts,
+            err_msg=f"{label}: scores={scores} p={p} w={weight} offs={offsets}",
+        )
+        assert int(got.unassigned) == want.unassigned, label
+        assert int(got.waterline) == want.waterline, (
+            f"{label}: scores={scores} p={p} w={weight} offs={offsets}"
+        )
+
+
+def test_sparse_levels_auto_picks_sparse_for_exotic_weight():
+    """Default (auto) mode uses the sparse grid when it's smaller and
+    stays bit-identical to the forced-dense solve."""
+    rng = random.Random(7)
+    n = 64
+    scores = [rng.randint(0, 100) for _ in range(n)]
+    schedulable = [True] * n
+    sched = GangScheduler(DEFAULT_HV, dynamic_weight=50, max_offset=0)
+    auto = sched(scores, schedulable, 200, offsets=[0] * n)
+    dense = sched(scores, schedulable, 200, offsets=[0] * n,
+                  sparse_levels=False)
+    np.testing.assert_array_equal(np.asarray(auto.counts),
+                                  np.asarray(dense.counts))
+    assert int(auto.waterline) == int(dense.waterline)
+    assert int(auto.unassigned) == int(dense.unassigned)

@@ -442,3 +442,181 @@ def test_event_replay_larger_than_cap_does_not_double_count(stub):
         )
     finally:
         client.stop()
+
+
+def test_write_pool_keepalive_and_bulk_parallelism(stub):
+    """Round-4 VERDICT item 1: writes ride a pool of keep-alive
+    connections instead of a fresh TCP connection per request. 3 sweeps
+    x 60 nodes = 180 PATCHes must add at most ``concurrent_syncs``
+    connections on the server side, and every patch must land."""
+    n_nodes, sweeps = 60, 3
+    for i in range(n_nodes):
+        stub.state.add_node(f"n{i:03d}", f"10.0.0.{i}")
+    client = KubeClusterClient(stub.url, concurrent_syncs=4)
+    try:
+        client.start()
+        time.sleep(1.0)  # let the async initial lists (events, NRT)
+        # open their connections before snapshotting the counter
+        with stub.state.lock:
+            conns_before = stub.state.connections
+        for s in range(sweeps):
+            per_node = {
+                f"n{i:03d}": {"cpu_usage_avg_5m": f"0.{s}{i:03d},ts"}
+                for i in range(n_nodes)
+            }
+            assert client.patch_node_annotations_bulk(per_node) == n_nodes
+        with stub.state.lock:
+            conns_after = stub.state.connections
+        assert conns_after - conns_before <= 4  # pooled, not per-request
+        # last sweep wins on every node (per-node FIFO through the pool)
+        for i in range(n_nodes):
+            anno = stub.state.nodes[f"n{i:03d}"]["metadata"]["annotations"]
+            assert anno["cpu_usage_avg_5m"] == f"0.{sweeps-1}{i:03d},ts"
+        # the mirror observed its own writes
+        assert (
+            client.get_node("n000").annotations["cpu_usage_avg_5m"]
+            == "0.2000,ts"
+        )
+    finally:
+        client.stop()
+
+
+def test_bind_pods_parallel_preserves_order_and_events(stub):
+    """bind_pods fans the binding POSTs across the pool; the returned
+    bound-key list stays in input order and the apiserver emits exactly
+    one Scheduled event per bind (no duplicate POSTs from retries)."""
+    stub.state.add_node("node-a", "10.0.0.1")
+    n = 40
+    client = KubeClusterClient(stub.url, concurrent_syncs=4)
+    try:
+        client.start()
+        keys = []
+        for i in range(n):
+            stub.state.add_pod("default", f"p{i:02d}")
+            keys.append(f"default/p{i:02d}")
+        assert _wait_until(lambda: len(client.list_pods()) == n)
+        bound = client.bind_pods([(k, "node-a") for k in keys])
+        assert bound == keys  # input order, all succeeded
+        scheduled = [e for e in stub.state.events if e["reason"] == "Scheduled"]
+        assert len(scheduled) == n
+        for k in keys:
+            assert stub.state.pods[k]["spec"]["nodeName"] == "node-a"
+        # mirror reflects the placements without waiting for the watch
+        assert all(client.get_pod(k).node_name == "node-a" for k in keys)
+    finally:
+        client.stop()
+
+
+def test_pooled_writer_retry_semantics():
+    """Send-phase transport failures (stale keep-alive) retry once for
+    every method — the server never saw a full request. Response-phase
+    failures retry only idempotent methods: a binding POST may have been
+    processed, so it reports False instead of risking a duplicate."""
+    import http.client as hc
+
+    from crane_scheduler_tpu.cluster.kube import _PooledWriter
+
+    class FakeResp:
+        def __init__(self, status=200):
+            self.status = status
+            self.will_close = False
+
+        def read(self):
+            return b"{}"
+
+    class FakeConn:
+        def __init__(self, send_fail=False, resp_fail=False, status=200):
+            self.send_fail = send_fail
+            self.resp_fail = resp_fail
+            self.status = status
+            self.requests = 0
+
+        def request(self, *a, **kw):
+            if self.send_fail:
+                raise ConnectionResetError("stale keep-alive")
+            self.requests += 1
+
+        def getresponse(self):
+            if self.resp_fail:
+                raise hc.BadStatusLine("")
+            return FakeResp(self.status)
+
+        def close(self):
+            pass
+
+    def writer(conns):
+        w = _PooledWriter("http://127.0.0.1:1", None, None, 1.0)
+        w._connect = lambda: conns.pop(0)
+        return w
+
+    # send-phase failure: retried once, POST included
+    conns = [FakeConn(send_fail=True), FakeConn()]
+    assert writer(conns)._do("POST", "/x", {}, "application/json") is True
+
+    # response-phase failure on POST: NOT retried (may have bound)
+    good = FakeConn()
+    assert (
+        writer([FakeConn(resp_fail=True), good])._do(
+            "POST", "/x", {}, "application/json"
+        )
+        is False
+    )
+    assert good.requests == 0  # second connection never used
+
+    # response-phase failure on PATCH: idempotent, retried once
+    conns = [FakeConn(resp_fail=True), FakeConn()]
+    assert writer(conns)._do("PATCH", "/x", {}, "application/json") is True
+
+    # HTTP error status -> False, no retry
+    assert writer([FakeConn(status=404)])._do(
+        "PATCH", "/x", {}, "application/json"
+    ) is False
+
+
+def test_non_monotonic_event_rvs_do_not_drop_fresh_events(stub):
+    """Round-4 VERDICT item 6: the rv watermark assumes etcd's globally
+    monotonic integer rvs, but the API contract says opaque. A server
+    emitting a FRESH event with a lower integer rv on a live stream must
+    not have it silently dropped: the monotonicity guard downgrades to
+    content-key dedup (maintained in parallel, so nothing is lost), and
+    true content duplicates still dedup afterwards."""
+    from crane_scheduler_tpu.annotator.bindings import BindingRecords
+    from crane_scheduler_tpu.annotator.events import EventIngestor
+
+    stub.state.add_node("node-a", "10.0.0.1")
+    client = KubeClusterClient(stub.url)
+    try:
+        client.start()
+        records = BindingRecords(1024, 600.0)
+        EventIngestor(client, records).start()
+
+        def ev(pod, rv, count=1):
+            stub.state.emit_event({
+                "metadata": {"namespace": "default",
+                             "name": f"{pod}.scheduled"},
+                "type": "Normal",
+                "reason": "Scheduled",
+                "message": f"Successfully assigned default/{pod} to node-a",
+                "count": count,
+                "lastTimestamp": "2026-07-30T00:00:00Z",
+            }, rv=rv)
+
+        def bound():
+            return records.get_last_node_binding_count(
+                "node-a", 600.0, NOW + 10
+            )
+
+        ev("p1", 100)
+        assert _wait_until(lambda: bound() == 1)
+        ev("p2", 5)  # fresh but BELOW the watermark: must still count
+        ev("p3", 101)
+        assert _wait_until(lambda: bound() == 3), (
+            f"fresh low-rv event dropped: bound={bound()}"
+        )
+        ev("p2", 6)  # identical content replayed: content dedup holds
+        ev("p4", 7)  # ...while distinct fresh events still land
+        assert _wait_until(lambda: bound() == 4)
+        time.sleep(0.2)
+        assert bound() == 4
+    finally:
+        client.stop()
