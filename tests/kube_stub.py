@@ -115,6 +115,13 @@ class KubeStubState:
             if rv is None:
                 self._stamp(obj)
             else:
+                # Even when the served OBJECT carries a pathological rv,
+                # the real apiserver still advances etcd's global revision
+                # on every write — the watch-history entry must be stamped
+                # with a fresh global rv or a list-then-watch client whose
+                # registration lands after this emit filters the backlog
+                # with `rv > since_rv` and silently never sees the event.
+                self._rv += 1
                 obj.setdefault("metadata", {})["resourceVersion"] = str(rv)
             self.events.append(obj)
             self._notify("events", "ADDED", obj)
