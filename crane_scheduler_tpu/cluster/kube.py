@@ -41,6 +41,7 @@ import json
 import queue
 import ssl
 import threading
+import time
 import urllib.error
 import urllib.request
 from concurrent.futures import Future
@@ -131,6 +132,49 @@ NRT_API_PATH = "/apis/topology.crane.io/v1alpha1/noderesourcetopologies"
 # retry when the failure happened before a full request reached the wire
 _IDEMPOTENT_METHODS = frozenset({"GET", "PUT", "PATCH", "DELETE"})
 
+# status-aware retry policy (the reference's workqueue re-enqueues every
+# failed sync with rate-limited backoff, node.go:35-36,68; here the
+# write worker itself absorbs the transient-status classes the apiserver
+# documents as retryable, so callers only see durable failures):
+# 429 = explicitly not processed — safe for every method, POSTs included;
+# 5xx = ambiguous (the request MAY have been applied behind a dying
+# proxy) — retried only for idempotent merge-patches, never for binds.
+_RETRYABLE_ANY = frozenset({429})
+_RETRYABLE_IDEMPOTENT = frozenset({500, 502, 503, 504})
+_MAX_STATUS_RETRIES = 3
+# retained response-body prefix: enough for an apiserver Status object's
+# message, small enough to be free on the hot path. Also caps the
+# per-retry sleep below stop()'s 2.0s worker join: worst case
+# 3 x 0.5s keeps a throttled worker's FIFO (and the shutdown sentinel
+# queued behind it) bounded instead of parking ~6s on Retry-After.
+_BODY_SNIPPET_CAP = 512
+_MAX_RETRY_SLEEP = 0.5
+
+
+class WriteResult:
+    """Outcome of one pooled write. Truthy on success so boolean callers
+    are unchanged; carries the final HTTP status, a snippet of the
+    failure body (a 409 bind conflict is now distinguishable from a 422
+    validation error or a transport failure), and the retry count."""
+
+    __slots__ = ("ok", "status", "error", "retries")
+
+    def __init__(self, ok: bool, status: int = 0, error: str = "",
+                 retries: int = 0):
+        self.ok = ok
+        self.status = status
+        self.error = error
+        self.retries = retries
+
+    def __bool__(self) -> bool:
+        return self.ok
+
+    def __repr__(self) -> str:
+        if self.ok:
+            return f"WriteResult(ok, {self.status})"
+        return (f"WriteResult(failed, status={self.status}, "
+                f"retries={self.retries}, error={self.error!r})")
+
 
 class _RawHTTPConnection:
     """Hand-rolled HTTP/1.1 keep-alive connection for the plain-http
@@ -166,6 +210,18 @@ class _RawHTTPConnection:
         )
 
     def getresponse(self):
+        try:
+            return self._getresponse()
+        except (ValueError, IndexError) as exc:
+            # malformed status line / header / chunk size: surface as an
+            # HTTPException so _do's response-phase retry classification
+            # applies (an idempotent PATCH gets its reconnect+retry)
+            # instead of escaping to the worker's blanket except
+            raise http.client.HTTPException(
+                f"malformed response: {exc!r}"
+            ) from exc
+
+    def _getresponse(self):
         line = self._rf.readline(65537)
         if not line:
             raise http.client.BadStatusLine("connection closed")
@@ -173,6 +229,7 @@ class _RawHTTPConnection:
         length = None
         chunked = False
         close = False
+        retry_after = None
         while True:
             h = self._rf.readline(65537)
             if h in (b"\r\n", b"\n", b""):
@@ -185,27 +242,44 @@ class _RawHTTPConnection:
                 close = True
             elif k == b"transfer-encoding" and b"chunked" in v.lower():
                 chunked = True
-        # drain the body now so the connection is immediately reusable
+            elif k == b"retry-after":
+                retry_after = v.decode("latin-1")
+        # drain the body now so the connection is immediately reusable,
+        # retaining a bounded prefix so failure statuses stay diagnosable
+        kept: list[bytes] = []
+        kept_len = 0
+
+        def _keep(piece: bytes):
+            nonlocal kept_len
+            if kept_len < _BODY_SNIPPET_CAP and piece:
+                kept.append(piece[: _BODY_SNIPPET_CAP - kept_len])
+                kept_len += len(kept[-1])
+
         if chunked:
             while True:
-                size = int(self._rf.readline(65537).strip() or b"0", 16)
+                # chunk size may carry extensions ("1a;ext=1"): RFC 7230
+                # says ignore them
+                size_line = self._rf.readline(65537).partition(b";")[0]
+                size = int(size_line.strip() or b"0", 16)
                 if size == 0:
                     self._rf.readline(65537)  # blank line after last chunk
                     break
-                self._rf.read(size)
+                _keep(self._rf.read(size))
                 self._rf.readline(65537)  # chunk-trailing CRLF
         elif length is not None:
-            self._rf.read(length)
+            _keep(self._rf.read(length))
         else:
             close = True  # read-to-EOF body: not reusable
 
         class _Resp:
             pass
 
+        body = b"".join(kept)
         resp = _Resp()
         resp.status = status
         resp.will_close = close
-        resp.read = lambda: b""  # already drained
+        resp.retry_after = retry_after
+        resp.read = lambda: body  # already drained; bounded prefix
         return resp
 
     def close(self):
@@ -247,6 +321,10 @@ class _PooledWriter(threading.Thread):
         self._timeout = timeout
         self._conn: http.client.HTTPConnection | None = None
         self.queue: queue.SimpleQueue = queue.SimpleQueue()
+        # per-worker failure counts by HTTP status (0 = transport);
+        # single-writer (this thread), aggregated lock-free by the
+        # client's write_failures_by_status property
+        self.status_failures: dict[int, int] = {}
 
     def _connect(self):
         import socket
@@ -273,11 +351,12 @@ class _PooledWriter(threading.Thread):
                 return
             method, path, body, content_type, fut = item
             try:
-                ok = self._do(method, path, body, content_type)
-            except Exception:  # noqa: BLE001 — a worker must never die
+                result = self._do(method, path, body, content_type)
+            except Exception as exc:  # noqa: BLE001 — a worker must never die
                 self._drop_conn()
-                ok = False
-            fut.set_result(ok)
+                self.status_failures[0] = self.status_failures.get(0, 0) + 1
+                result = WriteResult(False, 0, f"worker: {exc!r}")
+            fut.set_result(result)
 
     def _drop_conn(self) -> None:
         if self._conn is not None:
@@ -287,41 +366,91 @@ class _PooledWriter(threading.Thread):
                 pass
             self._conn = None
 
-    def _do(self, method: str, path: str, body, content_type: str) -> bool:
+    @staticmethod
+    def _retry_delay(retry_after, backoff: float) -> float:
+        """Honor a numeric Retry-After when present (capped so a
+        misbehaving server can't park a worker), else the caller's
+        exponential backoff."""
+        if retry_after:
+            try:
+                return min(max(float(retry_after), 0.0), _MAX_RETRY_SLEEP)
+            except ValueError:
+                pass  # HTTP-date form: fall through to backoff
+        return min(backoff, _MAX_RETRY_SLEEP)
+
+    def _do(self, method: str, path: str, body, content_type: str) -> WriteResult:
         data = None if body is None else json.dumps(body).encode()
         headers = {}
         if data is not None:
             headers["Content-Type"] = content_type
         if self._token:
             headers["Authorization"] = f"Bearer {self._token}"
-        for attempt in (0, 1):
+        transport_retried = False
+        status_retries = 0
+        backoff = 0.05
+        attempts = 0
+        while True:
+            attempts += 1
             if self._conn is None:
                 self._conn = self._connect()
             try:
                 self._conn.request(method, path, body=data, headers=headers)
-            except (http.client.HTTPException, OSError):
+            except (http.client.HTTPException, OSError) as exc:
                 # send-phase failure: the server never saw a complete
                 # request (the classic case is a keep-alive connection
                 # the server idle-closed between our writes) — always
                 # safe to reconnect and retry once, POSTs included
                 self._drop_conn()
-                if attempt:
-                    return False
+                if transport_retried:
+                    self.status_failures[0] = (
+                        self.status_failures.get(0, 0) + 1)
+                    return WriteResult(
+                        False, 0, f"send: {exc!r}", attempts - 1)
+                transport_retried = True
                 continue
             try:
                 resp = self._conn.getresponse()
-                resp.read()  # drain so the connection can be reused
-            except (http.client.HTTPException, OSError):
+                payload = resp.read()  # drained; bounded snippet kept
+            except (http.client.HTTPException, OSError) as exc:
                 # response-phase failure: the request may have been
                 # processed — retry only idempotent methods
                 self._drop_conn()
-                if attempt or method not in _IDEMPOTENT_METHODS:
-                    return False
+                if transport_retried or method not in _IDEMPOTENT_METHODS:
+                    self.status_failures[0] = (
+                        self.status_failures.get(0, 0) + 1)
+                    return WriteResult(
+                        False, 0, f"recv: {exc!r}", attempts - 1)
+                transport_retried = True
                 continue
             if resp.will_close:
                 self._drop_conn()
-            return 200 <= resp.status < 400
-        return False
+            # a full request/response cycle completed: the next attempt
+            # (status retry) gets a fresh send-phase retry budget — a
+            # Retry-After sleep routinely outlives the server's
+            # keep-alive idle timeout, and that idle-close send failure
+            # is always safe to retry
+            transport_retried = False
+            status = resp.status
+            # only 2xx is success: kube API writes never legitimately
+            # succeed via an unfollowed redirect — a 301/302 from an
+            # ingress means the apiserver never applied the write
+            if 200 <= status < 300:
+                return WriteResult(True, status, "", attempts - 1)
+            self.status_failures[status] = (
+                self.status_failures.get(status, 0) + 1)
+            retryable = status in _RETRYABLE_ANY or (
+                status in _RETRYABLE_IDEMPOTENT
+                and method in _IDEMPOTENT_METHODS
+            )
+            snippet = payload[:_BODY_SNIPPET_CAP].decode("utf-8", "replace")
+            if not retryable or status_retries >= _MAX_STATUS_RETRIES:
+                return WriteResult(False, status, snippet, attempts - 1)
+            status_retries += 1
+            retry_after = getattr(resp, "retry_after", None)
+            if retry_after is None and hasattr(resp, "getheader"):
+                retry_after = resp.getheader("Retry-After")
+            time.sleep(self._retry_delay(retry_after, backoff))
+            backoff = min(backoff * 2, 1.0)
 
 
 def nrt_from_json(obj: dict):
@@ -458,7 +587,11 @@ class KubeClusterClient:
         # first write (read-only clients never pay the threads)
         self._write_workers = max(1, int(concurrent_syncs))
         self._pool: list[_PooledWriter] = []
+        self._pool_closed = False
         self._pool_lock = threading.Lock()
+        # workers that have been retired by stop() — their failure
+        # counters still aggregate into write_failures_by_status
+        self._retired_pool: list[_PooledWriter] = []
 
     # -- HTTP plumbing -----------------------------------------------------
 
@@ -501,22 +634,28 @@ class KubeClusterClient:
         for one object land on one worker's FIFO queue, so per-object
         ordering is preserved no matter how many caller threads write
         concurrently; distinct objects spread across the pool."""
-        if not self._pool:
-            with self._pool_lock:
-                if not self._pool:
-                    workers = []
-                    for _ in range(self._write_workers):
-                        w = _PooledWriter(
-                            self.base_url, self._token, self._context,
-                            self._timeout,
-                        )
-                        w.start()
-                        workers.append(w)
-                    # single assignment: no partially-built pool visible
-                    self._pool = workers
         fut: Future = Future()
-        worker = self._pool[hash(key) % len(self._pool)]
-        worker.queue.put((method, path, body, content_type, fut))
+        # worker selection AND enqueue happen under the pool lock so a
+        # concurrent stop() can't swap the pool out from under us (a
+        # lock-free read raced stop(): hash % 0, or an enqueue landing
+        # AFTER the shutdown sentinel whose Future then never resolved
+        # and blocked the caller's .result() forever)
+        with self._pool_lock:
+            if self._pool_closed:
+                fut.set_result(WriteResult(False, 0, "client stopped"))
+                return fut
+            if not self._pool:
+                workers = []
+                for _ in range(self._write_workers):
+                    w = _PooledWriter(
+                        self.base_url, self._token, self._context,
+                        self._timeout,
+                    )
+                    w.start()
+                    workers.append(w)
+                self._pool = workers
+            worker = self._pool[hash(key) % len(self._pool)]
+            worker.queue.put((method, path, body, content_type, fut))
         return fut
 
     def _write(
@@ -719,11 +858,30 @@ class KubeClusterClient:
             t.join(timeout=0.2)
         self._threads.clear()
         with self._pool_lock:
+            self._pool_closed = True
             pool, self._pool = self._pool, []
+            self._retired_pool.extend(pool)
         for w in pool:
             w.queue.put(None)  # drains queued writes first (FIFO)
         for w in pool:
             w.join(timeout=2.0)
+
+    @property
+    def write_failures_by_status(self) -> dict[int, int]:
+        """Aggregate failed-write counts by HTTP status across the pool
+        (0 = transport-level failure). Observability the reference leaves
+        to client-go logs; a 409 bind conflict is countable separately
+        from a 5xx or a dead connection."""
+        with self._pool_lock:
+            workers = list(self._pool) + list(self._retired_pool)
+        out: dict[int, int] = {}
+        for w in workers:
+            # snapshot before iterating: the worker thread may insert a
+            # first-seen status key mid-iteration (dict(d) is a single
+            # C-level copy, safe against concurrent inserts)
+            for status, n in dict(w.status_failures).items():
+                out[status] = out.get(status, 0) + n
+        return out
 
     def _watch_loop(
         self,

@@ -51,6 +51,22 @@ class KubeStubState:
         # pagination tokens -> (remaining items, snapshot rv)
         self._continues: dict[str, tuple[list[dict], str]] = {}
         self._continue_seq = 0
+        # injected write faults, served FIFO: each entry is
+        # (status, payload_dict, extra_headers) answered to the next
+        # PATCH/POST (non-control) request INSTEAD of normal handling
+        self.write_faults: deque = deque()
+
+    def inject_write_faults(self, *faults):
+        """Queue canned failure responses for upcoming write requests.
+        Each fault: (status, payload) or (status, payload, headers) —
+        e.g. (429, {...}, {"Retry-After": "0.1"}) or
+        (301, {}, {"Location": "/elsewhere"})."""
+        with self.lock:
+            for f in faults:
+                status, payload, *rest = f
+                self.write_faults.append(
+                    (int(status), payload or {}, (rest[0] if rest else {}))
+                )
 
     # -- mutations (each stamps a resourceVersion + history entry) ---------
 
@@ -217,13 +233,25 @@ def _make_handler(state: KubeStubState):
             except TimeoutError:
                 self.close_connection = True
 
-        def _send_raw(self, code: int, body: bytes):
+        def _send_raw(self, code: int, body: bytes,
+                      extra_headers: dict | None = None):
             # single-write response, skipping BaseHTTPRequestHandler's
             # Server/Date header formatting (hot-path cost per response)
+            extra = b""
+            for k, v in (extra_headers or {}).items():
+                extra += f"{k}: {v}\r\n".encode("latin-1")
             self.wfile.write(
                 b"HTTP/1.1 %d OK\r\nContent-Type: application/json\r\n"
-                b"Content-Length: %d\r\n\r\n" % (code, len(body)) + body
+                b"Content-Length: %d\r\n" % (code, len(body))
+                + extra + b"\r\n" + body
             )
+
+        def _pop_write_fault(self):
+            """Serve one injected fault (body already read) or None."""
+            with state.lock:
+                if state.write_faults:
+                    return state.write_faults.popleft()
+            return None
 
         def _json(self, code: int, payload: dict):
             self._send_raw(code, json.dumps(payload).encode())
@@ -454,6 +482,11 @@ def _make_handler(state: KubeStubState):
             # client writers aren't serialized on response I/O
             state.requests.append(("PATCH", self.path))
             body = self._read_body()
+            fault = self._pop_write_fault()
+            if fault is not None:
+                status, payload, headers = fault
+                return self._send_raw(
+                    status, json.dumps(payload).encode(), headers)
             annotations = body.get("metadata", {}).get("annotations", {})
             parts = self.path.strip("/").split("/")
             code, payload, raw = 404, {"message": "bad patch path"}, None
@@ -501,6 +534,12 @@ def _make_handler(state: KubeStubState):
             body = self._read_body()
             parts = self.path.strip("/").split("/")
             code, payload = 404, {"message": "bad post path"}
+            if parts[0] != "__stub":
+                fault = self._pop_write_fault()
+                if fault is not None:
+                    status, fault_payload, headers = fault
+                    return self._send_raw(
+                        status, json.dumps(fault_payload).encode(), headers)
             if parts[0] == "__stub":
                 # control endpoints for subprocess mode
                 if parts[1] == "seed":
