@@ -141,6 +141,10 @@ _IDEMPOTENT_METHODS = frozenset({"GET", "PUT", "PATCH", "DELETE"})
 # proxy) — retried only for idempotent merge-patches, never for binds.
 _RETRYABLE_ANY = frozenset({429})
 _RETRYABLE_IDEMPOTENT = frozenset({500, 502, 503, 504})
+# batches at least this large ride the native C++ flush engine (when
+# built and the scheme is plain http): below it, thread spawn + connect
+# overhead beats the GIL savings
+_NATIVE_FLUSH_MIN = 128
 _MAX_STATUS_RETRIES = 3
 # retained response-body prefix: enough for an apiserver Status object's
 # message, small enough to be free on the hot path. Also caps the
@@ -176,23 +180,50 @@ class WriteResult:
                 f"retries={self.retries}, error={self.error!r})")
 
 
+class _RawResponse:
+    """Pre-drained response for _RawHTTPConnection (module-level: the
+    hot path must not pay __build_class__ per response)."""
+
+    __slots__ = ("status", "will_close", "retry_after", "_body")
+
+    def __init__(self, status: int, will_close: bool, retry_after, body: bytes):
+        self.status = status
+        self.will_close = will_close
+        self.retry_after = retry_after
+        self._body = body
+
+    def read(self) -> bytes:
+        return self._body  # already drained; bounded prefix
+
+
 class _RawHTTPConnection:
-    """Hand-rolled HTTP/1.1 keep-alive connection for the plain-http
-    write path. http.client routes every response's headers through
+    """Hand-rolled HTTP/1.1 keep-alive connection for the pooled write
+    path. http.client routes every response's headers through
     email.feedparser (~100us of pure-Python work per response), which
     at annotation-storm rates makes the CLIENT the throughput cap; this
     builds each request in one ``sendall`` and parses responses with a
     minimal reader. Exposes the http.client subset ``_PooledWriter``
-    uses (``request``/``getresponse``/``close``); https keeps
-    http.client + TLS."""
+    uses (``request``/``getresponse``/``close``).
 
-    def __init__(self, host: str, port: int | None, timeout: float):
+    With ``context`` the same framing runs over an ``ssl``-wrapped
+    socket: after the one-time handshake, a TLS record wrap/unwrap is
+    OpenSSL C code — orders cheaper than http.client's per-response
+    Python parsing — so the production https path (the reference's
+    client-go always talks TLS, options.go:91-136) inherits the same
+    fast path as plain http instead of falling back to
+    http.client.HTTPSConnection."""
+
+    def __init__(self, host: str, port: int | None, timeout: float,
+                 context: ssl.SSLContext | None = None):
         import socket
 
         self._sock = socket.create_connection(
-            (host, port or 80), timeout=timeout
+            (host, port or (443 if context is not None else 80)),
+            timeout=timeout,
         )
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if context is not None:
+            self._sock = context.wrap_socket(self._sock, server_hostname=host)
         self._rf = self._sock.makefile("rb")
         self._host_hdr = f"{host}:{port}" if port else host
 
@@ -271,16 +302,7 @@ class _RawHTTPConnection:
         else:
             close = True  # read-to-EOF body: not reusable
 
-        class _Resp:
-            pass
-
-        body = b"".join(kept)
-        resp = _Resp()
-        resp.status = status
-        resp.will_close = close
-        resp.retry_after = retry_after
-        resp.read = lambda: body  # already drained; bounded prefix
-        return resp
+        return _RawResponse(status, close, retry_after, b"".join(kept))
 
     def close(self):
         try:
@@ -327,19 +349,16 @@ class _PooledWriter(threading.Thread):
         self.status_failures: dict[int, int] = {}
 
     def _connect(self):
-        import socket
-
         if self._scheme == "https":
-            conn = http.client.HTTPSConnection(
-                self._host, self._port, timeout=self._timeout,
-                context=self._context,
+            # same raw framing over an ssl-wrapped socket (TCP_NODELAY
+            # set before the wrap; every production HTTP client,
+            # client-go included, disables Nagle on pooled connections)
+            context = self._context
+            if context is None:
+                context = ssl.create_default_context()
+            return _RawHTTPConnection(
+                self._host, self._port, self._timeout, context=context
             )
-            conn.connect()
-            # keep-alive + Nagle + delayed ACK = ~40ms/request stalls;
-            # every production HTTP client (client-go included, via Go's
-            # net.Dial defaults) disables Nagle on pooled connections
-            conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            return conn
         return _RawHTTPConnection(self._host, self._port, self._timeout)
 
     def run(self) -> None:
@@ -542,9 +561,19 @@ class KubeClusterClient:
         concurrent_syncs: int = 4,
     ):
         self.base_url = base_url.rstrip("/")
+        u = urlsplit(self.base_url)
+        self._scheme = u.scheme
+        self._host = u.hostname or "127.0.0.1"
+        self._port = u.port
         self._token = token
         self._context = context
         self._timeout = timeout
+        # native bulk flusher (GIL-free C++ fan-out for large batches;
+        # plain-http only): built lazily, None-and-disabled on failure
+        self._native_flusher = None
+        self._native_flush_disabled = False
+        self._native_status_failures: dict[int, int] = {}
+        self._native_lock = threading.Lock()
         self._mirror = ClusterState()
         from ..topology.types import InMemoryNRTLister
 
@@ -866,6 +895,46 @@ class KubeClusterClient:
         for w in pool:
             w.join(timeout=2.0)
 
+    # -- native bulk flush -------------------------------------------------
+
+    def _get_native_flusher(self):
+        """The C++ bulk flush engine (native/crane_native.cpp
+        crane_http_flush), or None when the scheme is https, the batch
+        machinery failed to build, or the library is unavailable. The
+        Python pool stays the slow path and the owner of status-based
+        retry semantics."""
+        if self._native_flush_disabled or self._scheme != "http":
+            return None
+        with self._native_lock:
+            if self._native_flusher is None and not self._native_flush_disabled:
+                try:
+                    from ..native.httpflush import NativeHTTPFlusher
+
+                    self._native_flusher = NativeHTTPFlusher(
+                        self._host, self._port or 80,
+                        workers=max(self._write_workers, 8),
+                        timeout=self._timeout,
+                    )
+                except (RuntimeError, OSError):
+                    self._native_flush_disabled = True
+            return self._native_flusher
+
+    def _render_request(self, method: str, path: str, body: dict,
+                        content_type: str = "application/json") -> bytes:
+        data = json.dumps(body).encode()
+        host = f"{self._host}:{self._port}" if self._port else self._host
+        auth = f"Authorization: Bearer {self._token}\r\n" if self._token else ""
+        return (
+            f"{method} {path} HTTP/1.1\r\nHost: {host}\r\n"
+            f"Content-Length: {len(data)}\r\n"
+            f"Content-Type: {content_type}\r\n{auth}\r\n"
+        ).encode("latin-1") + data
+
+    def _count_native_failure(self, status: int) -> None:
+        with self._native_lock:
+            self._native_status_failures[status] = (
+                self._native_status_failures.get(status, 0) + 1)
+
     @property
     def write_failures_by_status(self) -> dict[int, int]:
         """Aggregate failed-write counts by HTTP status across the pool
@@ -880,6 +949,9 @@ class KubeClusterClient:
             # first-seen status key mid-iteration (dict(d) is a single
             # C-level copy, safe against concurrent inserts)
             for status, n in dict(w.status_failures).items():
+                out[status] = out.get(status, 0) + n
+        with self._native_lock:
+            for status, n in self._native_status_failures.items():
                 out[status] = out.get(status, 0) + n
         return out
 
@@ -1148,9 +1220,56 @@ class KubeClusterClient:
         gathered after, so a sweep flush runs ``concurrent_syncs``-wide
         over pooled connections instead of one fresh round-trip at a
         time (the reference's concurrent-syncs workers over client-go's
-        shared transport, node.go:29-42)."""
+        shared transport, node.go:29-42).
+
+        Batches of >= _NATIVE_FLUSH_MIN over plain http ride the C++
+        flush engine instead: the whole storm is one GIL-releasing call
+        (send/parse/drain in native worker threads), with engine
+        failures re-driven through the Python pool so they keep its
+        status-aware retry/backoff semantics. Merge-patch ordering note:
+        the annotator is the only node-annotation writer and flushes
+        from one thread, so bypassing the per-key FIFO pool for the
+        batch cannot reorder writes to a node."""
+        items = list(per_node.items())
+        patched = 0
+        if len(items) >= _NATIVE_FLUSH_MIN:
+            flusher = self._get_native_flusher()
+            if flusher is not None:
+                reqs = [
+                    self._render_request(
+                        "PATCH",
+                        f"/api/v1/nodes/{name}",
+                        {"metadata": {"annotations": dict(kv)}},
+                        "application/merge-patch+json",
+                    )
+                    for name, kv in items
+                ]
+                statuses = flusher.flush(reqs, idempotent=True)
+                retry_items = []
+                ok_updates: dict[str, dict] = {}
+                for (name, kv), status in zip(items, statuses.tolist()):
+                    if 200 <= status < 300:
+                        ok_updates[name] = kv
+                    elif status == 0 or status in _RETRYABLE_ANY \
+                            or status in _RETRYABLE_IDEMPOTENT:
+                        # transport loss / transient status: re-drive
+                        # through the pool, which owns backoff +
+                        # Retry-After (transient statuses count here,
+                        # matching the pool's per-occurrence counting;
+                        # transport absorptions don't, also matching)
+                        if status:
+                            self._count_native_failure(int(status))
+                        retry_items.append((name, kv))
+                    else:
+                        # durable failure (404/422/...): count ONCE and
+                        # drop — the pool wouldn't retry it either
+                        self._count_native_failure(int(status))
+                if ok_updates:
+                    self._mirror.patch_node_annotations_bulk(ok_updates)
+                    patched += len(ok_updates)
+                items = retry_items  # slow path owns retries/backoff
         futs = []
-        for name, kv in per_node.items():
+        for name, kv in items:
             body = {"metadata": {"annotations": dict(kv)}}
             futs.append((
                 name,
@@ -1163,7 +1282,6 @@ class KubeClusterClient:
                     "application/merge-patch+json",
                 ),
             ))
-        patched = 0
         for name, kv, fut in futs:
             if fut.result():
                 self._mirror.patch_node_annotations_bulk({name: kv})
@@ -1263,9 +1381,41 @@ class KubeClusterClient:
         keep-alive connections — the kube-scheduler framework binds from
         parallel goroutines the same way), then gathered in input order
         so the returned bound-key list is deterministic."""
-        items = (
+        items = list(
             assignments.items() if hasattr(assignments, "items") else assignments
         )
+        bound = []
+        if len(items) >= _NATIVE_FLUSH_MIN:
+            flusher = self._get_native_flusher()
+            if flusher is not None:
+                # binding POSTs are NOT idempotent: the engine retries
+                # send-phase failures only, and nothing is re-driven
+                # through the pool afterwards (a response-phase loss is
+                # ambiguous — re-POSTing could double-bind; callers own
+                # reconciliation, exactly as with a pool failure)
+                reqs = []
+                for pod_key, node_name in items:
+                    path, body = self._binding_request(pod_key, node_name)
+                    reqs.append(self._render_request("POST", path, body))
+                statuses = flusher.flush(reqs, idempotent=False)
+                retry_binds = []
+                for (pod_key, node_name), status in zip(
+                    items, statuses.tolist()
+                ):
+                    if 200 <= status < 300:
+                        self._apply_bound(pod_key, node_name)
+                        bound.append(pod_key)
+                    elif status in _RETRYABLE_ANY:
+                        # 429 = explicitly not processed: safe to
+                        # re-POST through the pool (it honors
+                        # Retry-After/backoff even for POSTs)
+                        self._count_native_failure(int(status))
+                        retry_binds.append((pod_key, node_name))
+                    else:
+                        self._count_native_failure(int(status))
+                items = retry_binds
+                if not items:
+                    return bound
         futs = []
         for pod_key, node_name in items:
             path, body = self._binding_request(pod_key, node_name)
@@ -1274,7 +1424,6 @@ class KubeClusterClient:
                 node_name,
                 self._submit_write(pod_key, "POST", path, body),
             ))
-        bound = []
         for pod_key, node_name, fut in futs:
             if fut.result():
                 self._apply_bound(pod_key, node_name)

@@ -1,0 +1,64 @@
+"""Native bulk HTTP flusher: GIL-free fan-out of pre-rendered requests.
+
+The reference's annotation writes go through client-go from compiled Go
+(ref: pkg/controller/annotator/node.go:123-146) — framing, response
+parsing and connection pooling never touch an interpreter lock. The
+Python pooled writer is capped by per-request GIL work (~80us on one
+core no matter how many worker threads). This wrapper hands a whole
+batch of pre-rendered HTTP/1.1 requests to ``crane_http_flush``
+(native/crane_native.cpp): C++ worker threads send/parse/drain over
+keep-alive connections while the single ctypes call releases the GIL.
+
+Plain-http only (IPv4). TLS and sub-batch writes ride the Python pool
+(cluster/kube.py), which also owns status-based retry/backoff — this
+engine does transport-level retries only and reports per-request
+statuses for the caller to triage.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import socket
+
+import numpy as np
+
+from .lib import load_native
+
+
+class NativeHTTPFlusher:
+    def __init__(self, host: str, port: int, workers: int = 8,
+                 timeout: float = 30.0):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("libcrane_native unavailable")
+        self._lib = lib
+        # the C engine takes an IPv4 literal; resolve once up front
+        self._ip = socket.gethostbyname(host).encode("ascii")
+        self._port = int(port)
+        self._workers = int(workers)
+        self._timeout_ms = max(1, int(timeout * 1000))
+
+    def flush(self, requests: list[bytes], idempotent: bool = True) -> np.ndarray:
+        """Send every request; return the per-request HTTP statuses
+        (0 = transport failure after the engine's own retry policy:
+        send-phase failures retry once for all methods, response-phase
+        failures only when ``idempotent``)."""
+        n = len(requests)
+        statuses = np.zeros(n, np.int32)
+        if n == 0:
+            return statuses
+        blob = b"".join(requests)
+        offsets = np.zeros(n + 1, np.int64)
+        np.cumsum([len(r) for r in requests], out=offsets[1:])
+        self._lib.crane_http_flush(
+            self._ip,
+            self._port,
+            blob,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n,
+            self._workers,
+            1 if idempotent else 0,
+            self._timeout_ms,
+            statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        return statuses

@@ -49,6 +49,11 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_uint8),
     ]
     lib.crane_render_f5.argtypes = [p_f64, i64, ctypes.c_char_p, p_i64]
+    lib.crane_http_flush.argtypes = [
+        ctypes.c_char_p, i32, ctypes.c_char_p, p_i64, i64, i32, i32, i32,
+        ctypes.POINTER(i32),
+    ]
+    lib.crane_http_flush.restype = i64
     return lib
 
 
@@ -73,6 +78,23 @@ def load_native():
                 return None
         try:
             _lib = _configure(ctypes.CDLL(_SO_PATH))
+        except AttributeError:
+            # stale prebuilt .so missing newer symbols: rebuild once and
+            # reload (make rewrites the file -> new inode -> dlopen
+            # loads fresh); degrade to None rather than crash consumers
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR, "clean"],
+                    check=True, capture_output=True, timeout=120,
+                )
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR],
+                    check=True, capture_output=True, timeout=120,
+                )
+                _lib = _configure(ctypes.CDLL(_SO_PATH))
+            except (OSError, AttributeError, subprocess.SubprocessError):
+                _lib = None
+                return None
         except OSError:
             return None
         return _lib

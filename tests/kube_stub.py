@@ -21,6 +21,11 @@ import threading
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+_HERE = os.path.dirname(os.path.abspath(__file__))
+# self-signed localhost cert for TLS mode (committed fixtures)
+STUB_CERT_PATH = os.path.join(_HERE, "stub_cert.pem")
+STUB_KEY_PATH = os.path.join(_HERE, "stub_key.pem")
+
 
 class KubeStubState:
     # history entries older than this are compacted away; a watch resume
@@ -180,6 +185,12 @@ def _make_handler(state: KubeStubState):
         disable_nagle_algorithm = True
 
         def setup(self):
+            ctx = getattr(self.server, "ssl_context", None)
+            if ctx is not None:
+                # per-connection TLS wrap in THIS handler thread: the
+                # handshake (the expensive part) parallelizes across
+                # connections like a real apiserver's
+                self.request = ctx.wrap_socket(self.request, server_side=True)
             super().setup()
             with state.lock:
                 state.connections += 1
@@ -230,7 +241,9 @@ def _make_handler(state: KubeStubState):
                 else:
                     method()
                 self.wfile.flush()
-            except TimeoutError:
+            except (TimeoutError, OSError):
+                # OSError covers TLS teardown (SSLEOFError etc.) when
+                # stop() severs sockets under live handlers
                 self.close_connection = True
 
         def _send_raw(self, code: int, body: bytes,
@@ -630,12 +643,45 @@ def _make_handler(state: KubeStubState):
 
 class _Server(ThreadingHTTPServer):
     daemon_threads = True  # lingering watch handlers must not block close
+    ssl_context = None  # set for TLS mode; handlers wrap per-connection
 
 
 class KubeStubServer:
-    def __init__(self):
+    def __init__(self, tls: bool = False, reuse_port: int | None = None):
         self.state = KubeStubState()
-        self._server = _Server(("127.0.0.1", 0), _make_handler(self.state))
+        self.tls = tls
+        if reuse_port is None:
+            self._server = _Server(("127.0.0.1", 0), _make_handler(self.state))
+        else:
+            # SO_REUSEPORT shard: several stub PROCESSES bind the same
+            # port and the kernel distributes client connections across
+            # them — a multi-core "apiserver" for write-throughput
+            # benchmarks (a real apiserver is Go on many cores; one
+            # Python process caps ~6k req/s on its GIL). Each shard has
+            # the FULL node set; per-object key routing in the client
+            # gives pods shard affinity (created and bound over the same
+            # connection). Cross-shard watch resume is NOT coherent
+            # (each shard has its own rv counter) — sharded mode is for
+            # write-path measurement, not watch-reconnect semantics.
+            self._server = _Server(
+                ("127.0.0.1", reuse_port), _make_handler(self.state),
+                bind_and_activate=False,
+            )
+            self._server.allow_reuse_port = True
+            self._server.server_bind()
+            self._server.server_activate()
+        self._control_server = None
+        if tls:
+            # self-signed localhost cert committed next to this stub
+            # (100y validity); clients verify against the same file.
+            # The context hangs off the server: each handler THREAD
+            # wraps its own accepted socket, so TLS handshakes run in
+            # parallel instead of serializing the accept loop.
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(STUB_CERT_PATH, STUB_KEY_PATH)
+            self._server.ssl_context = ctx
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True
         )
@@ -643,6 +689,17 @@ class KubeStubServer:
     @property
     def url(self) -> str:
         host, port = self._server.server_address
+        scheme = "https" if self.tls else "http"
+        return f"{scheme}://{host}:{port}"
+
+    def attach_control_listener(self) -> str:
+        """Second listener (private port) over the SAME state: lets a
+        benchmark address one specific SO_REUSEPORT shard (seed, stats)
+        when the shared port's kernel routing picks shards arbitrarily."""
+        ctl = _Server(("127.0.0.1", 0), _make_handler(self.state))
+        threading.Thread(target=ctl.serve_forever, daemon=True).start()
+        self._control_server = ctl
+        host, port = ctl.server_address
         return f"http://{host}:{port}"
 
     def start(self):
@@ -652,6 +709,9 @@ class KubeStubServer:
     def stop(self):
         self._server.shutdown()
         self._server.server_close()
+        if self._control_server is not None:
+            self._control_server.shutdown()
+            self._control_server.server_close()
         # sever established keep-alive connections too: handler threads
         # are daemons and would otherwise keep serving pooled clients
         # after "server death" (a real apiserver's exit closes these)
@@ -680,49 +740,96 @@ class KubeStubSubprocess:
     the ``/__stub/*`` control endpoints replace direct state access.
     """
 
-    def __init__(self, null: bool = False):
+    def __init__(self, null: bool = False, shards: int = 1):
         import subprocess
         import sys
 
-        args = [sys.executable, os.path.abspath(__file__), "--serve"]
-        if null:
-            args.append("--null")  # NullAPIServer: client-ceiling mode
-        self._proc = subprocess.Popen(
-            args,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.DEVNULL,
-            text=True,
-        )
-        self.url = self._proc.stdout.readline().strip()
-        if not self.url.startswith("http"):
-            raise RuntimeError(f"stub subprocess failed: {self.url!r}")
+        self._procs: list = []
+        self.control_urls: list[str] = []
+        self.url = ""
+        shards = max(1, int(shards))
+        port = 0
+        for i in range(shards):
+            args = [sys.executable, os.path.abspath(__file__), "--serve"]
+            if null:
+                args.append("--null")  # NullAPIServer: client-ceiling mode
+            if shards > 1:
+                args += ["--reuse-port", str(port)]
+            proc = subprocess.Popen(
+                args,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL,
+                text=True,
+            )
+            line = proc.stdout.readline().strip()
+            parts = line.split()
+            if not parts or not parts[0].startswith("http"):
+                proc.terminate()
+                for p in self._procs:
+                    p.terminate()
+                raise RuntimeError(f"stub subprocess failed: {line!r}")
+            self._procs.append(proc)
+            if shards > 1:
+                # "shared_url control_url": the shared port is identical
+                # across shards (SO_REUSEPORT); controls are per-shard
+                self.url = parts[0]
+                self.control_urls.append(parts[1])
+                if i == 0:
+                    port = int(parts[0].rsplit(":", 1)[1])
+            else:
+                self.url = parts[0]
+                self.control_urls.append(parts[0])
 
-    def _control(self, path: str, body: dict | None = None) -> dict:
+    def _control(self, path: str, body: dict | None = None,
+                 base: str | None = None) -> dict:
         import urllib.request
 
         req = urllib.request.Request(
-            self.url + path,
+            (base or self.url) + path,
             method="POST" if body is not None else "GET",
             data=None if body is None else json.dumps(body).encode(),
         )
         with urllib.request.urlopen(req, timeout=120) as resp:  # noqa: S310
             return json.loads(resp.read())
 
+    def _control_all(self, path: str, body: dict | None = None) -> list[dict]:
+        return [self._control(path, body, base=u) for u in self.control_urls]
+
     def seed(self, nodes: int, prefix: str = "node-") -> dict:
-        return self._control("/__stub/seed", {"nodes": nodes, "prefix": prefix})
+        # every shard holds the full node set (a patch routed to any
+        # shard must find its node)
+        return self._control_all(
+            "/__stub/seed", {"nodes": nodes, "prefix": prefix}
+        )[0]
 
     def stats(self) -> dict:
-        return self._control("/__stub/stats")
+        """Aggregated across shards: request counts and connections sum;
+        per-shard request totals reported under ``shard_requests`` so a
+        benchmark can see the SO_REUSEPORT spread."""
+        per = self._control_all("/__stub/stats")
+        if len(per) == 1:
+            return per[0]
+        agg: dict = {"requests": {}, "connections": 0, "shard_requests": []}
+        for s in per:
+            for k, v in s.get("requests", {}).items():
+                agg["requests"][k] = agg["requests"].get(k, 0) + v
+            agg["connections"] += s.get("connections", 0)
+            agg["shard_requests"].append(
+                sum(s.get("requests", {}).values())
+            )
+        return agg
 
     def close_watches(self) -> None:
-        self._control("/__stub/close_watches", {})
+        self._control_all("/__stub/close_watches", {})
 
     def add_node(self, name: str, ip: str = "10.0.0.1") -> None:
-        self._control("/__stub/add_node", {"name": name, "ip": ip})
+        self._control_all("/__stub/add_node", {"name": name, "ip": ip})
 
     def stop(self):
-        self._proc.terminate()
-        self._proc.wait(timeout=10)
+        for p in self._procs:
+            p.terminate()
+        for p in self._procs:
+            p.wait(timeout=10)
 
 
 class NullAPIServer:
@@ -800,9 +907,15 @@ if __name__ == "__main__":
     import sys
 
     if "--serve" in sys.argv:
-        _srv = (
-            NullAPIServer().start() if "--null" in sys.argv
-            else KubeStubServer().start()
-        )
-        print(_srv.url, flush=True)
+        if "--null" in sys.argv:
+            _srv = NullAPIServer().start()
+            print(_srv.url, flush=True)
+        elif "--reuse-port" in sys.argv:
+            _port = int(sys.argv[sys.argv.index("--reuse-port") + 1])
+            _srv = KubeStubServer(reuse_port=_port).start()
+            _ctl_url = _srv.attach_control_listener()
+            print(_srv.url, _ctl_url, flush=True)
+        else:
+            _srv = KubeStubServer().start()
+            print(_srv.url, flush=True)
         threading.Event().wait()  # serve until terminated

@@ -755,3 +755,191 @@ def test_raw_connection_chunk_extensions_and_diagnostics():
         c.close()
     finally:
         lsock.close()
+
+
+# -- TLS parity (round 5) -------------------------------------------------
+# The reference's client-go always talks TLS to the apiserver
+# (options.go:91-136); the pooled write fast path must hold over https.
+
+
+@pytest.fixture()
+def tls_stub():
+    server = kube_stub.KubeStubServer(tls=True).start()
+    yield server
+    server.stop()
+
+
+@pytest.fixture()
+def tls_client(tls_stub):
+    import ssl
+
+    ctx = ssl.create_default_context(cafile=kube_stub.STUB_CERT_PATH)
+    c = KubeClusterClient(tls_stub.url, context=ctx)
+    yield c
+    c.stop()
+
+
+def test_tls_full_loop_reads_and_writes(tls_stub, tls_client):
+    """List+watch mirror, annotation patch, bind, and the Scheduled
+    event loop — all over https with certificate verification on."""
+    assert tls_stub.url.startswith("https://")
+    tls_stub.state.add_node("node-a", "10.0.0.1")
+    tls_stub.state.add_pod("default", "p1")
+    tls_client.start()
+    assert {n.name for n in tls_client.list_nodes()} == {"node-a"}
+    assert tls_client.patch_node_annotation("node-a", "k", "v")
+    assert tls_client.get_node("node-a").annotations["k"] == "v"
+    assert tls_client.bind_pod("default/p1", "node-a")
+    assert tls_client.get_pod("default/p1").node_name == "node-a"
+    # live watch still delivers over TLS
+    tls_stub.state.add_node("node-b", "10.0.0.2")
+    assert _wait_until(lambda: tls_client.get_node("node-b") is not None)
+
+
+def test_tls_write_pool_keepalive_and_fault_retry(tls_stub, tls_client):
+    """Pooled writes over TLS reuse connections (no handshake per
+    write) and inherit the status-aware retry path."""
+    st = tls_stub.state
+    st.add_node("node-a", "10.0.0.1")
+    tls_client.start()
+    # let the read-side watch connections finish settling (urllib
+    # list/watch threads open their own connections after start())
+    stable = st.connections
+    for _ in range(50):
+        time.sleep(0.05)
+        if st.connections == stable:
+            break
+        stable = st.connections
+    before = st.connections
+    for i in range(25):
+        assert tls_client.patch_node_annotation("node-a", f"k{i}", "v")
+    # all 25 writes share one object key -> one pool worker -> ONE
+    # keep-alive TLS connection, not a handshake per write
+    assert st.connections - before <= 1
+    st.inject_write_faults(
+        (429, {"message": "throttled"}, {"Retry-After": "0.05"})
+    )
+    assert tls_client.patch_node_annotation("node-a", "kx", "v")
+    assert tls_client.write_failures_by_status.get(429) == 1
+
+
+# -- native bulk flush engine (round 5) ----------------------------------
+
+
+def _native_available():
+    from crane_scheduler_tpu.native.lib import native_available
+
+    return native_available()
+
+
+@pytest.mark.skipif(not _native_available(), reason="libcrane_native missing")
+def test_native_bulk_patch_and_bind(stub, client):
+    """Batches >= _NATIVE_FLUSH_MIN ride the C++ flush engine (GIL-free
+    fan-out); results must be indistinguishable from the pool path:
+    mirror updated, server state patched, binds applied."""
+    n = 300
+    for i in range(n):
+        stub.state.add_node(f"node-{i:03d}", f"10.0.1.{i % 250}")
+        stub.state.add_pod("default", f"p{i:03d}")
+    client.start()
+    per_node = {f"node-{i:03d}": {"k": f"v{i},ts"} for i in range(n)}
+    assert client.patch_node_annotations_bulk(per_node) == n
+    # engine actually engaged (not the pool fallback)
+    assert client._native_flusher is not None
+    assert client.get_node("node-150").annotations["k"] == "v150,ts"
+    with stub.state.lock:
+        assert stub.state.nodes["node-150"]["metadata"]["annotations"]["k"] == "v150,ts"
+    bound = client.bind_pods(
+        [(f"default/p{i:03d}", f"node-{i:03d}") for i in range(n)]
+    )
+    assert len(bound) == n
+    assert client.get_pod("default/p007").node_name == "node-007"
+
+
+@pytest.mark.skipif(not _native_available(), reason="libcrane_native missing")
+def test_native_bulk_patch_failures_reroute_through_pool(stub, client):
+    """Engine failures re-drive through the Python pool, which owns
+    status-aware retry: an injected transient 429 must not lose a
+    node's annotations."""
+    n = 200
+    for i in range(n):
+        stub.state.add_node(f"node-{i:03d}", f"10.0.2.{i % 250}")
+    client.start()
+    stub.state.inject_write_faults(
+        (429, {"message": "throttled"}, {"Retry-After": "0.05"})
+    )
+    per_node = {f"node-{i:03d}": {"k": "v,ts"} for i in range(n)}
+    assert client.patch_node_annotations_bulk(per_node) == n
+    with stub.state.lock:
+        missing = [
+            name for name in per_node
+            if stub.state.nodes[name]["metadata"]["annotations"].get("k") != "v,ts"
+        ]
+    assert missing == []
+    assert client.write_failures_by_status.get(429) == 1
+
+
+@pytest.mark.skipif(not _native_available(), reason="libcrane_native missing")
+def test_native_bind_conflict_counted_not_retried(stub, client):
+    """Non-idempotent binding POSTs are never re-driven: a 409 leaves
+    the pod out of the bound list and lands in the failure counters."""
+    n = 150
+    for i in range(n):
+        stub.state.add_node(f"node-{i:03d}", f"10.0.3.{i % 250}")
+        stub.state.add_pod("default", f"p{i:03d}")
+    client.start()
+    stub.state.inject_write_faults((409, {"message": "already bound"}))
+    bound = client.bind_pods(
+        [(f"default/p{i:03d}", f"node-{i:03d}") for i in range(n)]
+    )
+    assert len(bound) == n - 1
+    assert client.write_failures_by_status.get(409) == 1
+    posts = [p for m, p in stub.state.requests if m == "POST"]
+    assert len(posts) == n  # no re-POST of the conflicted bind
+
+
+@pytest.mark.skipif(not _native_available(), reason="libcrane_native missing")
+def test_native_bind_429_redriven_through_pool(stub, client):
+    """429 = explicitly not processed: throttled binds re-drive through
+    the pool (which honors Retry-After even for POSTs) so batch size
+    never changes bind outcomes under throttling."""
+    n = 150
+    for i in range(n):
+        stub.state.add_node(f"node-{i:03d}", f"10.0.4.{i % 250}")
+        stub.state.add_pod("default", f"p{i:03d}")
+    client.start()
+    stub.state.inject_write_faults(
+        (429, {"message": "throttled"}, {"Retry-After": "0.05"})
+    )
+    bound = client.bind_pods(
+        [(f"default/p{i:03d}", f"node-{i:03d}") for i in range(n)]
+    )
+    assert len(bound) == n  # the throttled bind landed on retry
+    posts = [p for m, p in stub.state.requests if m == "POST"]
+    assert len(posts) == n + 1  # exactly one re-POST
+
+
+@pytest.mark.skipif(not _native_available(), reason="libcrane_native missing")
+def test_native_flush_times_out_on_wedged_server():
+    """A server that accepts but never responds must surface as status
+    0 within the timeout — never hang the flush (the Python pool path
+    enforces the client timeout; the engine must too)."""
+    import socket
+
+    from crane_scheduler_tpu.native.httpflush import NativeHTTPFlusher
+
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(8)
+    port = lsock.getsockname()[1]
+    try:
+        f = NativeHTTPFlusher("127.0.0.1", port, workers=2, timeout=0.3)
+        reqs = [b"PATCH /x HTTP/1.1\r\nHost: h\r\nContent-Length: 0\r\n\r\n"] * 4
+        t0 = time.time()
+        statuses = f.flush(reqs, idempotent=True)
+        # wedged recv pays the timeout once per attempt (engine retries
+        # idempotent requests once): bounded, not forever
+        assert time.time() - t0 < 5.0
+        assert list(statuses) == [0, 0, 0, 0]
+    finally:
+        lsock.close()
