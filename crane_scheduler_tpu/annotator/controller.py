@@ -133,13 +133,13 @@ class NodeAnnotator:
         self._stop = threading.Event()
         # direct-store mode (AnnotatorConfig.direct_store)
         self._store: NodeLoadStore | None = None
-        # deferred annotation patches, coalesced last-write-wins per
-        # (key, node) — columnar (key -> {node: raw}) because sweeps emit
-        # whole columns and string keys hash cheaper than tuples.
-        # Annotation writes are idempotent state, so a slow flusher never
-        # backlogs more than (|metrics|+1) x |nodes| entries, and
-        # re-syncs between flushes collapse to one patch
-        self._anno_pending: dict[str, dict[str, str]] = {}
+        # columnar pending: (key, names, values) segments appended by
+        # bulk sweeps — no per-entry dict churn on the sync path, and
+        # flush applies whole columns through the cluster's columnar
+        # primitive (the dict pivot dominated 50k flush profiles). The
+        # ONLY deferred-annotation buffer: the queue path patches the
+        # cluster directly (annotate_node_load), it never defers.
+        self._anno_cols: list[tuple[str, list[str], list[str]]] = []
         self._anno_lock = threading.Lock()
         # (node_set_version, [(name, ip)], [name], [ip]) — a bulk sweep
         # re-reads the same tables |metrics| times per cycle (_node_tables)
@@ -151,14 +151,14 @@ class NodeAnnotator:
         self._store = store
         return store
 
-    def _emit_annotation(self, node_name: str, key: str, raw: str) -> None:
-        with self._anno_lock:
-            self._anno_pending.setdefault(key, {})[node_name] = raw
-
     def _emit_annotation_column(self, key: str, names, values) -> None:
-        """One lock hold for a whole column's deferred patches."""
+        """One appended segment per (key, sweep): ownership of ``values``
+        transfers to the flusher (callers pass freshly-built lists);
+        ``names`` is treated as immutable (it is the sweep's shared node
+        table in the common case, and segment grouping at flush time
+        keys on its identity)."""
         with self._anno_lock:
-            self._anno_pending.setdefault(key, {}).update(zip(names, values))
+            self._anno_cols.append((key, names, values))
 
     def _node_tables(self):
         """``(pairs, names, ips)`` for the sweep loops, cached on the
@@ -200,18 +200,38 @@ class NodeAnnotator:
         Uses the cluster's bulk patch primitive when present (one
         lock/PATCH per node instead of per (node, key))."""
         with self._anno_lock:
-            pending, self._anno_pending = self._anno_pending, {}
-        if not pending:
+            cols, self._anno_cols = self._anno_cols, []
+        if not cols:
             return 0
-        total = sum(len(sub) for sub in pending.values())
-        per_node: dict[str, dict[str, str]] = {}
-        for key, sub in pending.items():
-            for node_name, raw in sub.items():
-                d = per_node.get(node_name)
-                if d is None:
-                    d = per_node[node_name] = {}
-                d[key] = raw
-        self._patch_per_node(per_node)
+        total = 0
+        # group column segments by the identity of their names list (the
+        # sweep's shared node table): one columnar patch per distinct
+        # row set, duplicate keys within a group collapse last-wins
+        # (exactly the semantics the per-node dict merge had). Groups
+        # apply in first-emission order, so a later sweep's segment
+        # always lands after an earlier sweep's.
+        groups: dict[int, tuple[list[str], dict[str, list[str]]]] = {}
+        for key, names, values in cols:
+            g = groups.get(id(names))
+            if g is None:
+                g = groups[id(names)] = (names, {})
+            g[1][key] = values
+        columns_api = getattr(
+            self.cluster, "patch_node_annotations_columns", None
+        )
+        for names, keyvals in groups.values():
+            total += sum(len(v) for v in keyvals.values())
+            if columns_api is not None:
+                columns_api(names, keyvals)
+            else:
+                per_node: dict[str, dict[str, str]] = {}
+                for key, values in keyvals.items():
+                    for name, raw in zip(names, values):
+                        d = per_node.get(name)
+                        if d is None:
+                            d = per_node[name] = {}
+                        d[key] = raw
+                self._patch_per_node(per_node)
         return total
 
     # -- core sync logic ---------------------------------------------------
@@ -402,10 +422,30 @@ class NodeAnnotator:
         nan, neg_inf = float("nan"), float("-inf")
         stale = shared_ts == neg_inf
         pairs, all_names, all_ips = self._node_tables()
-        # bulk column providers return {ip: value} in node order — when
-        # the key sequence matches exactly, take the values as-is and
-        # skip both the host-alias scan and |nodes| dict lookups
-        if list(samples) == all_ips:
+        # bulk column providers may return ``(hosts, values)`` aligned
+        # lists (zero dict churn end to end) or the classic {ip: value}
+        # mapping — when the host sequence matches the node table
+        # exactly, take the values as-is and skip both the host-alias
+        # scan and |nodes| dict lookups
+        col_floats = None
+        if isinstance(samples, tuple):
+            hosts, col = samples[0], samples[1]
+            if hosts == all_ips:
+                vals = list(col)
+                if len(samples) == 3:
+                    # pre-parsed float column (contract: exactly the
+                    # Go-parse of the strings, NaN where unparseable) —
+                    # valid only while rows stay aligned with `names`
+                    col_floats = samples[2]
+            else:
+                by_host_get = _index_samples_by_host(
+                    dict(zip(hosts, col))
+                ).get
+                vals = [
+                    by_host_get(ip) or by_host_get(name)
+                    for name, ip in pairs
+                ]
+        elif list(samples) == all_ips:
             vals = list(samples.values())
         else:
             by_host_get = _index_samples_by_host(samples).get
@@ -429,6 +469,11 @@ class NodeAnnotator:
         else:
             hot_names = [n for n in names if n not in hot_emitted]
             hot_emitted.update(hot_names)
+            if len(hot_names) == len(names):
+                # nothing filtered: share the names OBJECT so the flush
+                # groups the hot column with the metric columns (one
+                # columnar patch instead of two)
+                hot_names = names
         hot_annos: list[str] = []
         if hot_names:
             if hot_by_node is not None:
@@ -448,6 +493,14 @@ class NodeAnnotator:
             if stale:
                 metric_vals = np.full((len(names),), nan)
                 metric_ts = np.full((len(names),), neg_inf)
+            elif col_floats is not None and names is all_names:
+                # pre-parsed column, still row-aligned (no fallback
+                # filtering happened): NaN marks missing/unparseable by
+                # the 3-tuple contract — sources with legitimate NaN
+                # samples must use the 2-tuple (string) form
+                metric_vals = np.asarray(col_floats, dtype=np.float64)
+                ok = ~np.isnan(metric_vals)
+                metric_ts = np.where(ok, shared_ts, neg_inf)
             else:
                 parsed = bulk_parse_values(vals)
                 if parsed is not None:
@@ -550,6 +603,9 @@ class NodeAnnotator:
                 continue
             except TypeError:  # source has no offset support
                 return 0
+            if isinstance(samples, tuple):
+                # 2- or 3-tuple column form: (hosts, strings[, floats])
+                samples = dict(zip(samples[0], samples[1]))
             by_host_get = _index_samples_by_host(samples).get
             for name, ip in self._node_pairs():
                 node = self.cluster.get_node(name)

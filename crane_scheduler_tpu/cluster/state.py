@@ -140,6 +140,25 @@ class ClusterState:
     def __init__(self, max_events: int = 4096):
         self._lock = threading.RLock()
         self._nodes: dict[str, Node] = {}
+        # Lazy annotation overlay: columnar patches append SEGMENTS —
+        # (names, pos, {key: values}, dead) — holding the sweep's
+        # column lists by reference, so a 50k-node x 7-key flush is
+        # O(keys) bookkeeping instead of a Python loop copying 50k node
+        # objects (that loop dominated 50k-node cycle profiles at
+        # ~7us/node). ``pos`` is a name->row map cached per names-list
+        # object (sweeps reuse the cluster's cached node table, so it
+        # builds once per node-set epoch). Reads merge lazily: get_node
+        # folds one node, list_nodes folds everything. Cross-style
+        # writes (add_node, delete_node, single/bulk dict patches) mark
+        # the name ``dead`` in every existing segment so a stale column
+        # value can never shadow a newer authoritative write; segments
+        # created later apply to the name again. Steady state is ONE
+        # segment whose key->values entries are replaced every sweep;
+        # a changing node set appends segments, capped by a full fold.
+        self._anno_segments: list[
+            tuple[list[str], dict[str, int], dict[str, list[str]], set]
+        ] = []
+        self._names_pos_cache: tuple[list[str], dict[str, int]] | None = None
         self._pods: dict[str, Pod] = {}
         # per-node bound-pod key index (insertion-ordered) so
         # list_pods(node) is O(pods on node), not O(all pods) — metric
@@ -222,9 +241,71 @@ class ClusterState:
 
     # -- nodes -------------------------------------------------------------
 
+    def _drop_overlay_locked(self, name: str) -> None:
+        """A newer authoritative write for ``name`` supersedes every
+        EXISTING segment's values for it (O(segments), no column
+        scans); later segments apply to the name again."""
+        for seg in self._anno_segments:
+            seg[3].add(name)
+
+    def _pos_for_locked(self, names: list[str]) -> dict[str, int]:
+        cache = self._names_pos_cache
+        if cache is None or cache[0] is not names:
+            # keyed on object identity; the strong ref in the cache
+            # keeps the id stable while cached
+            cache = (names, {n: i for i, n in enumerate(names)})
+            self._names_pos_cache = cache
+        return cache[1]
+
+    def _merged_annotations_locked(self, node: Node):
+        """Node's annotations with the overlay applied; returns the
+        node's own mapping when the overlay has nothing for it."""
+        merged = None
+        name = node.name
+        for names, pos, cols, dead in self._anno_segments:
+            if name in dead:
+                continue
+            i = pos.get(name)
+            if i is None:
+                continue
+            if merged is None:
+                merged = dict(node.annotations)
+            for key, values in cols.items():
+                merged[key] = values[i]
+        return merged if merged is not None else node.annotations
+
+    def _fold_overlay_locked(self) -> None:
+        """Materialize every overlay segment into the node objects (paid
+        once per full read — list_nodes — instead of every flush)."""
+        if not self._anno_segments:
+            return
+        segments, self._anno_segments = self._anno_segments, []
+        nodes = self._nodes
+        for name, node in nodes.items():
+            anno = None
+            for names, pos, cols, dead in segments:
+                if name in dead:
+                    continue
+                i = pos.get(name)
+                if i is None:
+                    continue
+                if anno is None:
+                    anno = dict(node.annotations)
+                for key, values in cols.items():
+                    anno[key] = values[i]
+            if anno is not None:
+                new_node = object.__new__(Node)
+                d = new_node.__dict__
+                d.update(node.__dict__)
+                d["annotations"] = anno
+                nodes[name] = new_node
+
     def add_node(self, node: Node) -> None:
         with self._lock:
             prev = self._nodes.get(node.name)
+            # the incoming object is authoritative (watch MODIFIED /
+            # direct replace): stale overlay values must not shadow it
+            self._drop_overlay_locked(node.name)
             self._nodes[node.name] = node
             self._sched_version += 1
             # annotation-only updates (e.g. a kube mirror echoing the
@@ -240,15 +321,30 @@ class ClusterState:
             if name in self._nodes:
                 self._note_pod_change_locked(name)
             self._nodes.pop(name, None)
+            self._drop_overlay_locked(name)
             self._sched_version += 1
             self._node_set_version += 1
 
     def get_node(self, name: str) -> Node | None:
         with self._lock:
-            return self._nodes.get(name)
+            node = self._nodes.get(name)
+            if node is None or not self._anno_segments:
+                return node
+            merged = self._merged_annotations_locked(node)
+            if merged is node.annotations:
+                return node
+            # fold this node so repeated reads stay cheap
+            new_node = object.__new__(Node)
+            d = new_node.__dict__
+            d.update(node.__dict__)
+            d["annotations"] = merged
+            self._nodes[name] = new_node
+            self._drop_overlay_locked(name)
+            return new_node
 
     def list_nodes(self) -> list[Node]:
         with self._lock:
+            self._fold_overlay_locked()
             return list(self._nodes.values())
 
     def node_names(self) -> list[str]:
@@ -261,8 +357,9 @@ class ClusterState:
             node = self._nodes.get(name)
             if node is None:
                 return False
-            anno = dict(node.annotations)
+            anno = dict(self._merged_annotations_locked(node))
             anno[key] = value
+            self._drop_overlay_locked(name)
             self._nodes[name] = replace(node, annotations=anno)
             self._sched_version += 1
             return True
@@ -275,11 +372,16 @@ class ClusterState:
         patched = 0
         with self._lock:
             nodes = self._nodes
+            has_overlay = bool(self._anno_segments)
             for name, kv in per_node.items():
                 node = nodes.get(name)
                 if node is None:
                     continue
-                anno = dict(node.annotations)
+                if has_overlay:
+                    anno = dict(self._merged_annotations_locked(node))
+                    self._drop_overlay_locked(name)
+                else:
+                    anno = dict(node.annotations)
                 anno.update(kv)
                 # raw copy (see bind_pods): field-identical to
                 # replace(node, annotations=anno), minus __init__ overhead
@@ -291,6 +393,34 @@ class ClusterState:
                 self._sched_version += 1
                 patched += 1
         return patched
+
+    def patch_node_annotations_columns(
+        self, names: list[str], columns: Mapping[str, list[str]]
+    ) -> int:
+        """Columnar batch patch: every column in ``columns`` is aligned
+        with ``names`` (row i belongs to ``names[i]``). Lands in the
+        lazy overlay as an O(keys) segment append/merge — NO per-node
+        work at all (the reference pays a PATCH per (node, metric),
+        node.go:101-121; the per-node dict-pivot this replaces
+        dominated 50k-node flush profiles at ~7us/node). Readers fold
+        segments lazily (see ``_anno_segments``). Returns the submitted
+        row count; rows for unknown nodes are dropped at fold time."""
+        with self._lock:
+            segments = self._anno_segments
+            if segments and segments[-1][0] is names and not segments[-1][3]:
+                # steady state: same node-table object, no tombstones —
+                # replace this sweep's columns in place
+                segments[-1][2].update(columns)
+            else:
+                segments.append((
+                    names, self._pos_for_locked(names), dict(columns), set(),
+                ))
+                if len(segments) > 8:
+                    # churning node sets / tombstones: bound the read
+                    # cost by materializing everything once
+                    self._fold_overlay_locked()
+            self._sched_version += len(names)
+        return len(names)
 
     # -- pods --------------------------------------------------------------
 

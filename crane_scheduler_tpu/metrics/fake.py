@@ -96,6 +96,11 @@ class FakeMetricsSource:
         if column is not None:
             out = column()
             if fail:
+                # fault injection needs the mapping form; column
+                # providers may serve aligned (hosts, values[, floats])
+                # tuples
+                if isinstance(out, tuple):
+                    out = dict(zip(out[0], out[1]))
                 for instance in [
                     i for i in out if (metric_name, i) in fail
                 ]:

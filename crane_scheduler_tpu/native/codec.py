@@ -103,11 +103,17 @@ def bulk_parse_values(strings) -> tuple[np.ndarray, np.ndarray] | None:
     return values, ok.astype(bool)
 
 
-def bulk_render_f5(vals: np.ndarray) -> list[str] | None:
+def bulk_render_f5(vals: np.ndarray, with_parse: bool = False):
     """Render a float column with the Prometheus 5-decimal contract
     (``format_metric_value``) in one C call; returns the string list, or
     None when the native library is unavailable. Callers apply the
-    negative/NaN clamp first when modeling ``_render``."""
+    negative/NaN clamp first when modeling ``_render``.
+
+    ``with_parse=True`` returns ``(strings, parsed, ok)`` where
+    ``parsed`` is the Go-parse of the RENDERED strings, computed from
+    the same native buffer (no join/encode glue): exactly the
+    quantized values a re-ingest of the strings would produce, which is
+    the bit-parity contract direct-store consumers need."""
     lib = load_native()
     if lib is None:
         return None
@@ -124,12 +130,34 @@ def bulk_render_f5(vals: np.ndarray) -> list[str] | None:
     text = buf.raw[: offsets[n]].decode("ascii")
     off = offsets.tolist()
     out = [text[off[i]:off[i + 1]] for i in range(n)]
-    if "" in out:
+    oversize_rows = [i for i, s in enumerate(out) if not s]
+    if oversize_rows:
         # oversize entries (>31 chars, |v| >= ~1e25) come back empty —
         # re-render those rows exactly in Python
         from ..loadstore.codec import format_metric_value
 
-        for i, s in enumerate(out):
-            if not s:
-                out[i] = format_metric_value(float(vals[i]))
-    return out
+        for i in oversize_rows:
+            out[i] = format_metric_value(float(vals[i]))
+    if not with_parse:
+        return out
+    parsed = np.empty((n,), dtype=np.float64)
+    ok = np.empty((n,), dtype=np.uint8)
+    if n:
+        lib.crane_parse_values(
+            buf,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n,
+            parsed.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        # oversize rows were re-rendered in Python above (the native
+        # buffer has an empty slice for them); parse the re-rendered
+        # strings the same way so parsed == parse(out) exactly
+        if oversize_rows:
+            from ..loadstore.codec import go_parse_float
+
+            for i in oversize_rows:
+                v = go_parse_float(out[i])
+                parsed[i] = float("nan") if v is None else v
+                ok[i] = v is not None
+    return out, parsed, ok.astype(bool)

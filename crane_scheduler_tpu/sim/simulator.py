@@ -166,13 +166,21 @@ class Simulator:
             [self._base[(name, metric)] for name, _ in self._pairs]
         )
 
-        def column() -> dict[str, str]:
+        def column():
             loads = base_vec + self.config.per_pod_load * self._counts_vector()
             np.clip(loads, 0.0, 1.0, out=loads)
-            rendered = bulk_render_f5(loads)
-            if rendered is None:  # no native lib: per-item fallback
+            bundle = bulk_render_f5(loads, with_parse=True)
+            if bundle is None:  # no native lib: per-item fallback
                 rendered = [format_metric_value(v) for v in loads]
-            return dict(zip(self._ips, rendered))
+                return (self._ips, rendered)
+            rendered, parsed, ok = bundle
+            # aligned-columns form with the pre-parsed floats: the
+            # annotator's bulk sweep consumes (hosts, strings, floats)
+            # directly — no 50k-entry dict per metric, no re-parse.
+            # ``parsed`` is the Go-parse of the rendered strings (the
+            # quantized round-trip), so the direct-store bit-parity
+            # contract holds exactly as if the consumer re-parsed.
+            return (self._ips, rendered, np.where(ok, parsed, np.nan))
 
         return column
 

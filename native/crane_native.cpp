@@ -22,6 +22,7 @@
 #include <cstring>
 #include <cstdio>
 #include <cctype>
+#include <cmath>
 #include <vector>
 
 extern "C" {
@@ -293,6 +294,47 @@ void crane_render_f5(const double* vals, int64_t n, char* out,
     } else if (v < -1.7976931348623157e308) {
       std::memcpy(out + pos, "-Inf", 4);
       wrote = 4;
+    } else if (v >= 0.0 && v < 1.0e4) {
+      // fast fixed-point path (annotation loads are small nonnegative
+      // reals; snprintf's general double->decimal dominated 50k-column
+      // render profiles). For v < 1e4, scaled < 1e9 so the multiply
+      // error is <= 0.5 ulp ~ 1.1e-7; when the fractional part is
+      // further than 1e-5 from the .5 rounding boundary the round
+      // direction is provably identical to %.5f's exact rounding.
+      // Anything nearer the boundary (and anything >= 1e4) takes the
+      // snprintf path, so output can never diverge.
+      double scaled = v * 100000.0;
+      double fl = std::floor(scaled);
+      double frac = scaled - fl;
+      if (frac > 0.5 - 1e-5 && frac < 0.5 + 1e-5) {
+        char scratch[352];
+        wrote = std::snprintf(scratch, sizeof(scratch), "%.5f", v);
+        if (wrote < 0 || wrote > 31) {
+          wrote = 0;
+        } else {
+          std::memcpy(out + pos, scratch, static_cast<size_t>(wrote));
+        }
+      } else {
+        uint64_t q =
+            static_cast<uint64_t>(fl) + (frac > 0.5 ? 1u : 0u);
+        uint64_t ipart = q / 100000u;
+        uint64_t fpart = q % 100000u;
+        char tmp[20];
+        int ni = 0;
+        do {
+          tmp[ni++] = static_cast<char>('0' + ipart % 10u);
+          ipart /= 10u;
+        } while (ipart);
+        char* w = out + pos;
+        for (int k = ni - 1; k >= 0; --k) *w++ = tmp[k];
+        *w++ = '.';
+        w[4] = static_cast<char>('0' + fpart % 10u); fpart /= 10u;
+        w[3] = static_cast<char>('0' + fpart % 10u); fpart /= 10u;
+        w[2] = static_cast<char>('0' + fpart % 10u); fpart /= 10u;
+        w[1] = static_cast<char>('0' + fpart % 10u); fpart /= 10u;
+        w[0] = static_cast<char>('0' + fpart % 10u);
+        wrote = ni + 6;
+      }
     } else {
       // render to a scratch sized for the %.5f worst case (~317 chars
       // for DBL_MAX); entries that exceed the caller's 32-byte budget

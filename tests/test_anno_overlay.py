@@ -1,0 +1,126 @@
+"""Lazy annotation-overlay semantics in ClusterState.
+
+Columnar patches (the annotator flush's shape) land as O(keys) overlay
+segments; every read path must observe exactly the same annotations a
+per-node apply would have produced, under every interleaving of
+columnar, single, bulk, add/delete writes. The reference has no
+equivalent structure (client-go applies each PATCH server-side;
+node.go:123-146) — these tests pin the rebuild-specific laziness.
+"""
+
+from crane_scheduler_tpu.cluster import ClusterState, Node
+
+
+def _cluster(n=5):
+    c = ClusterState()
+    for i in range(n):
+        c.add_node(Node(name=f"n{i}", annotations={"base": "b"}))
+    return c
+
+
+def _names(c):
+    return sorted(c.node_names())
+
+
+def test_columnar_patch_visible_via_get_and_list():
+    c = _cluster()
+    names = _names(c)
+    c.patch_node_annotations_columns(
+        names, {"k1": [f"v{i}" for i in range(5)], "k2": ["x"] * 5}
+    )
+    assert c.get_node("n3").annotations == {"base": "b", "k1": "v3", "k2": "x"}
+    for i, node in enumerate(sorted(c.list_nodes(), key=lambda n: n.name)):
+        assert node.annotations["k1"] == f"v{i}"
+    # after the full fold the overlay is gone but values persist
+    assert c._anno_segments == []
+    assert c.get_node("n1").annotations["k1"] == "v1"
+
+
+def test_single_patch_overrides_column_and_later_column_wins_again():
+    c = _cluster()
+    names = _names(c)
+    c.patch_node_annotations_columns(names, {"k": ["old"] * 5})
+    assert c.patch_node_annotation("n2", "k", "single")
+    assert c.get_node("n2").annotations["k"] == "single"
+    # other nodes still see the column value
+    assert c.get_node("n1").annotations["k"] == "old"
+    # a NEWER column applies to n2 again
+    c.patch_node_annotations_columns(names, {"k": ["new"] * 5})
+    assert c.get_node("n2").annotations["k"] == "new"
+
+
+def test_bulk_patch_after_column_merges_not_shadows():
+    c = _cluster()
+    names = _names(c)
+    c.patch_node_annotations_columns(
+        names, {"k": ["col"] * 5, "other": ["o"] * 5}
+    )
+    c.patch_node_annotations_bulk({"n0": {"k": "bulk"}})
+    anno = c.get_node("n0").annotations
+    # bulk write wins for its key; the column's OTHER key survived the
+    # merge; a stale column value must never resurface for n0
+    assert anno["k"] == "bulk" and anno["other"] == "o"
+    c.patch_node_annotations_columns(names[1:], {"k": ["late"] * 4})
+    assert c.get_node("n0").annotations["k"] == "bulk"
+    assert c.get_node("n1").annotations["k"] == "late"
+
+
+def test_delete_then_readd_sees_no_stale_overlay():
+    c = _cluster()
+    names = _names(c)
+    c.patch_node_annotations_columns(names, {"k": ["stale"] * 5})
+    c.delete_node("n4")
+    c.add_node(Node(name="n4", annotations={"fresh": "f"}))
+    assert c.get_node("n4").annotations == {"fresh": "f"}
+    # peers unaffected
+    assert c.get_node("n0").annotations["k"] == "stale"
+
+
+def test_authoritative_add_node_supersedes_overlay():
+    """A watch MODIFIED delivering the server's copy must not be
+    shadowed by an older pending column."""
+    c = _cluster()
+    names = _names(c)
+    c.patch_node_annotations_columns(names, {"k": ["pending"] * 5})
+    c.add_node(Node(name="n1", annotations={"k": "server"}))
+    assert c.get_node("n1").annotations["k"] == "server"
+    assert c.get_node("n2").annotations["k"] == "pending"
+
+
+def test_segment_cap_folds():
+    c = _cluster()
+    for round_i in range(12):
+        names = sorted(c.node_names())  # fresh list object every time
+        c.patch_node_annotations_columns(names, {f"k{round_i}": ["v"] * 5})
+    assert len(c._anno_segments) <= 9
+    anno = c.get_node("n0").annotations
+    for round_i in range(12):
+        assert anno[f"k{round_i}"] == "v"
+
+
+def test_steady_state_is_one_segment():
+    c = _cluster()
+    names = sorted(c.node_names())  # same object across sweeps
+    for sweep in range(50):
+        c.patch_node_annotations_columns(
+            names, {"k": [f"s{sweep}"] * 5, "hot": ["h"] * 5}
+        )
+    assert len(c._anno_segments) == 1
+    assert c.get_node("n3").annotations["k"] == "s49"
+
+
+def test_sched_version_advances_on_columnar_patch():
+    c = _cluster()
+    names = _names(c)
+    v = c.sched_version
+    c.patch_node_annotations_columns(names, {"k": ["v"] * 5})
+    assert c.sched_version > v
+
+
+def test_ghost_rows_dropped_at_fold():
+    c = _cluster()
+    names = _names(c) + ["ghost"]
+    c.patch_node_annotations_columns(names, {"k": ["v"] * 6})
+    assert c.get_node("ghost") is None
+    nodes = c.list_nodes()
+    assert len(nodes) == 5 and all(n.annotations["k"] == "v" for n in nodes)
