@@ -31,6 +31,15 @@ from jax.sharding import Mesh
 
 from ..policy.compile import PolicyTensors
 from ..scorer.batched import BatchedScorer
+
+# compact packed layout (single source of truth for pack AND unpack):
+# per-node uint32 = counts(COMPACT_COUNT_BITS) | score | schedulable(msb).
+# COMPACT_MAX_PODS bounds the burst size the counts field can carry
+# (per-node counts never exceed the burst's pod total).
+COMPACT_COUNT_BITS = 18
+COMPACT_MAX_PODS = 1 << COMPACT_COUNT_BITS
+_COMPACT_COUNT_MASK = COMPACT_MAX_PODS - 1
+_COMPACT_SCORE_MASK = (1 << (31 - COMPACT_COUNT_BITS)) - 1
 from ..scorer.topk import GangScheduler
 
 # Rebased (non-f64) snapshots must not age past this: the f32 rounding
@@ -121,6 +130,11 @@ class ShardedScheduleStep:
             in_shardings=(in_vecs, rep),
             out_shardings=rep,
         )
+        self._jit_packed_compact = jax.jit(
+            self._step_packed_compact,
+            in_shardings=(in_vecs, rep),
+            out_shardings=rep,
+        )
 
     def _step(self, prepared, num_pods):
         if self.hybrid:
@@ -157,6 +171,29 @@ class ShardedScheduleStep:
                 ),
             ]
         )
+
+    def _step_packed_compact(self, prepared, num_pods):
+        """[N+2] uint32: per node ``counts(bits 0-17) | score(18-30) |
+        schedulable(31)``; tail ``[unassigned, bitcast(waterline)]``.
+        Sound while counts <= num_pods < 2^18 (``packed`` enforces) and
+        scores are in [0, 8191] — the scorer clamps to [0, 100]
+        (oracle.py trunc-clamp; hybrid rescue rows substitute oracle
+        scores with the same range)."""
+        schedulable, scores, counts, unassigned, waterline = self._step(
+            prepared, num_pods
+        )
+        body = (
+            counts.astype(jnp.uint32)
+            | (scores.astype(jnp.uint32) << COMPACT_COUNT_BITS)
+            | (schedulable.astype(jnp.uint32) << 31)
+        )
+        tail = jnp.stack([
+            unassigned.astype(jnp.uint32),
+            jax.lax.bitcast_convert_type(
+                waterline.astype(jnp.int32), jnp.uint32
+            ),
+        ])
+        return jnp.concatenate([body, tail])
 
     def prepare(
         self, snapshot, now: float, capacity=None, offsets=None
@@ -537,12 +574,30 @@ class ShardedScheduleStep:
         return ShardedStepResult(*out)
 
     def packed(self, prepared: PreparedSnapshot, num_pods, now: float | None = None):
-        """One-fetch variant: device [3N+2] int32 (see ``unpack``)."""
-        return self._jit_packed(*self._args(prepared, num_pods, now))
+        """One-fetch variant. Bursts below ``COMPACT_MAX_PODS`` use the
+        compact [N+2] uint32 layout (1/3 the tunnel bytes of the wide
+        [3N+2] int32 — ~60ms/fetch at 50k nodes over a ~7MB/s tunnel —
+        at the same single round-trip); larger bursts fall back to the
+        wide layout. ``unpack`` discriminates by dtype."""
+        args = self._args(prepared, num_pods, now)
+        if num_pods < COMPACT_MAX_PODS:
+            return self._jit_packed_compact(*args)
+        return self._jit_packed(*args)
 
     @staticmethod
     def unpack(packed_host: np.ndarray, n: int):
-        """Split a fetched packed result into host-side step outputs."""
+        """Split a fetched packed result into host-side step outputs
+        (wide int32 or compact uint32 — see ``_step_packed_compact``)."""
+        if packed_host.dtype == np.uint32:
+            body = packed_host[:n]
+            counts = (body & _COMPACT_COUNT_MASK).astype(np.int32)
+            scores = (
+                (body >> COMPACT_COUNT_BITS) & _COMPACT_SCORE_MASK
+            ).astype(np.int32)
+            schedulable = (body >> 31).astype(bool)
+            unassigned = int(packed_host[-2])
+            waterline = int(packed_host[-2:].view(np.int32)[1])
+            return schedulable, scores, counts, unassigned, waterline
         npad = (packed_host.shape[0] - 2) // 3
         schedulable = packed_host[:n].astype(bool)
         scores = packed_host[npad : npad + n]

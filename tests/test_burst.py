@@ -284,3 +284,36 @@ def test_fuzz_burst_equals_object_path_across_random_clusters():
             for s in sims:
                 s.clock.advance(15.0)
                 s.sync_metrics()
+
+
+def test_compact_packed_format_matches_wide():
+    """The compact uint32 [N+2] fetch layout must unpack to exactly the
+    wide [3N+2] int32 outputs (counts/scores/schedulable/unassigned/
+    waterline) for the same prepared snapshot and burst."""
+    import numpy as np
+
+    from crane_scheduler_tpu.parallel.sharded import COMPACT_MAX_PODS
+
+    sim = make_sim(n_nodes=97, seed=5)
+    batch = sim.build_batch_scheduler(bucket=128)
+    now = sim.clock()
+    batch.refresh()
+    prepared = batch._prepare(now)
+    step = batch._sharded
+    num_pods = 513
+    wide = np.asarray(step._jit_packed(*step._args(prepared, num_pods, now)))
+    compact = np.asarray(
+        step._jit_packed_compact(*step._args(prepared, num_pods, now))
+    )
+    assert compact.dtype == np.uint32 and wide.dtype == np.int32
+    assert compact.nbytes * 3 < wide.nbytes + 24
+    n = batch._prepared_n
+    for a, b in zip(step.unpack(wide, n), step.unpack(compact, n)):
+        np.testing.assert_array_equal(a, b)
+    # the PUBLIC dispatcher picks compact for small bursts and the wide
+    # layout past the counts-field cap
+    assert np.asarray(step.packed(prepared, num_pods, now=now)).dtype == np.uint32
+    assert (
+        np.asarray(step.packed(prepared, COMPACT_MAX_PODS, now=now)).dtype
+        == np.int32
+    )
