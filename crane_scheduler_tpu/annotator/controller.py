@@ -216,22 +216,38 @@ class NodeAnnotator:
             if g is None:
                 g = groups[id(names)] = (names, {})
             g[1][key] = values
+        group_list = list(groups.values())
+        for names, keyvals in group_list:
+            total += sum(len(v) for v in keyvals.values())
+        # one call for ALL groups: a sweep with fallback-filtered node
+        # sets produces one group per metric, and a per-group apply
+        # would cost the kube path one HTTP PATCH per (node, group) —
+        # the groups API lets it pivot everything into one patch per
+        # node (kube.py), while the in-memory cluster applies segments
+        groups_api = getattr(
+            self.cluster, "patch_node_annotation_groups", None
+        )
+        if groups_api is not None:
+            groups_api(group_list)
+            return total
         columns_api = getattr(
             self.cluster, "patch_node_annotations_columns", None
         )
-        for names, keyvals in groups.values():
-            total += sum(len(v) for v in keyvals.values())
-            if columns_api is not None:
+        if columns_api is not None:
+            for names, keyvals in group_list:
                 columns_api(names, keyvals)
-            else:
-                per_node: dict[str, dict[str, str]] = {}
-                for key, values in keyvals.items():
-                    for name, raw in zip(names, values):
-                        d = per_node.get(name)
-                        if d is None:
-                            d = per_node[name] = {}
-                        d[key] = raw
-                self._patch_per_node(per_node)
+            return total
+        # write-through fallback: pivot across ALL groups so each node
+        # still gets exactly one patch
+        per_node: dict[str, dict[str, str]] = {}
+        for names, keyvals in group_list:
+            for key, values in keyvals.items():
+                for name, raw in zip(names, values):
+                    d = per_node.get(name)
+                    if d is None:
+                        d = per_node[name] = {}
+                    d[key] = raw
+        self._patch_per_node(per_node)
         return total
 
     # -- core sync logic ---------------------------------------------------

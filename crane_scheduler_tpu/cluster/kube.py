@@ -1295,13 +1295,23 @@ class KubeClusterClient:
         per-node dicts here — the pivot is noise next to wire time on
         this path — and ride the bulk primitive (native engine when
         large)."""
+        return self.patch_node_annotation_groups([(names, columns)])
+
+    def patch_node_annotation_groups(self, groups) -> int:
+        """Pivot ALL column groups into per-node dicts and issue ONE
+        merge-patch per node. A sweep whose metrics carry different
+        row sets (fallback-filtered nodes) produces one group per
+        metric; applying them group-by-group would multiply the HTTP
+        patch count per node by the group count (measured 6x at 5k
+        nodes — the whole round-4 write-path win given back)."""
         per_node: dict[str, dict[str, str]] = {}
-        for key, values in columns.items():
-            for name, value in zip(names, values):
-                d = per_node.get(name)
-                if d is None:
-                    d = per_node[name] = {}
-                d[key] = value
+        for names, columns in groups:
+            for key, values in columns.items():
+                for name, value in zip(names, values):
+                    d = per_node.get(name)
+                    if d is None:
+                        d = per_node[name] = {}
+                    d[key] = value
         return self.patch_node_annotations_bulk(per_node)
 
     def patch_pod_annotation(self, key: str, anno_key: str, value: str) -> bool:

@@ -422,6 +422,18 @@ class ClusterState:
             self._sched_version += len(names)
         return len(names)
 
+    def patch_node_annotation_groups(self, groups) -> int:
+        """Apply several aligned column groups (``[(names, {key:
+        values}), ...]`` — the annotator flush's shape when fallback
+        filtering gives metrics different row sets) in one call. Each
+        group is an O(keys) overlay segment here; the kube client's
+        implementation instead pivots ALL groups into one HTTP patch
+        per node."""
+        patched = 0
+        for names, columns in groups:
+            patched += self.patch_node_annotations_columns(names, columns)
+        return patched
+
     # -- pods --------------------------------------------------------------
 
     def _index_remove(self, pod: Pod) -> None:

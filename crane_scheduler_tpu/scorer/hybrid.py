@@ -8,11 +8,15 @@ value sits within float32 error of a decision boundary:
   (trunc flip),
 - a hot value within error of a multiple of 0.1 (penalty flip).
 
-Those cases are *detectable on device*: the jitted f32 pass emits a
-conservative risk mask alongside its verdicts. Risky rows — typically a
-tiny fraction — are re-scored exactly in float64 numpy on the host
-(``score_rows_f64``, the same IEEE-double operation sequence as the Go
-code and the oracle, with no dependency on jax x64). The result is
+Those cases are detected by a HOST-side risk scan at snapshot-refresh
+time (``risk_mask_f64`` below — numpy over the store columns; a
+device-emitted mask was prototyped in round 3 and measured slower than
+the host scan once the column-replay refresh landed, see
+ROADMAP.md round 3). Risky rows — typically a tiny fraction — are
+re-scored exactly in float64 numpy on the host (``score_rows_f64``, the
+same IEEE-double operation sequence as the Go code and the oracle, with
+no dependency on jax x64), and their verdicts ride the prepared
+snapshot as override vectors the device step substitutes. The result is
 bit-parity everywhere at f32 throughput.
 
 Tolerances are deliberately loose (1e-4 absolute on comparisons, 1e-3 on

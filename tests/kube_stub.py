@@ -433,6 +433,12 @@ def _make_handler(state: KubeStubState):
                         "requests": by_method,
                         "rv": state._rv,
                         "events": len(state.events),
+                        "watchers": len(state.watchers),
+                        "watcher_backlog": sum(
+                            q.qsize() for _, q in state.watchers
+                        ),
+                        "threads": threading.active_count(),
+                        "history": len(state.history),
                         "maxrss_kb": resource.getrusage(
                             resource.RUSAGE_SELF
                         ).ru_maxrss,
@@ -740,19 +746,29 @@ class KubeStubSubprocess:
     the ``/__stub/*`` control endpoints replace direct state access.
     """
 
-    def __init__(self, null: bool = False, shards: int = 1):
+    def __init__(self, null: bool = False, shards: int = 1,
+                 tls: bool = False):
         import subprocess
         import sys
 
         self._procs: list = []
         self.control_urls: list[str] = []
         self.url = ""
+        self._ssl_context = None
+        if tls:
+            import ssl
+
+            self._ssl_context = ssl.create_default_context(
+                cafile=STUB_CERT_PATH
+            )
         shards = max(1, int(shards))
         port = 0
         for i in range(shards):
             args = [sys.executable, os.path.abspath(__file__), "--serve"]
             if null:
                 args.append("--null")  # NullAPIServer: client-ceiling mode
+            if tls:
+                args.append("--tls")
             if shards > 1:
                 args += ["--reuse-port", str(port)]
             proc = subprocess.Popen(
@@ -789,7 +805,9 @@ class KubeStubSubprocess:
             method="POST" if body is not None else "GET",
             data=None if body is None else json.dumps(body).encode(),
         )
-        with urllib.request.urlopen(req, timeout=120) as resp:  # noqa: S310
+        with urllib.request.urlopen(  # noqa: S310
+            req, timeout=120, context=self._ssl_context
+        ) as resp:
             return json.loads(resp.read())
 
     def _control_all(self, path: str, body: dict | None = None) -> list[dict]:
@@ -912,10 +930,12 @@ if __name__ == "__main__":
             print(_srv.url, flush=True)
         elif "--reuse-port" in sys.argv:
             _port = int(sys.argv[sys.argv.index("--reuse-port") + 1])
-            _srv = KubeStubServer(reuse_port=_port).start()
+            _srv = KubeStubServer(
+                tls="--tls" in sys.argv, reuse_port=_port
+            ).start()
             _ctl_url = _srv.attach_control_listener()
             print(_srv.url, _ctl_url, flush=True)
         else:
-            _srv = KubeStubServer().start()
+            _srv = KubeStubServer(tls="--tls" in sys.argv).start()
             print(_srv.url, flush=True)
         threading.Event().wait()  # serve until terminated

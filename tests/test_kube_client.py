@@ -943,3 +943,40 @@ def test_native_flush_times_out_on_wedged_server():
         assert list(statuses) == [0, 0, 0, 0]
     finally:
         lsock.close()
+
+
+def test_flush_with_mixed_row_sets_is_one_patch_per_node(stub, client):
+    """A sweep whose metrics carry DIFFERENT row sets (nodes missing
+    from some metrics' samples fall back to the per-node queue) must
+    still flush as ONE merge-patch per node — applying the per-metric
+    column groups separately multiplied the HTTP patch count by the
+    group count (measured 6x before the groups API existed)."""
+    from crane_scheduler_tpu.annotator import AnnotatorConfig, NodeAnnotator
+    from crane_scheduler_tpu.loadstore import NodeLoadStore
+    from crane_scheduler_tpu.policy import compile_policy
+
+    n = 200
+    for i in range(n):
+        stub.state.add_node(f"node-{i:03d}", f"10.0.9.{i % 250}")
+    # one node with NO metric samples: fallback filtering gives every
+    # metric pass its own fresh (names, values) row set
+    stub.state.add_node("node-bare", "10.99.99.99")
+    client.start()
+    fake = FakeMetricsSource()
+    metric_names = [sp.name for sp in DEFAULT_POLICY.spec.sync_period]
+    for i in range(n):
+        for m in metric_names:
+            fake.set(m, f"10.0.9.{i % 250}", 0.4, by="ip")
+    ann = NodeAnnotator(client, fake, DEFAULT_POLICY,
+                        AnnotatorConfig(bulk_sync=True, direct_store=True))
+    ann.attach_store(NodeLoadStore(compile_policy(DEFAULT_POLICY)))
+    ann.sync_all_once_bulk(NOW)
+    before = len([1 for m, p in stub.state.requests if m == "PATCH"])
+    ann.flush_annotations()
+    patches = len([1 for m, p in stub.state.requests if m == "PATCH"]) - before
+    assert patches == n  # exactly one patch per sampled node
+    # and every metric landed in that one patch
+    with stub.state.lock:
+        anno = stub.state.nodes["node-000"]["metadata"]["annotations"]
+    for m in metric_names:
+        assert m in anno
