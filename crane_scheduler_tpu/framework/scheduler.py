@@ -619,7 +619,7 @@ class BatchScheduler:
         node_idx = np.full((len(names),), -1, dtype=np.int32)
         k = min(len(order), len(names))
         node_idx[:k] = order[:k]
-        table = list(node_names[:n])
+        table = self._burst_node_table(node_names, n)
         bound = None
         if bind and handle is not None:
             bound = self.cluster.bind_burst(handle, table, node_idx, now)
@@ -640,6 +640,19 @@ class BatchScheduler:
             schedulable_row=np.asarray(schedulable),
             now=now,
         )
+
+    def _burst_node_table(self, node_names, n: int) -> list:
+        """The burst's node table as a STABLE list object, cached on the
+        prepared snapshot's names tuple: bursts sharing one snapshot
+        reuse the same list, so identity-keyed caches downstream
+        (``bind_burst``'s remap, the native heap's interned-ids cache)
+        skip their 50k-name re-translation per burst. The list is
+        treated as immutable by every consumer."""
+        cache = getattr(self, "_node_table_cache", None)
+        if cache is None or cache[0] is not node_names or cache[1] != n:
+            cache = (node_names, n, list(node_names[:n]))
+            self._node_table_cache = cache
+        return cache[2]
 
     @staticmethod
     def _expand_counts(scores, counts, names, keys):

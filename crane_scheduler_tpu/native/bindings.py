@@ -79,19 +79,27 @@ class NativeBindingRecords:
             )
 
     def add_bind_columns(self, node_table, node_idx, ts: int) -> None:
-        """Columnar push: intern the (small) node table once, map the
-        per-pod index column through it with numpy, and push the whole
-        burst in ONE FFI call — no per-pod Python objects at all."""
+        """Columnar push: intern the node table once, map the per-pod
+        index column through it with numpy, and push the whole burst in
+        ONE FFI call — no per-pod Python objects at all. The interned
+        ids are cached on the table OBJECT (the burst path reuses one
+        list per snapshot and treats it as immutable), so repeat bursts
+        skip the 50k-name intern sweep."""
         node_idx = np.asarray(node_idx, dtype=np.int64)
         n = len(node_idx)
         if not n:
             return
         with self._lock:
-            table_ids = np.fromiter(
-                (self._intern(name) for name in node_table),
-                dtype=np.int32,
-                count=len(node_table),
-            )
+            cache = getattr(self, "_table_ids_cache", None)
+            if cache is not None and cache[0] is node_table:
+                table_ids = cache[1]
+            else:
+                table_ids = np.fromiter(
+                    (self._intern(name) for name in node_table),
+                    dtype=np.int32,
+                    count=len(node_table),
+                )
+                self._table_ids_cache = (node_table, table_ids)
             ids = np.ascontiguousarray(table_ids[node_idx])
             ts_arr = np.full((n,), int(ts), dtype=np.int64)
             self._lib.crane_bindings_add_batch(

@@ -102,6 +102,7 @@ class Simulator:
                 self._base[(name, m)] = base
                 self.metrics.set(m, ip, self._stream(name, m), by="ip")
         self._ips = [ip for _, ip in self._pairs]
+        self._names = [name for name, _ in self._pairs]
         for m in metric_names:
             # bulk sweeps read the whole column in one call instead of
             # |nodes| per-instance closures
@@ -141,13 +142,19 @@ class Simulator:
         version = self.cluster.sched_version
         cache = self._counts_vec_cache
         if cache is None or cache[0] != version:
-            counts = self._bound_counts()
-            get = counts.get
-            vec = np.fromiter(
-                (get(name, 0) for name, _ in self._pairs),
-                dtype=np.float64,
-                count=len(self._pairs),
-            )
+            bc_for = getattr(self.cluster, "bound_counts_for", None)
+            if bc_for is not None:
+                # vectorized: one gather through the cluster's slot
+                # array (self._names is the stable key object)
+                vec = bc_for(self._names).astype(np.float64)
+            else:
+                counts = self._bound_counts()
+                get = counts.get
+                vec = np.fromiter(
+                    (get(name, 0) for name, _ in self._pairs),
+                    dtype=np.float64,
+                    count=len(self._pairs),
+                )
             cache = (version, vec)
             self._counts_vec_cache = cache
         return cache[1]

@@ -317,3 +317,23 @@ def test_compact_packed_format_matches_wide():
         np.asarray(step.packed(prepared, COMPACT_MAX_PODS, now=now)).dtype
         == np.int32
     )
+
+
+def test_bind_burst_duplicate_names_in_table_still_counts_exactly():
+    """The bulk-adopt fast path must detect duplicate node names (legal
+    for the public API; the old remap loop deduped them) and fall back
+    to the dedup loop — fancy-index += with duplicate slots would drop
+    additions silently."""
+    cluster = ClusterState()
+    burst = cluster.add_pod_burst("ns", [f"p{i}" for i in range(6)])
+    table = ["node-a", "node-b", "node-a"]  # duplicate on purpose
+    rows = cluster.bind_burst(burst, table, [0, 1, 2, 0, 1, 2])
+    assert len(rows) == 6
+    # rows bound via tid 0 and tid 2 are BOTH node-a
+    assert cluster.count_pods("node-a") == 4
+    assert cluster.count_pods("node-b") == 2
+    assert cluster.count_pods_all() == {"node-a": 4, "node-b": 2}
+    import numpy as np
+
+    vec = cluster.bound_counts_for(["node-a", "node-b", "ghost"])
+    assert vec.tolist() == [4, 2, 0]
