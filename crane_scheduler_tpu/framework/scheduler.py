@@ -31,6 +31,23 @@ from ..policy.compile import compile_policy
 from ..policy.types import DynamicSchedulerPolicy
 
 
+def _submit_fetch(pool, dev):
+    """Fetch future for a dispatched device result: prefetched on the
+    pool's worker when pipelining (exceptions are retrieved either by
+    the drain or by the done-callback, so an abandoned generator never
+    leaves a never-retrieved tunnel error), fetched inline at depth 1."""
+    import numpy as np
+    from concurrent.futures import Future
+
+    if pool is None:
+        fut = Future()
+        fut.set_result(np.asarray(dev))
+        return fut
+    fut = pool.submit(np.asarray, dev)
+    fut.add_done_callback(lambda f: f.cancelled() or f.exception())
+    return fut
+
+
 @dataclass
 class ScheduleResult:
     pod_key: str
@@ -523,28 +540,46 @@ class BatchScheduler:
         static (ref: SURVEY §3.4 — scores only move when annotations
         change), so results are otherwise identical."""
         from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+
+        import numpy as np
 
         if depth < 1:
             raise ValueError("depth must be >= 1")
-        pending = deque()  # (device array, keys, now, names, n)
-        for pods in batches:
-            now = self._clock()
-            self.refresh()
-            prepared = self._prepare(now)
-            dev = self._sharded.packed(prepared, len(pods), now=now)
-            dev.copy_to_host_async()
-            keys = [pod.key() for pod in pods]
-            pending.append((dev, keys, now, self._prepared_names, self._prepared_n))
-            if len(pending) >= depth:
+        pending = deque()  # (fetch future, keys, now, names, n)
+        # single prefetch worker (depth > 1 only — at depth 1 the drain
+        # immediately follows dispatch, so a worker hop buys nothing):
+        # the blocking device->host wait (a full tunnel round-trip per
+        # cycle) runs OFF the scheduling thread, overlapping the next
+        # cycle's host work (annotator sync, bind application). One
+        # worker keeps fetches in dispatch order; ALL cluster mutation
+        # stays on this thread, so semantics are unchanged.
+        pool = ThreadPoolExecutor(max_workers=1) if depth > 1 else None
+        try:
+            for pods in batches:
+                now = self._clock()
+                self.refresh()
+                prepared = self._prepare(now)
+                dev = self._sharded.packed(prepared, len(pods), now=now)
+                dev.copy_to_host_async()
+                keys = [pod.key() for pod in pods]
+                pending.append((
+                    _submit_fetch(pool, dev), keys, now,
+                    self._prepared_names, self._prepared_n,
+                ))
+                if len(pending) >= depth:
+                    yield self._drain_pipelined(pending.popleft(), bind)
+            while pending:
                 yield self._drain_pipelined(pending.popleft(), bind)
-        while pending:
-            yield self._drain_pipelined(pending.popleft(), bind)
+        finally:
+            if pool is not None:
+                # abandonment must not block on in-flight tunnel
+                # fetches; the worker finishes in the background
+                pool.shutdown(wait=False, cancel_futures=True)
 
     def _drain_pipelined(self, pending, bind: bool) -> BatchResult:
-        import numpy as np
-
-        dev, keys, now, names, n = pending
-        packed = np.asarray(dev)  # the only synchronization point
+        fut, keys, now, names, n = pending
+        packed = fut.result()  # the only synchronization point
         result = self._build_result(packed, keys, now=now, names=names, n=n)
         if bind:
             self._apply_binds(result, now)
@@ -585,28 +620,39 @@ class BatchScheduler:
                 "cluster does not support columnar bursts; use "
                 "schedule_batch with Pod objects"
             )
+        from concurrent.futures import ThreadPoolExecutor
+
+        import numpy as np
+
         pending = deque()
-        for namespace, names in bursts:
-            now = self._clock()
-            self.refresh()
-            prepared = self._prepare(now)
-            dev = self._sharded.packed(prepared, len(names), now=now)
-            dev.copy_to_host_async()
-            handle = add_burst(namespace, names) if bind else None
-            pending.append(
-                (dev, namespace, names, handle, now,
-                 self._prepared_names, self._prepared_n)
-            )
-            if len(pending) >= depth:
+        # same single prefetch worker as schedule_batches_pipelined
+        # (depth > 1 only); mutation order is unchanged
+        pool = ThreadPoolExecutor(max_workers=1) if depth > 1 else None
+        try:
+            for namespace, names in bursts:
+                now = self._clock()
+                self.refresh()
+                prepared = self._prepare(now)
+                dev = self._sharded.packed(prepared, len(names), now=now)
+                dev.copy_to_host_async()
+                handle = add_burst(namespace, names) if bind else None
+                pending.append(
+                    (_submit_fetch(pool, dev), namespace, names,
+                     handle, now, self._prepared_names, self._prepared_n)
+                )
+                if len(pending) >= depth:
+                    yield self._drain_burst(pending.popleft(), bind)
+            while pending:
                 yield self._drain_burst(pending.popleft(), bind)
-        while pending:
-            yield self._drain_burst(pending.popleft(), bind)
+        finally:
+            if pool is not None:
+                pool.shutdown(wait=False, cancel_futures=True)
 
     def _drain_burst(self, item, bind: bool) -> BurstResult:
         import numpy as np
 
-        dev, namespace, names, handle, now, node_names, n = item
-        packed = np.asarray(dev)  # the only synchronization point
+        fut, namespace, names, handle, now, node_names, n = item
+        packed = fut.result()  # the only synchronization point
         schedulable, scores, counts, _unassigned, _ = self._sharded.unpack(
             packed, n
         )
@@ -650,7 +696,9 @@ class BatchScheduler:
         treated as immutable by every consumer."""
         cache = getattr(self, "_node_table_cache", None)
         if cache is None or cache[0] is not node_names or cache[1] != n:
-            cache = (node_names, n, list(node_names[:n]))
+            # a TUPLE: results alias this object, and downstream caches
+            # key on its identity — immutability is load-bearing
+            cache = (node_names, n, tuple(node_names[:n]))
             self._node_table_cache = cache
         return cache[2]
 

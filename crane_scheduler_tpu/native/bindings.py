@@ -91,7 +91,10 @@ class NativeBindingRecords:
             return
         with self._lock:
             cache = getattr(self, "_table_ids_cache", None)
-            if cache is not None and cache[0] is node_table:
+            if (cache is not None and cache[0] is node_table
+                    and len(cache[1]) == len(node_table)):
+                # length guard: a caller may legally grow a reused
+                # table in place (identity unchanged)
                 table_ids = cache[1]
             else:
                 table_ids = np.fromiter(
