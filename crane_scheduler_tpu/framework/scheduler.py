@@ -22,7 +22,10 @@ plugin — that is the framework's core acceptance criterion.
 from __future__ import annotations
 
 import time
+from concurrent.futures import Future
 from dataclasses import dataclass, field
+
+import numpy as np
 
 from ..cluster.state import ClusterState, Pod
 from ..framework.types import CycleState, NodeInfo, Status
@@ -36,9 +39,6 @@ def _submit_fetch(pool, dev):
     pool's worker when pipelining (exceptions are retrieved either by
     the drain or by the done-callback, so an abandoned generator never
     leaves a never-retrieved tunnel error), fetched inline at depth 1."""
-    import numpy as np
-    from concurrent.futures import Future
-
     if pool is None:
         fut = Future()
         fut.set_result(np.asarray(dev))
@@ -240,7 +240,9 @@ class BurstResult:
     namespace: str
     names: list  # pod names, row order
     node_idx: object  # np.int32 [len(names)], -1 = unassigned
-    node_table: list  # node names the column indexes
+    node_table: tuple  # node names the column indexes (IMMUTABLE:
+    # aliases the snapshot's shared table; identity-keyed caches
+    # depend on it never changing)
     bound_rows: object  # rows actually bound (None when bind=False)
     scores_row: object  # np int64 [n_nodes], row-aligned with node_table
     schedulable_row: object  # np bool [n_nodes]
@@ -542,8 +544,6 @@ class BatchScheduler:
         from collections import deque
         from concurrent.futures import ThreadPoolExecutor
 
-        import numpy as np
-
         if depth < 1:
             raise ValueError("depth must be >= 1")
         pending = deque()  # (fetch future, keys, now, names, n)
@@ -622,8 +622,6 @@ class BatchScheduler:
             )
         from concurrent.futures import ThreadPoolExecutor
 
-        import numpy as np
-
         pending = deque()
         # same single prefetch worker as schedule_batches_pipelined
         # (depth > 1 only); mutation order is unchanged
@@ -687,13 +685,14 @@ class BatchScheduler:
             now=now,
         )
 
-    def _burst_node_table(self, node_names, n: int) -> list:
-        """The burst's node table as a STABLE list object, cached on the
-        prepared snapshot's names tuple: bursts sharing one snapshot
-        reuse the same list, so identity-keyed caches downstream
-        (``bind_burst``'s remap, the native heap's interned-ids cache)
-        skip their 50k-name re-translation per burst. The list is
-        treated as immutable by every consumer."""
+    def _burst_node_table(self, node_names, n: int) -> tuple:
+        """The burst's node table as a STABLE, IMMUTABLE tuple, cached
+        on the prepared snapshot's names tuple: bursts sharing one
+        snapshot reuse the same object, so identity-keyed caches
+        downstream (``bind_burst``'s remap, the native heap's
+        interned-ids cache) skip their 50k-name re-translation per
+        burst. BurstResult.node_table aliases it — immutability is
+        load-bearing for those caches."""
         cache = getattr(self, "_node_table_cache", None)
         if cache is None or cache[0] is not node_names or cache[1] != n:
             # a TUPLE: results alias this object, and downstream caches

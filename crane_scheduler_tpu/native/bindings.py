@@ -82,9 +82,9 @@ class NativeBindingRecords:
         """Columnar push: intern the node table once, map the per-pod
         index column through it with numpy, and push the whole burst in
         ONE FFI call — no per-pod Python objects at all. The interned
-        ids are cached on the table OBJECT (the burst path reuses one
-        list per snapshot and treats it as immutable), so repeat bursts
-        skip the 50k-name intern sweep."""
+        ids are cached on the table OBJECT when it is a tuple (the
+        burst path reuses one immutable tuple per snapshot), so repeat
+        bursts skip the 50k-name intern sweep."""
         node_idx = np.asarray(node_idx, dtype=np.int64)
         n = len(node_idx)
         if not n:
@@ -92,9 +92,11 @@ class NativeBindingRecords:
         with self._lock:
             cache = getattr(self, "_table_ids_cache", None)
             if (cache is not None and cache[0] is node_table
-                    and len(cache[1]) == len(node_table)):
-                # length guard: a caller may legally grow a reused
-                # table in place (identity unchanged)
+                    and isinstance(node_table, tuple)):
+                # cached only for immutable tables (the burst path
+                # passes one tuple per snapshot): a mutable list could
+                # be edited in place with identity unchanged, silently
+                # serving stale ids — lists always re-intern
                 table_ids = cache[1]
             else:
                 table_ids = np.fromiter(

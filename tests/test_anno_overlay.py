@@ -124,3 +124,125 @@ def test_ghost_rows_dropped_at_fold():
     assert c.get_node("ghost") is None
     nodes = c.list_nodes()
     assert len(nodes) == 5 and all(n.annotations["k"] == "v" for n in nodes)
+
+
+def test_overlay_randomized_interleaving_matches_naive_model():
+    """Fuzz: random sequences of columnar patches, single patches, bulk
+    patches, add/delete, and reads must always observe exactly what a
+    naive apply-immediately model observes."""
+    import random
+
+    rng = random.Random(20260730)
+    for trial in range(30):
+        c = ClusterState()
+        model: dict[str, dict[str, str]] = {}
+        names_pool = [f"n{i}" for i in range(12)]
+        for n in names_pool[:8]:
+            c.add_node(Node(name=n, annotations={"base": "b"}))
+            model[n] = {"base": "b"}
+        live_tables: list[list[str]] = []
+        for step in range(60):
+            op = rng.random()
+            live = sorted(model)
+            if op < 0.40 and live:
+                # columnar patch over a random subset (sometimes reusing
+                # a previous names list object to hit the merge path)
+                if live_tables and rng.random() < 0.5:
+                    # reuse the OBJECT so the identity-keyed in-place
+                    # merge path (segments[-1][0] is names) is exercised;
+                    # the list may contain since-deleted names
+                    names = rng.choice(live_tables)
+                else:
+                    names = rng.sample(live, rng.randint(1, len(live)))
+                    live_tables.append(names)
+                key = f"k{rng.randint(0, 3)}"
+                values = [f"v{trial}.{step}.{i}" for i in range(len(names))]
+                c.patch_node_annotations_columns(names, {key: values})
+                for n, v in zip(names, values):
+                    if n in model:
+                        model[n][key] = v
+            elif op < 0.55 and live:
+                n = rng.choice(live)
+                key = f"k{rng.randint(0, 3)}"
+                c.patch_node_annotation(n, key, f"s{step}")
+                model[n][key] = f"s{step}"
+            elif op < 0.70 and live:
+                n = rng.choice(live)
+                c.patch_node_annotations_bulk({n: {"kb": f"b{step}"}})
+                model[n]["kb"] = f"b{step}"
+            elif op < 0.80 and live:
+                n = rng.choice(live)
+                c.delete_node(n)
+                del model[n]
+            elif op < 0.90:
+                n = rng.choice(names_pool)
+                c.add_node(Node(name=n, annotations={"fresh": str(step)}))
+                model[n] = {"fresh": str(step)}
+            else:
+                # full read folds everything
+                for node in c.list_nodes():
+                    assert dict(node.annotations) == model[node.name], (
+                        trial, step, node.name)
+            # spot-check a random node through get_node every step
+            if model:
+                n = rng.choice(sorted(model))
+                got = c.get_node(n)
+                assert got is not None and dict(got.annotations) == model[n], (
+                    trial, step, n)
+        for node in c.list_nodes():
+            assert dict(node.annotations) == model[node.name]
+
+
+def test_overlay_concurrent_readers_and_column_writers():
+    """Thread storm: column writers flushing sweeps while readers fold
+    via get_node/list_nodes — no exceptions, and the final state equals
+    the last writer's values."""
+    import threading
+
+    c = ClusterState()
+    names = [f"n{i:03d}" for i in range(300)]
+    for n in names:
+        c.add_node(Node(name=n, annotations={}))
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def writer():
+        try:
+            sweep = 0
+            while not stop.is_set():
+                sweep += 1
+                c.patch_node_annotations_columns(
+                    names, {"k": [f"w{sweep}"] * len(names),
+                            "hot": [str(sweep)] * len(names)}
+                )
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                node = c.get_node("n150")
+                assert node is not None
+                anno = dict(node.annotations)
+                if anno:
+                    assert anno["k"].startswith("w")
+                for nd in c.list_nodes()[:10]:
+                    dict(nd.annotations)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer) for _ in range(2)] + [
+        threading.Thread(target=reader) for _ in range(2)
+    ]
+    for t in threads:
+        t.start()
+    import time as _time
+
+    _time.sleep(1.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errors, errors
+    # final fold is coherent: every node carries one writer's sweep
+    final = {dict(n.annotations).get("k") for n in c.list_nodes()}
+    assert all(v and v.startswith("w") for v in final)

@@ -980,3 +980,25 @@ def test_flush_with_mixed_row_sets_is_one_patch_per_node(stub, client):
         anno = stub.state.nodes["node-000"]["metadata"]["annotations"]
     for m in metric_names:
         assert m in anno
+
+
+def test_sharded_subprocess_stub_serves_writes_and_aggregates_stats():
+    """SO_REUSEPORT shard mode (bench infrastructure): every shard holds
+    the full node set, writes land on whichever shard the kernel picked,
+    and stats aggregate across shards with the per-shard spread
+    visible."""
+    server = kube_stub.KubeStubSubprocess(shards=2)
+    client = None
+    try:
+        server.seed(200)
+        client = KubeClusterClient(server.url, concurrent_syncs=4)
+        per = {f"node-{i:05d}": {"m": "0.5,ts"} for i in range(200)}
+        assert client.patch_node_annotations_bulk(per) == 200
+        stats = server.stats()
+        assert stats["requests"].get("PATCH", 0) >= 200
+        assert len(stats["shard_requests"]) == 2
+        assert sum(stats["shard_requests"]) >= 200
+    finally:
+        if client is not None:
+            client.stop()
+        server.stop()
