@@ -508,6 +508,17 @@ def event_from_json(obj: dict) -> Event:
     )
 
 
+class _KubeBurstHandle:
+    """Burst handle pairing the mirror's columnar burst with the rows
+    whose creation POST the apiserver refused (never bound)."""
+
+    __slots__ = ("burst", "failed")
+
+    def __init__(self, burst, failed: set):
+        self.burst = burst
+        self.failed = failed
+
+
 class KubeClusterClient:
     """Informer-backed cluster view + API write-through.
 
@@ -1371,6 +1382,123 @@ class KubeClusterClient:
             return
         self._mirror.add_pod(pod)
 
+    def _post_batch(self, items: list[tuple[str, str, dict]]) -> list[bool]:
+        """THE non-idempotent POST batch: ``items`` are (key, path,
+        body). Large plain-http batches ride the native engine; 429s —
+        explicitly not processed, so safe to re-POST — re-drive through
+        the Python pool (which honors Retry-After/backoff) exactly as
+        small batches do; any other failure is durable. Single-sourced
+        here so bind_pods/add_pod_burst/bind_burst can't drift apart in
+        retry policy. Returns per-item success."""
+        n = len(items)
+        ok = [False] * n
+        retry: list[int] = []
+        flusher = self._get_native_flusher()
+        if flusher is not None and n >= _NATIVE_FLUSH_MIN:
+            reqs = [
+                self._render_request("POST", path, body)
+                for _, path, body in items
+            ]
+            statuses = flusher.flush(reqs, idempotent=False)
+            for i, status in enumerate(statuses.tolist()):
+                if 200 <= status < 300:
+                    ok[i] = True
+                else:
+                    self._count_native_failure(int(status))
+                    if status in _RETRYABLE_ANY:
+                        retry.append(i)
+        else:
+            retry = list(range(n))
+        if retry:
+            futs = [
+                (i, self._submit_write(
+                    items[i][0], "POST", items[i][1], items[i][2]))
+                for i in retry
+            ]
+            for i, fut in futs:
+                ok[i] = bool(fut.result())
+        return ok
+
+    # -- columnar bursts through the API -----------------------------------
+
+    def add_pod_burst(self, namespace: str, names: list):
+        """Columnar burst arrival through the API: one creation POST per
+        pod streamed over the native engine (the apiserver has no bulk
+        create), the mirror keeping the burst as rows. Rows whose POST
+        failed are marked dead in the handle so ``bind_burst`` never
+        binds a pod the server refused. Gives ``BatchScheduler``'s burst
+        mode (schedule_pod_burst / schedule_bursts_pipelined) the same
+        cluster contract the in-memory ClusterState provides.
+
+        The mirror burst registers BEFORE the POSTs go out: watch
+        echoes of the created pods then shadow existing rows through
+        the normal ``_add_pod_locked`` path instead of racing ahead and
+        leaving duplicate object+row entries. Rows the server refuses
+        are retired immediately after (a refused row is mirror-visible
+        only for the wire round-trip)."""
+        path = f"/api/v1/namespaces/{namespace}/pods"
+        burst = self._mirror.add_pod_burst(namespace, names)
+        ok = self._post_batch([
+            (f"{namespace}/{name}", path,
+             {"metadata": {"name": name, "namespace": namespace},
+              "spec": {}})
+            for name in names
+        ])
+        failed = {row for row, good in enumerate(ok) if not good}
+        if failed:
+            # server refused those creations: the rows must not exist
+            self._mirror.retire_burst_rows(burst, sorted(failed))
+        return _KubeBurstHandle(burst, failed)
+
+    def bind_burst(self, handle, node_table, node_idx, now=None) -> list[int]:
+        """Columnar bind through the binding subresource: one POST per
+        bound row streamed over the native engine, the mirror applying
+        placements for the rows the server accepted — WITHOUT local
+        event emission (the apiserver's Scheduled events arrive through
+        the watch, exactly like ``bind_pod``). Returns bound rows."""
+        import numpy as _np2
+
+        burst = handle.burst
+        node_idx = _np2.asarray(node_idx, dtype=_np2.int32)
+        rows = [
+            row for row in range(len(node_idx))
+            if node_idx[row] >= 0 and row not in handle.failed
+        ]
+        if not rows:
+            return []
+        ns = burst.namespace
+        names = burst.names
+        items = []
+        for row in rows:
+            pod_key = f"{ns}/{names[row]}"
+            path, body = self._binding_request(
+                pod_key, node_table[int(node_idx[row])]
+            )
+            items.append((pod_key, path, body))
+        ok = self._post_batch(items)
+        ok_rows = [row for row, good in zip(rows, ok) if good]
+        # Optimistic mirror apply for accepted rows, no local events.
+        # The pods watch echoes creations quickly, shadowing burst rows
+        # into object pods — the columnar apply covers rows still in
+        # burst form; echoed rows take the object path (_apply_bound),
+        # exactly like per-pod bind_pod's optimistic apply.
+        mirror_idx = _np2.full((len(node_idx),), -1, dtype=_np2.int32)
+        ok_rows = sorted(ok_rows)
+        mirror_idx[ok_rows] = node_idx[ok_rows]
+        columnar_bound = set(
+            int(r) for r in self._mirror.bind_burst(
+                burst, node_table, mirror_idx, now, notify=False
+            )
+        )
+        for row in ok_rows:
+            if row not in columnar_bound:
+                self._apply_bound(
+                    f"{ns}/{names[row]}", node_table[int(node_idx[row])]
+                )
+        # the SERVER's acceptance defines what bound (the mirror is a
+        # cache in whatever form each row currently takes)
+        return ok_rows
+
     @staticmethod
     def _binding_request(pod_key: str, node_name: str) -> tuple[str, dict]:
         namespace, name = pod_key.split("/", 1)
@@ -1402,56 +1530,22 @@ class KubeClusterClient:
         return True
 
     def bind_pods(self, assignments, now: float | None = None) -> list[str]:
-        """Bind a batch: all binding POSTs are submitted to the write
-        pool up front (``concurrent_syncs`` parallel workers over
-        keep-alive connections — the kube-scheduler framework binds from
-        parallel goroutines the same way), then gathered in input order
-        so the returned bound-key list is deterministic."""
-        items = list(
+        """Bind a batch through the binding subresource: POSTs stream
+        over the shared batch path (native engine when large, pooled
+        workers otherwise; 429s re-driven — see ``_post_batch``),
+        gathered in input order so the returned bound-key list is
+        deterministic."""
+        pairs = list(
             assignments.items() if hasattr(assignments, "items") else assignments
         )
-        bound = []
-        if len(items) >= _NATIVE_FLUSH_MIN:
-            flusher = self._get_native_flusher()
-            if flusher is not None:
-                # binding POSTs are NOT idempotent: the engine retries
-                # send-phase failures only, and nothing is re-driven
-                # through the pool afterwards (a response-phase loss is
-                # ambiguous — re-POSTing could double-bind; callers own
-                # reconciliation, exactly as with a pool failure)
-                reqs = []
-                for pod_key, node_name in items:
-                    path, body = self._binding_request(pod_key, node_name)
-                    reqs.append(self._render_request("POST", path, body))
-                statuses = flusher.flush(reqs, idempotent=False)
-                retry_binds = []
-                for (pod_key, node_name), status in zip(
-                    items, statuses.tolist()
-                ):
-                    if 200 <= status < 300:
-                        self._apply_bound(pod_key, node_name)
-                        bound.append(pod_key)
-                    elif status in _RETRYABLE_ANY:
-                        # 429 = explicitly not processed: safe to
-                        # re-POST through the pool (it honors
-                        # Retry-After/backoff even for POSTs)
-                        self._count_native_failure(int(status))
-                        retry_binds.append((pod_key, node_name))
-                    else:
-                        self._count_native_failure(int(status))
-                items = retry_binds
-                if not items:
-                    return bound
-        futs = []
-        for pod_key, node_name in items:
+        items = []
+        for pod_key, node_name in pairs:
             path, body = self._binding_request(pod_key, node_name)
-            futs.append((
-                pod_key,
-                node_name,
-                self._submit_write(pod_key, "POST", path, body),
-            ))
-        for pod_key, node_name, fut in futs:
-            if fut.result():
+            items.append((pod_key, path, body))
+        ok = self._post_batch(items)
+        bound = []
+        for (pod_key, node_name), good in zip(pairs, ok):
+            if good:
                 self._apply_bound(pod_key, node_name)
                 bound.append(pod_key)
         return bound

@@ -785,6 +785,17 @@ class ClusterState:
         self._table_slots_cache = (table, out)
         return out
 
+    def retire_burst_rows(self, burst: _PodBurst, rows) -> None:
+        """Remove burst rows entirely (e.g. pod creations an apiserver
+        refused): marked dead, dropped from the key index, invisible to
+        every read."""
+        with self._lock:
+            ns = burst.namespace
+            for row in rows:
+                self._burst_retire_row_locked(burst, row)
+                if self._burst_index is not None:
+                    self._burst_index.pop(f"{ns}/{burst.names[row]}", None)
+
     def _burst_counts_locked(self) -> dict[str, int] | None:
         """Bound-pod counts contributed by live burst rows, as a dict —
         rebuilt lazily from the slot array and cached on the counts
@@ -854,7 +865,8 @@ class ClusterState:
             out.extend(b.materialize(int(r)) for r in rows)
         return out
 
-    def bind_burst(self, burst: _PodBurst, node_table: list, node_idx, now=None):
+    def bind_burst(self, burst: _PodBurst, node_table: list, node_idx,
+                   now=None, notify: bool = True):
         """Columnar bind: row ``i`` -> ``node_table[node_idx[i]]``
         (``-1`` leaves the row pending). One lock transaction applies the
         whole column, stamps ``sched_version``/resourceVersions exactly
@@ -862,7 +874,14 @@ class ClusterState:
         bounded log's tail (the deque would evict the rest anyway) and
         for subscribers without columnar support, and hands columnar
         subscribers ``(node_table, node_idx_bound, now)``. Returns the
-        bound row indices (ascending = event order)."""
+        bound row indices (ascending = event order).
+
+        ``notify=False`` applies placements WITHOUT recording or
+        delivering Scheduled events: the kube client's optimistic
+        mirror apply uses it — the apiserver emits the authoritative
+        event, which arrives through the watch (exactly the per-pod
+        ``bind_pod`` rule; local emission would double-count hot
+        values)."""
         if now is None:
             now = time.time()
         node_idx = np.asarray(node_idx, dtype=np.int32)
@@ -914,14 +933,22 @@ class ClusterState:
             self._sched_version += n
             rv_base = self._rv_next
             self._rv_next += n
-            handlers = list(self._event_handlers)
-            batch = list(zip(self._batch_handlers, self._batch_columnar))
+            if notify:
+                handlers = list(self._event_handlers)
+                batch = list(zip(self._batch_handlers, self._batch_columnar))
+            else:
+                handlers, batch = [], []
             need_full = bool(handlers) or any(c is None for _, c in batch)
             # materialize the log tail (bounded: the deque would evict
             # everything older) — or everything if a legacy subscriber
             # needs per-Event delivery
             maxlen = self._events.maxlen or n
-            first = 0 if need_full else max(0, n - maxlen)
+            if not notify:
+                first = n  # no local events at all: the server's arrive
+            elif need_full:
+                first = 0
+            else:
+                first = max(0, n - maxlen)
             tail_events: list[Event] = []
             ns = burst.namespace
             names = burst.names
@@ -945,9 +972,10 @@ class ClusterState:
                     resource_version=rv_base + k,
                 )
                 tail_events.append(ev)
-            for ev in tail_events[-maxlen:] if need_full else tail_events:
-                self._events.append(ev)
-                self._event_index[f"{ev.namespace}/{ev.name}"] = ev
+            if notify:
+                for ev in tail_events[-maxlen:] if need_full else tail_events:
+                    self._events.append(ev)
+                    self._event_index[f"{ev.namespace}/{ev.name}"] = ev
         if n:
             for ev in tail_events if need_full else ():
                 for handler in handlers:

@@ -1002,3 +1002,113 @@ def test_sharded_subprocess_stub_serves_writes_and_aggregates_stats():
         if client is not None:
             client.stop()
         server.stop()
+
+
+# -- columnar bursts through the API (round 5) ----------------------------
+
+
+def test_kube_burst_add_and_bind_end_to_end(stub, client):
+    """The kube client's columnar burst API: creations + bindings
+    stream through the API, the mirror serves burst reads, the server
+    holds the placements, and the SERVER's Scheduled events feed hot
+    values exactly once (no local double emission)."""
+    from crane_scheduler_tpu.annotator.bindings import BindingRecords
+    from crane_scheduler_tpu.annotator.events import EventIngestor
+
+    for i in range(5):
+        stub.state.add_node(f"node-{i}", f"10.0.5.{i}")
+    client.start()
+    records = BindingRecords(1024, 600.0)
+    EventIngestor(client, records).start()
+
+    handle = client.add_pod_burst("bench", [f"bp{i}" for i in range(200)])
+    assert client.get_pod("bench/bp7") is not None
+    with stub.state.lock:
+        assert "bench/bp7" in stub.state.pods  # created server-side
+
+    table = tuple(f"node-{i}" for i in range(5))
+    idx = [i % 5 for i in range(200)]
+    bound = client.bind_burst(handle, table, idx)
+    assert len(bound) == 200
+    assert client.get_pod("bench/bp7").node_name == "node-2"
+    with stub.state.lock:
+        assert stub.state.pods["bench/bp7"]["spec"]["nodeName"] == "node-2"
+    # hot-value feedback arrives from the SERVER's events, once per pod
+    assert _wait_until(
+        lambda: sum(
+            records.get_last_node_binding_count(n, 600.0, NOW + 10)
+            for n in table
+        ) == 200
+    )
+    time.sleep(0.3)  # any double emission would keep counting
+    assert sum(
+        records.get_last_node_binding_count(n, 600.0, NOW + 10)
+        for n in table
+    ) == 200
+
+
+def test_kube_burst_refused_creation_rows_never_bind(stub, client):
+    stub.state.add_node("node-a", "10.0.0.1")
+    client.start()
+    stub.state.inject_write_faults((422, {"message": "invalid pod"}))
+    handle = client.add_pod_burst("bench", [f"rp{i}" for i in range(150)])
+    assert len(handle.failed) == 1
+    (failed_row,) = handle.failed
+    assert client.get_pod(f"bench/rp{failed_row}") is None
+    bound = client.bind_burst(
+        handle, ("node-a",), [0] * 150
+    )
+    assert len(bound) == 149 and failed_row not in bound
+    posts = [p for m, p in stub.state.requests
+             if m == "POST" and p.endswith("/binding")]
+    assert len(posts) == 149  # no binding POST for the refused row
+
+
+def test_kube_burst_bind_conflict_reconciles(stub, client):
+    stub.state.add_node("node-a", "10.0.0.1")
+    client.start()
+    handle = client.add_pod_burst("bench", [f"cp{i}" for i in range(150)])
+    stub.state.inject_write_faults((409, {"message": "already bound"}))
+    bound = client.bind_burst(handle, ("node-a",), [0] * 150)
+    assert len(bound) == 149
+    assert client.write_failures_by_status.get(409) == 1
+
+
+def test_batch_scheduler_burst_mode_over_kube(stub, client):
+    """BatchScheduler.schedule_pod_burst runs unchanged against the
+    kube client now that it implements the burst contract."""
+    import jax.numpy as jnp
+
+    from crane_scheduler_tpu.framework.scheduler import BatchScheduler
+
+    for i in range(4):
+        stub.state.add_node(f"node-{i}", f"10.0.6.{i}")
+    client.start()
+    fake = FakeMetricsSource()
+    for metric in {sp.name for sp in DEFAULT_POLICY.spec.sync_period}:
+        for i in range(4):
+            fake.set(metric, f"10.0.6.{i}", 0.1 + 0.2 * i, by="ip")
+    ann = NodeAnnotator(client, fake, DEFAULT_POLICY, AnnotatorConfig())
+    ann.sync_all_once(NOW)
+    batch = BatchScheduler(client, DEFAULT_POLICY, clock=lambda: NOW + 1,
+                           snapshot_bucket=8)
+    result = batch.schedule_pod_burst(
+        "bench", [f"kb{i}" for i in range(40)], bind=True
+    )
+    assert result.n_assigned == 40
+    with stub.state.lock:
+        for i in range(40):
+            assert stub.state.pods[f"bench/kb{i}"]["spec"]["nodeName"]
+
+
+def test_kube_burst_bind_429_redriven_like_bind_pods(stub, client):
+    """_post_batch single-sources the POST retry policy: a throttled
+    burst bind re-drives through the pool exactly like bind_pods."""
+    stub.state.add_node("node-a", "10.0.0.1")
+    client.start()
+    handle = client.add_pod_burst("bench", [f"tp{i}" for i in range(150)])
+    stub.state.inject_write_faults(
+        (429, {"message": "throttled"}, {"Retry-After": "0.05"})
+    )
+    bound = client.bind_burst(handle, ("node-a",), [0] * 150)
+    assert len(bound) == 150  # the throttled bind landed on retry
