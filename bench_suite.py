@@ -597,6 +597,29 @@ def config7(dtype, rtt):
         for _ in range(cycles):
             assigned += full_cycle()
         wall = time.perf_counter() - t0
+
+        # burst-mode loop through the SAME apiserver: columnar burst
+        # create + bind via KubeClusterClient's burst contract
+        # (round-5: kube burst API), sync+flush per cycle like above
+        def burst_stream():
+            for c in range(cycles):
+                ann.sync_all_once_bulk()
+                ann.flush_annotations()
+                base = (c + 100) * pods_per_cycle
+                yield ("bench", [f"kburst-{base + i}"
+                                 for i in range(pods_per_cycle)])
+
+        for _ in batch.schedule_bursts_pipelined(
+            [("bench", [f"kburst-w{i}" for i in range(pods_per_cycle)])],
+            bind=True,
+        ):
+            pass  # warm the burst path
+        t0 = time.perf_counter()
+        burst_assigned = sum(
+            r.n_assigned
+            for r in batch.schedule_bursts_pipelined(burst_stream(), bind=True)
+        )
+        burst_wall = time.perf_counter() - t0
         client.stop()
         ceiling = _client_write_ceiling(kube_stub, workers=concurrent_syncs)
         ceiling_pool = _client_write_ceiling(
@@ -624,6 +647,8 @@ def config7(dtype, rtt):
               "cycles": cycles,
               "assigned": assigned,
               "pods_per_sec_through_api": round(assigned / wall),
+              "pods_per_sec_through_api_burst": round(
+                  burst_assigned / burst_wall),
               "note": "through-API rates are bound by the single-process "
                       "Python stub apiserver, not the client: the native "
                       "flush ceiling vs the null responder is the "
