@@ -337,3 +337,39 @@ def test_bind_burst_duplicate_names_in_table_still_counts_exactly():
 
     vec = cluster.bound_counts_for(["node-a", "node-b", "ghost"])
     assert vec.tolist() == [4, 2, 0]
+
+
+def test_compact_unpack_field_boundaries():
+    """Hand-packed uint32 rows at the bitfield extremes: counts at the
+    18-bit cap, score at the 13-bit cap, schedulable bit set/unset, and
+    a negative waterline surviving the int32 bitcast."""
+    from crane_scheduler_tpu.parallel.sharded import (
+        COMPACT_COUNT_BITS,
+        COMPACT_MAX_PODS,
+        ShardedScheduleStep,
+    )
+
+    count_max = COMPACT_MAX_PODS - 1
+    score_max = (1 << (31 - COMPACT_COUNT_BITS)) - 1
+    rows = [
+        (0, 0, 0),
+        (count_max, score_max, 1),
+        (count_max, 0, 0),
+        (0, score_max, 1),
+        (12345, 100, 1),
+    ]
+    body = np.asarray(
+        [c | (s << COMPACT_COUNT_BITS) | (b << 31) for c, s, b in rows],
+        dtype=np.uint32,
+    )
+    tail = np.asarray([7, np.uint32(np.int32(-1).view(np.uint32))],
+                      dtype=np.uint32)
+    packed = np.concatenate([body, tail])
+    sched, scores, counts, unassigned, waterline = ShardedScheduleStep.unpack(
+        packed, len(rows)
+    )
+    assert counts.tolist() == [c for c, _, _ in rows]
+    assert scores.tolist() == [s for _, s, _ in rows]
+    assert sched.tolist() == [bool(b) for _, _, b in rows]
+    assert unassigned == 7
+    assert waterline == -1
