@@ -63,6 +63,49 @@ class _WeightedPlugin:
     weight: int = 1
 
 
+class _OverlappedRefresh:
+    """Double-buffered store refresh for the pipelined loops: the
+    reference keeps the metrics-sync path off the scheduling hot path
+    (annotator/scheduler decoupling) — here ``tick()`` kicks a
+    background ``BatchScheduler.refresh()`` when none is in flight and
+    returns WITHOUT waiting, so ``_prepare`` consumes the store state of
+    the last COMPLETED ingest instead of blocking the cycle on a fresh
+    one. The first tick blocks (a cold scheduler must not score an empty
+    store); worker exceptions surface on the next tick. The store's own
+    lock makes the concurrent ingest safe; the version counter keeps the
+    device snapshot coherent with whatever state ``_prepare`` observes."""
+
+    def __init__(self, scheduler: "BatchScheduler"):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._scheduler = scheduler
+        self._pool = ThreadPoolExecutor(max_workers=1)
+        self._fut: Future | None = None
+        self._first = True
+
+    def tick(self) -> None:
+        sched = self._scheduler
+        if self._first:
+            self._first = False
+            self._pool.submit(sched.refresh).result()
+            return
+        fut = self._fut
+        if fut is not None:
+            if not fut.done():
+                # ingest still in flight: score the last-completed
+                # snapshot rather than stalling the cycle
+                sched.refresh_stats["overlap_hits"] += 1
+                return
+            self._fut = None
+            fut.result()  # surface worker errors, at most one tick late
+        self._fut = self._pool.submit(sched.refresh)
+
+    def close(self) -> None:
+        # never block loop teardown on an in-flight ingest — it drains in
+        # the background against a store that outlives this loop
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+
 class Scheduler:
     """Plugin-driven single-pod scheduler (the reference-shaped path).
 
@@ -355,10 +398,17 @@ class BatchScheduler:
             "columns": 0,  # column-log replay ([N] vectors per column)
             "delta": 0,  # row-delta scatter
             "full": 0,  # full snapshot + H2D upload
+            "ingest_ms": 0.0,  # host ms spent in refresh() bulk ingest
+            "risk_rescan_rows": 0,  # rows the hybrid f64 risk scan touched
+            "overlap_hits": 0,  # pipelined cycles served without blocking
+            # on an in-flight background refresh (overlap_refresh mode)
         }
         # device-resident snapshot cache: (store version, padded N) it was
         # built from; an unchanged store re-dispatches with zero uploads
         self._prepared = None
+        self._rescan_counted = None  # last PreparedSnapshot counted into
+        # risk_rescan_rows (a no-op override refresh returns the same
+        # object and must not re-count)
         self._prepared_key = None
         self._prepared_layout = None
         self._prepared_snap = None  # host snapshot behind self._prepared
@@ -370,14 +420,26 @@ class BatchScheduler:
         direct-mode shared store skips this — the annotator owns it."""
         if not self._refresh_from_cluster:
             return
+        t0 = time.perf_counter()
         nodes = self.cluster.list_nodes()
         self.store.bulk_ingest((n.name, n.annotations) for n in nodes)
         self.store.prune_absent(n.name for n in nodes)
+        self.refresh_stats["ingest_ms"] += (time.perf_counter() - t0) * 1e3
 
     # Delta uploads only pay off while the dirt is sparse: past this
     # fraction of rows a full column re-upload is cheaper than the
     # scatter (and avoids accumulating scatter chains).
     _DELTA_MAX_FRACTION = 0.25
+
+    def _note_rescan(self) -> None:
+        """Fold the latest override refresh's scanned-row count into
+        ``refresh_stats["risk_rescan_rows"]`` — once per refreshed
+        PreparedSnapshot object."""
+        p = self._prepared
+        if p is None or p.ovr_mask is None or p is self._rescan_counted:
+            return
+        self._rescan_counted = p
+        self.refresh_stats["risk_rescan_rows"] += int(p.ovr_rescan_rows)
 
     def _prepare(self, now: float):
         """Upload (or reuse) the device snapshot for the current store.
@@ -412,6 +474,7 @@ class BatchScheduler:
                 self._prepared = self._sharded.with_overrides(
                     self._prepared, self._prepared_snap, now
                 )
+                self._note_rescan()
                 return self._prepared
             if not stale_epoch:
                 self.refresh_stats["hit"] += 1
@@ -446,9 +509,16 @@ class BatchScheduler:
                             if hv is not None:
                                 snap.hot_value[ids] = hv
                                 snap.hot_ts[ids] = ht
-                        self._prepared = self._sharded.with_overrides(
-                            self._prepared, snap, now, force=True
+                        # the touched rows are the dirty set: the rescue
+                        # refresh rescans O(dirty + boundary band), not N
+                        dirty = np.unique(
+                            np.concatenate([e[1] for e in entries])
                         )
+                        self._prepared = self._sharded.with_overrides(
+                            self._prepared, snap, now, force=True,
+                            dirty_rows=dirty,
+                        )
+                        self._note_rescan()
                     return self._prepared
 
             (new_key, layout, rows, values_rows, ts_rows, hot_rows,
@@ -467,20 +537,23 @@ class BatchScheduler:
                     # fold the SAME delta into the cached host snapshot
                     # (re-snapshotting could observe newer data than the
                     # device rows, breaking override parity), then
-                    # recompute the rescue vectors from it
+                    # recompute the rescue vectors for the dirty rows
                     snap = self._prepared_snap
                     snap.values[rows] = values_rows
                     snap.ts[rows] = ts_rows
                     snap.hot_value[rows] = hot_rows
                     snap.hot_ts[rows] = hot_ts_rows
                     self._prepared = self._sharded.with_overrides(
-                        self._prepared, snap, now, force=True
+                        self._prepared, snap, now, force=True,
+                        dirty_rows=rows,
                     )
+                    self._note_rescan()
                 return self._prepared
 
         self.refresh_stats["full"] += 1
         snap = self.store.snapshot(bucket=self._bucket)
         self._prepared = self._sharded.prepare(snap, now)
+        self._note_rescan()
         self._prepared_key = key
         self._prepared_layout = getattr(self.store, "layout_version", None)
         # only hybrid override refreshes re-read the host snapshot;
@@ -520,7 +593,8 @@ class BatchScheduler:
             result.unassigned.extend(failed)
 
     def schedule_batches_pipelined(self, batches, bind: bool = True,
-                                   depth: int = 4):
+                                   depth: int = 4,
+                                   overlap_refresh: bool = False):
         """Pipelined burst scheduling: dispatch up to ``depth`` cycles
         ahead (JAX dispatch is asynchronous) and start each result's
         device->host copy immediately (``copy_to_host_async``) BEFORE
@@ -540,12 +614,24 @@ class BatchScheduler:
         ``depth - 1`` cycles' binds (bounded lag in the event->hot-value
         feedback); within one annotator sync window node scores are
         static (ref: SURVEY §3.4 — scores only move when annotations
-        change), so results are otherwise identical."""
+        change), so results are otherwise identical.
+
+        ``overlap_refresh``: run the cluster re-ingest on a background
+        worker, double-buffered against ``_prepare`` — each cycle scores
+        the last-completed store state instead of blocking on ingest
+        (the reference's annotator/scheduler decoupling; adds at most
+        one refresh interval of annotation lag, same order as the
+        pipeline's own bind lag). ``refresh_stats["overlap_hits"]``
+        counts the cycles that skipped the wait."""
         from collections import deque
         from concurrent.futures import ThreadPoolExecutor
 
         if depth < 1:
             raise ValueError("depth must be >= 1")
+        refresher = (
+            _OverlappedRefresh(self)
+            if overlap_refresh and self._refresh_from_cluster else None
+        )
         pending = deque()  # (fetch future, keys, now, names, n)
         # single prefetch worker (depth > 1 only — at depth 1 the drain
         # immediately follows dispatch, so a worker hop buys nothing):
@@ -558,7 +644,10 @@ class BatchScheduler:
         try:
             for pods in batches:
                 now = self._clock()
-                self.refresh()
+                if refresher is not None:
+                    refresher.tick()
+                else:
+                    self.refresh()
                 prepared = self._prepare(now)
                 dev = self._sharded.packed(prepared, len(pods), now=now)
                 dev.copy_to_host_async()
@@ -572,6 +661,8 @@ class BatchScheduler:
             while pending:
                 yield self._drain_pipelined(pending.popleft(), bind)
         finally:
+            if refresher is not None:
+                refresher.close()
             if pool is not None:
                 # abandonment must not block on in-flight tunnel
                 # fetches; the worker finishes in the background
@@ -603,13 +694,17 @@ class BatchScheduler:
         raise RuntimeError("empty burst stream")  # pragma: no cover
 
     def schedule_bursts_pipelined(
-        self, bursts, bind: bool = True, depth: int = 4
+        self, bursts, bind: bool = True, depth: int = 4,
+        overlap_refresh: bool = False,
     ):
         """Pipelined columnar bursts: ``bursts`` yields ``(namespace,
         names)`` pairs; one ``BurstResult`` per burst, in order. Same
         dispatch/drain overlap (and the same bounded feedback lag) as
-        ``schedule_batches_pipelined``. Requires a burst-capable cluster
-        (``add_pod_burst``/``bind_burst`` — ClusterState has them)."""
+        ``schedule_batches_pipelined``, including ``overlap_refresh``
+        (background double-buffered ingest — cycles consume the
+        last-completed store state instead of blocking on it). Requires
+        a burst-capable cluster (``add_pod_burst``/``bind_burst`` —
+        ClusterState has them)."""
         from collections import deque
 
         if depth < 1:
@@ -622,6 +717,10 @@ class BatchScheduler:
             )
         from concurrent.futures import ThreadPoolExecutor
 
+        refresher = (
+            _OverlappedRefresh(self)
+            if overlap_refresh and self._refresh_from_cluster else None
+        )
         pending = deque()
         # same single prefetch worker as schedule_batches_pipelined
         # (depth > 1 only); mutation order is unchanged
@@ -629,7 +728,10 @@ class BatchScheduler:
         try:
             for namespace, names in bursts:
                 now = self._clock()
-                self.refresh()
+                if refresher is not None:
+                    refresher.tick()
+                else:
+                    self.refresh()
                 prepared = self._prepare(now)
                 dev = self._sharded.packed(prepared, len(names), now=now)
                 dev.copy_to_host_async()
@@ -643,6 +745,8 @@ class BatchScheduler:
             while pending:
                 yield self._drain_burst(pending.popleft(), bind)
         finally:
+            if refresher is not None:
+                refresher.close()
             if pool is not None:
                 pool.shutdown(wait=False, cancel_futures=True)
 

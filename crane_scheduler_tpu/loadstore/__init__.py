@@ -2,6 +2,7 @@ from .codec import (
     encode_annotation,
     decode_annotation,
     decode_annotation_or_missing,
+    bulk_decode_annotations,
     go_parse_float,
     format_metric_value,
 )
@@ -11,6 +12,7 @@ __all__ = [
     "encode_annotation",
     "decode_annotation",
     "decode_annotation_or_missing",
+    "bulk_decode_annotations",
     "go_parse_float",
     "format_metric_value",
     "NodeLoadStore",

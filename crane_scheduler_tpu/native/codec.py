@@ -13,7 +13,7 @@ from datetime import datetime, timedelta
 
 import numpy as np
 
-from ..loadstore.codec import decode_annotation
+from ..loadstore.codec import bulk_decode_annotations
 from ..utils.timeutil import get_location
 from .lib import load_native
 
@@ -41,20 +41,23 @@ def bulk_parse_annotations(raw_strings) -> tuple[np.ndarray, np.ndarray]:
     lib = load_native()
     offset = _fixed_utc_offset_seconds()
     if lib is None or offset is None:
-        for i, raw in enumerate(raw_strings):
-            if raw is None:
-                continue
-            v, t = decode_annotation(raw)
-            if v is None or t is None:
-                continue
-            values[i], ts[i] = v, t
-        return values, ts
+        # vectorized numpy twin (also the DST-zone path: it parses
+        # through the exact per-string timestamp codec underneath)
+        return bulk_decode_annotations(raw_strings)
 
-    encoded = [(s or "").encode("utf-8", "replace") for s in raw_strings]
+    # one join + one encode (same ASCII fast path as bulk_parse_values:
+    # a byte/char length mismatch detects any non-ASCII batch exactly)
+    strs = [s if isinstance(s, str) else "" for s in raw_strings]
+    joined = "".join(strs)
+    buffer = joined.encode("utf-8", "replace")
     offsets = np.zeros((n + 1,), dtype=np.int64)
-    for i, b in enumerate(encoded):
-        offsets[i + 1] = offsets[i] + len(b)
-    buffer = b"".join(encoded)
+    if len(buffer) == len(joined):
+        np.cumsum(np.fromiter(map(len, strs), np.int64, count=n),
+                  out=offsets[1:])
+    else:
+        encoded = [s.encode("utf-8", "replace") for s in strs]
+        np.cumsum([len(b) for b in encoded], out=offsets[1:])
+        buffer = b"".join(encoded)
     lib.crane_parse_annotations(
         buffer,
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),

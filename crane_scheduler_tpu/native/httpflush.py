@@ -32,11 +32,27 @@ class NativeHTTPFlusher:
         if lib is None:
             raise RuntimeError("libcrane_native unavailable")
         self._lib = lib
-        # the C engine takes an IPv4 literal; resolve once up front
-        self._ip = socket.gethostbyname(host).encode("ascii")
+        self._host = host
         self._port = int(port)
         self._workers = int(workers)
         self._timeout_ms = max(1, int(timeout * 1000))
+        # the C engine takes an IPv4 literal; resolved up front, and
+        # re-resolved when a whole batch comes back transport-dead (DNS
+        # failover moved the apiserver while the client caches this
+        # flusher for its lifetime)
+        self._ip = self._resolve()
+
+    def _resolve(self) -> bytes:
+        """First A record for the host via getaddrinfo (honors
+        /etc/hosts, round-robin DNS, and IPv4 literals alike). The
+        engine speaks IPv4 only, so AAAA-only hosts fail here — callers
+        fall back to the Python pool, which connects by name."""
+        infos = socket.getaddrinfo(
+            self._host, self._port, socket.AF_INET, socket.SOCK_STREAM
+        )
+        if not infos:
+            raise OSError(f"no IPv4 address for {self._host!r}")
+        return infos[0][4][0].encode("ascii")
 
     def flush(self, requests: list[bytes], idempotent: bool = True) -> np.ndarray:
         """Send every request; return the per-request HTTP statuses
@@ -61,4 +77,13 @@ class NativeHTTPFlusher:
             self._timeout_ms,
             statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         )
+        if not statuses.any():
+            # every request died at the transport layer: the cached IPv4
+            # is suspect (apiserver failover). Re-resolve for the NEXT
+            # batch; keep the old address when resolution itself fails
+            # so a transient DNS outage can't zero out a working target.
+            try:
+                self._ip = self._resolve()
+            except OSError:
+                pass
         return statuses

@@ -21,6 +21,15 @@ _lock = threading.Lock()
 _lib = None
 _attempted = False
 
+# A prebuilt .so missing newer symbols normally just degrades to the
+# pure-Python paths: rebuilding at runtime means running make clean +
+# make synchronously under the module lock, stalling the first
+# native-path caller (and racing a concurrent process's dlopen against
+# our unlink). Opt in explicitly — dev/test loops set this; production
+# images ship a matching .so or none at all.
+_REBUILD_STALE_ENV = "CRANE_NATIVE_REBUILD_STALE"
+_REBUILD_TIMEOUT_SECONDS = 30
+
 
 def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     i64, i32 = ctypes.c_int64, ctypes.c_int32
@@ -79,17 +88,25 @@ def load_native():
         try:
             _lib = _configure(ctypes.CDLL(_SO_PATH))
         except AttributeError:
-            # stale prebuilt .so missing newer symbols: rebuild once and
+            # stale prebuilt .so missing newer symbols. Rebuild-and-
             # reload (make rewrites the file -> new inode -> dlopen
-            # loads fresh); degrade to None rather than crash consumers
+            # loads fresh) only when explicitly enabled (see
+            # _REBUILD_STALE_ENV) and with a short timeout; otherwise
+            # degrade to the pure-Python paths rather than stall the
+            # process for minutes under the module lock.
+            if os.environ.get(_REBUILD_STALE_ENV, "") not in ("1", "true", "yes"):
+                _lib = None
+                return None
             try:
                 subprocess.run(
                     ["make", "-C", _NATIVE_DIR, "clean"],
-                    check=True, capture_output=True, timeout=120,
+                    check=True, capture_output=True,
+                    timeout=_REBUILD_TIMEOUT_SECONDS,
                 )
                 subprocess.run(
                     ["make", "-C", _NATIVE_DIR],
-                    check=True, capture_output=True, timeout=120,
+                    check=True, capture_output=True,
+                    timeout=_REBUILD_TIMEOUT_SECONDS,
                 )
                 _lib = _configure(ctypes.CDLL(_SO_PATH))
             except (OSError, AttributeError, subprocess.SubprocessError):

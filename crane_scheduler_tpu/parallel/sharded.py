@@ -76,6 +76,12 @@ class PreparedSnapshot:
     ovr_sched: Any = None  # [N] bool
     ovr_score: Any = None  # [N] int32
     ovr_now: float | None = None  # wall-clock the overrides were computed at
+    # host-side incremental-rescan state (scorer.hybrid.OverrideCache):
+    # per-row cached risk bits/verdicts + validity margins, so an
+    # override refresh rescans O(dirty + boundary-band) rows instead of
+    # the full store (None for non-hybrid steps)
+    ovr_cache: Any = None
+    ovr_rescan_rows: int = 0  # rows rescanned by the last override refresh
 
 
 @dataclass
@@ -202,13 +208,18 @@ class ShardedScheduleStep:
 
         Host -> device transfer happens here, once per refresh; the jitted
         step then reruns against the resident arrays for any pod batch.
+        All scoring inputs ship in ONE batched ``device_put`` (a remote
+        runtime pays a full round trip per transfer call — the previous
+        per-array puts serialized most of the 50k-node cold refresh), and
+        the hybrid risk scan runs on host WHILE that async transfer is in
+        flight, so the scan is no longer on the upload's critical path.
         """
-        dtype = self.scorer.dtype
+        np_dtype = jnp.dtype(self.scorer.dtype)
         ts = np.asarray(snapshot.ts, np.float64)
         hot_ts = np.asarray(snapshot.hot_ts, np.float64)
         now_value = float(now)
         epoch = 0.0
-        if dtype != jnp.dtype(jnp.float64):
+        if np_dtype != jnp.dtype(jnp.float64):
             epoch = now_value  # exact in f64; deltas small enough for f32
             ts = ts - epoch
             hot_ts = hot_ts - epoch
@@ -218,55 +229,95 @@ class ShardedScheduleStep:
             capacity = np.full((n,), 1 << 30, dtype=np.int64)
         if offsets is None:
             offsets = np.zeros((n,), dtype=np.int32)
+        host = (
+            np.ascontiguousarray(np.asarray(snapshot.values), dtype=np_dtype),
+            np.ascontiguousarray(ts, dtype=np_dtype),
+            np.ascontiguousarray(np.asarray(snapshot.hot_value), dtype=np_dtype),
+            np.ascontiguousarray(hot_ts, dtype=np_dtype),
+            np.ascontiguousarray(np.asarray(snapshot.node_valid), dtype=bool),
+            np.ascontiguousarray(np.asarray(capacity, dtype=np.int64)),
+            np.ascontiguousarray(np.asarray(offsets, dtype=np.int32)),
+        )
+        values_d, ts_d, hot_d, hot_ts_d, valid_d, cap_d, off_d = jax.device_put(
+            host,
+            (self._row, self._row, self._vec, self._vec, self._vec,
+             self._vec, self._vec),
+        )
         ovr = {}
         if self.hybrid:
             ovr = self._override_vectors(snapshot, float(now))
         return PreparedSnapshot(
-            values=jax.device_put(jnp.asarray(snapshot.values, dtype), self._row),
-            ts=jax.device_put(jnp.asarray(ts, dtype), self._row),
-            hot_value=jax.device_put(jnp.asarray(snapshot.hot_value, dtype), self._vec),
-            hot_ts=jax.device_put(jnp.asarray(hot_ts, dtype), self._vec),
-            node_valid=jax.device_put(
-                jnp.asarray(snapshot.node_valid, jnp.bool_), self._vec
-            ),
-            now=jnp.asarray(now_value, dtype),
-            capacity=jax.device_put(jnp.asarray(capacity), self._vec),
-            offsets=jax.device_put(jnp.asarray(offsets, jnp.int32), self._vec),
+            values=values_d,
+            ts=ts_d,
+            hot_value=hot_d,
+            hot_ts=hot_ts_d,
+            node_valid=valid_d,
+            now=jnp.asarray(now_value, self.scorer.dtype),
+            capacity=cap_d,
+            offsets=off_d,
             epoch=epoch,
             **ovr,
         )
 
-    def _override_vectors(self, snapshot, now: float, rebase_age: float = 0.0) -> dict:
-        """Device-put the hybrid f64 rescue vectors for (snapshot, now)."""
-        from ..scorer.hybrid import compute_overrides
+    def _override_vectors(
+        self, snapshot, now: float, rebase_age: float = 0.0,
+        cache=None, dirty_rows=None,
+    ) -> dict:
+        """Compute + device-put the hybrid f64 rescue vectors for
+        ``(snapshot, now)``. With ``cache`` (an OverrideCache from an
+        earlier call), only dirty/boundary-band rows rescan — but this
+        path always re-uploads the full [N] vectors; ``with_overrides``
+        owns the cheaper device-side scatter."""
+        from ..scorer.hybrid import compute_overrides_incremental
 
-        ovr_mask, ovr_sched, ovr_score, _ = compute_overrides(
-            self.tensors,
-            snapshot.values,
-            snapshot.ts,
-            snapshot.hot_value,
-            snapshot.hot_ts,
-            snapshot.node_valid,
-            now,
-            rebase_age=rebase_age,
+        ovr_mask, ovr_sched, ovr_score, _, new_cache, scanned = (
+            compute_overrides_incremental(
+                self.tensors,
+                snapshot.values,
+                snapshot.ts,
+                snapshot.hot_value,
+                snapshot.hot_ts,
+                snapshot.node_valid,
+                now,
+                cache=cache,
+                dirty_rows=dirty_rows,
+                rebase_age=rebase_age,
+            )
+        )
+        mask_d, sched_d, score_d = jax.device_put(
+            (
+                np.ascontiguousarray(ovr_mask),
+                np.ascontiguousarray(ovr_sched),
+                np.ascontiguousarray(ovr_score, dtype=np.int32),
+            ),
+            (self._vec, self._vec, self._vec),
         )
         return {
-            "ovr_mask": jax.device_put(jnp.asarray(ovr_mask), self._vec),
-            "ovr_sched": jax.device_put(jnp.asarray(ovr_sched), self._vec),
-            "ovr_score": jax.device_put(jnp.asarray(ovr_score, jnp.int32), self._vec),
+            "ovr_mask": mask_d,
+            "ovr_sched": sched_d,
+            "ovr_score": score_d,
             "ovr_now": now,
+            "ovr_cache": new_cache,
+            "ovr_rescan_rows": scanned,
         }
 
     def with_overrides(
         self, prepared: PreparedSnapshot, snapshot, now: float,
-        force: bool = False,
+        force: bool = False, dirty_rows=None,
     ) -> PreparedSnapshot:
         """Refresh the hybrid rescue vectors for a new wall time against
-        the same (cached) snapshot — only three [N] vectors re-upload; the
-        resident load matrices are reused. No-op for non-hybrid steps or
-        (unless ``force``) when the overrides are already current for
-        ``now`` — force after ``apply_delta``, where the underlying data
-        changed at an unchanged scoring time.
+        the same (cached) snapshot. No-op for non-hybrid steps or (unless
+        ``force``) when the overrides are already current for ``now``.
+
+        With the snapshot's incremental cache (``ovr_cache``), only rows
+        whose inputs changed (``dirty_rows`` — pass the store's delta
+        rows; ``force`` with ``dirty_rows=None`` means unknown dirt and
+        falls back to a full rescan) or whose cached verdict can flip
+        with the clock (staleness-boundary band) are rescanned, and the
+        refreshed rows SCATTER into the resident device vectors — the
+        common annotator tick costs O(dirty) host work and a tiny upload
+        (zero when nothing changed) instead of an O(N·M) rescan plus
+        three [N] uploads.
 
         The f32 rounding of the rebased timestamps grows with
         ``now - epoch`` (the cached snapshot's age); the risk scan widens
@@ -292,9 +343,87 @@ class ShardedScheduleStep:
                 epoch=float(now),
                 **self._override_vectors(snapshot, float(now), rebase_age=0.0),
             )
+        cache = prepared.ovr_cache if prepared.ovr_mask is not None else None
+        if force and dirty_rows is None:
+            cache = None  # unknown mutations: a full rescan is required
+        if cache is None:
+            return dataclasses.replace(
+                prepared,
+                **self._override_vectors(snapshot, float(now), rebase_age=age),
+            )
+        from ..scorer.hybrid import compute_overrides_incremental
+
+        mask, sched, score, changed, new_cache, scanned = (
+            compute_overrides_incremental(
+                self.tensors,
+                snapshot.values,
+                snapshot.ts,
+                snapshot.hot_value,
+                snapshot.hot_ts,
+                snapshot.node_valid,
+                float(now),
+                cache=cache,
+                dirty_rows=dirty_rows,
+                rebase_age=age,
+            )
+        )
+        if changed is None:
+            # cache was rebuilt from scratch: full [N] re-upload
+            mask_d, sched_d, score_d = jax.device_put(
+                (mask, sched, np.ascontiguousarray(score, dtype=np.int32)),
+                (self._vec, self._vec, self._vec),
+            )
+            return dataclasses.replace(
+                prepared, ovr_mask=mask_d, ovr_sched=sched_d,
+                ovr_score=score_d, ovr_now=float(now),
+                ovr_cache=new_cache, ovr_rescan_rows=scanned,
+            )
+        if changed.size == 0:
+            # nothing to change on device: zero host scan, zero upload
+            return dataclasses.replace(
+                prepared, ovr_now=float(now), ovr_cache=new_cache,
+                ovr_rescan_rows=0,
+            )
+        import math as _math
+
+        k = changed.size
+        kpad = 1 << max(0, _math.ceil(_math.log2(k)))
+        npad = int(prepared.capacity.shape[0])
+        idx = np.full((kpad,), npad, dtype=np.int32)  # pad rows drop
+        idx[:k] = changed
+        m_rows = np.zeros((kpad,), dtype=bool)
+        m_rows[:k] = mask[changed]
+        s_rows = np.zeros((kpad,), dtype=bool)
+        s_rows[:k] = sched[changed]
+        sc_rows = np.zeros((kpad,), dtype=np.int32)
+        sc_rows[:k] = score[changed]
+        mask_d, sched_d, score_d = self._jit_ovr_scatter(
+            prepared.ovr_mask, prepared.ovr_sched, prepared.ovr_score,
+            jnp.asarray(idx), jnp.asarray(m_rows), jnp.asarray(s_rows),
+            jnp.asarray(sc_rows),
+        )
         return dataclasses.replace(
-            prepared,
-            **self._override_vectors(snapshot, float(now), rebase_age=age),
+            prepared, ovr_mask=mask_d, ovr_sched=sched_d, ovr_score=score_d,
+            ovr_now=float(now), ovr_cache=new_cache, ovr_rescan_rows=scanned,
+        )
+
+    @functools.cached_property
+    def _jit_ovr_scatter(self):
+        def scatter(mask, sched, score, idx, m_rows, s_rows, sc_rows):
+            # mode="drop": the kpad padding indices point past the array
+            return (
+                mask.at[idx].set(m_rows, mode="drop"),
+                sched.at[idx].set(s_rows, mode="drop"),
+                score.at[idx].set(sc_rows, mode="drop"),
+            )
+
+        return jax.jit(
+            scatter,
+            in_shardings=(
+                self._vec, self._vec, self._vec,
+                self._rep, self._rep, self._rep, self._rep,
+            ),
+            out_shardings=(self._vec, self._vec, self._vec),
         )
 
     def apply_delta(

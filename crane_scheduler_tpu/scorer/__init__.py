@@ -9,12 +9,20 @@ from .oracle import (
     score_node,
 )
 from .batched import BatchedScorer, ScoreResult
-from .hybrid import HybridScorer, compute_overrides, score_rows_f64
+from .hybrid import (
+    HybridScorer,
+    OverrideCache,
+    compute_overrides,
+    compute_overrides_incremental,
+    score_rows_f64,
+)
 from .topk import GangScheduler, gang_assign_host, gang_assign_oracle
 
 __all__ = [
     "HybridScorer",
+    "OverrideCache",
     "compute_overrides",
+    "compute_overrides_incremental",
     "score_rows_f64",
     "GangScheduler",
     "gang_assign_host",

@@ -294,10 +294,11 @@ void crane_render_f5(const double* vals, int64_t n, char* out,
     } else if (v < -1.7976931348623157e308) {
       std::memcpy(out + pos, "-Inf", 4);
       wrote = 4;
-    } else if (v >= 0.0 && v < 1.0e4) {
+    } else if (!std::signbit(v) && v < 1.0e4) {
       // fast fixed-point path (annotation loads are small nonnegative
       // reals; snprintf's general double->decimal dominated 50k-column
-      // render profiles). For v < 1e4, scaled < 1e9 so the multiply
+      // render profiles). signbit (not v >= 0.0) so -0.0 keeps the
+      // snprintf path: FormatFloat renders it "-0.00000". For v < 1e4, scaled < 1e9 so the multiply
       // error is <= 0.5 ulp ~ 1.1e-7; when the fractional part is
       // further than 1e-5 from the .5 rounding boundary the round
       // direction is provably identical to %.5f's exact rounding.

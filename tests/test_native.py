@@ -160,7 +160,10 @@ def test_bulk_render_f5_matches_python_and_handles_oversize():
     rng = np.random.default_rng(3)
     vals = np.concatenate([
         rng.uniform(0, 1, 5000),
-        [0.0, 1.0, 0.125, 2.5e-6, 1e30, 1.7e308,
+        # -0.0 must render "-0.00000" like FormatFloat — the fixed-point
+        # fast path admitted it (v >= 0.0 is true for negative zero) and
+        # dropped the sign until the signbit gate excluded it
+        [0.0, -0.0, 1.0, 0.125, 2.5e-6, 1e30, 1.7e308,
          float("nan"), float("inf"), float("-inf")],
     ])
     got = bulk_render_f5(vals)

@@ -1,0 +1,366 @@
+"""Refresh-path coverage: the vectorized batch codec, the incremental
+f64 risk rescan, the new refresh_stats counters, and the overlapped
+(background, double-buffered) refresh mode.
+
+The codec and rescan are parity-critical: every test here pins the fast
+path bit-for-bit against the slow per-string / full-scan twin it
+replaces, on randomized and boundary-heavy inputs.
+"""
+
+import random
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from crane_scheduler_tpu.loadstore import NodeLoadStore
+from crane_scheduler_tpu.loadstore.codec import (
+    bulk_decode_annotations,
+    decode_annotation_or_missing,
+)
+from crane_scheduler_tpu.policy import DEFAULT_POLICY, compile_policy
+from crane_scheduler_tpu.scorer.hybrid import (
+    compute_overrides,
+    compute_overrides_incremental,
+    risk_mask_f64,
+)
+from crane_scheduler_tpu.utils import format_local_time
+
+from test_hybrid import NOW, boundary_value, build_store
+
+TENSORS = compile_policy(DEFAULT_POLICY)
+
+
+# -- batch codec -----------------------------------------------------------
+
+
+def _fuzz_cases(rng, n):
+    ts_strs = [format_local_time(NOW - k * 37.0) for k in range(5)]
+    cases = []
+    for _ in range(n):
+        roll = rng.random()
+        ts = rng.choice(ts_strs)
+        if roll < 0.35:
+            cases.append(f"{boundary_value(rng):.7f},{ts}")
+        elif roll < 0.45:
+            cases.append(f"{rng.uniform(-5, 5):.5f},{ts}")
+        elif roll < 0.5:
+            cases.append(f"{rng.uniform(0, 1e6):.3e},{ts}")
+        elif roll < 0.55:
+            cases.append(rng.choice(["NaN", "Inf", "-Inf", "nan"]) + "," + ts)
+        elif roll < 0.6:
+            cases.append(None)
+        elif roll < 0.64:
+            cases.append("")
+        elif roll < 0.68:
+            cases.append("0.5")  # no comma: structurally invalid
+        elif roll < 0.72:
+            cases.append(f"0.5,0.6,{ts}")  # two commas: invalid
+        elif roll < 0.76:
+            cases.append(f"abc,{ts}")  # unparseable value
+        elif roll < 0.8:
+            cases.append("0.30000,2026-13-40T99:99:99Z")  # bad stamp
+        elif roll < 0.84:
+            cases.append(f"1_000.5,{ts}")  # Go underscore literal
+        elif roll < 0.88:
+            cases.append("0.30000,not-a-timestamp-20")  # 20 chars, junk
+        elif roll < 0.92:
+            cases.append(f"0.30000,{ts[:-1]}")  # 19-char stamp
+        elif roll < 0.96:
+            cases.append(f"+{rng.random():.5f},{ts}")  # signed: slow path
+        else:
+            cases.append(f"{rng.random():.5f},{ts} ")  # trailing junk
+    return cases
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_bulk_decode_matches_per_string_decoder(seed):
+    """bulk_decode_annotations is element-for-element bit-identical to
+    decode_annotation_or_missing, across valid, malformed, and
+    boundary-heavy wire strings (None entries included)."""
+    rng = random.Random(seed)
+    cases = _fuzz_cases(rng, 4000)
+    values, ts = bulk_decode_annotations(cases)
+    for i, raw in enumerate(cases):
+        want_v, want_t = (
+            decode_annotation_or_missing(raw)
+            if raw is not None else (float("nan"), float("-inf"))
+        )
+        got_v, got_t = float(values[i]), float(ts[i])
+        assert got_t == want_t, (i, raw)
+        assert (got_v == want_v) or (got_v != got_v and want_v != want_v), (
+            i, raw,
+        )
+
+
+def test_bulk_decode_non_ascii_falls_back_exactly():
+    """Non-ASCII bytes break the byte==char offset assumption; the codec
+    must detect that and decode per entry, still bit-identically."""
+    ts = format_local_time(NOW)
+    cases = [f"0.25000,{ts}", f"0.5é,{ts}", "€", f"1.0,{ts}"]
+    values, tsv = bulk_decode_annotations(cases)
+    for i, raw in enumerate(cases):
+        want_v, want_t = decode_annotation_or_missing(raw)
+        assert float(tsv[i]) == want_t
+        got_v = float(values[i])
+        assert (got_v == want_v) or (got_v != got_v and want_v != want_v)
+
+
+def test_store_bulk_ingest_matches_per_annotation_ingest():
+    """The store's batched ingest paths (ingest_node_annotations /
+    bulk_ingest) leave the matrices bit-identical to the per-annotation
+    ingest loop they vectorized."""
+    rng = random.Random(7)
+    ts_fresh = format_local_time(NOW)
+    annos = []
+    for i in range(80):
+        anno = {}
+        for m in TENSORS.metric_names:
+            if rng.random() < 0.15:
+                continue
+            anno[m] = f"{boundary_value(rng):.7f},{ts_fresh}"
+        if rng.random() < 0.1:
+            anno[rng.choice(TENSORS.metric_names)] = "garbage"
+        if rng.random() < 0.5:
+            anno["node_hot_value"] = f"{rng.choice(['0', '1', '2.5'])},{ts_fresh}"
+        anno["unrelated"] = "ignored,me"
+        annos.append((f"n{i}", anno))
+
+    slow = NodeLoadStore(TENSORS)
+    for name, anno in annos:
+        i = slow.add_node(name)
+        for key, raw in anno.items():
+            if key == "node_hot_value" or key in TENSORS.metric_index:
+                slow.ingest_annotation(name, key, raw)
+
+    via_node = NodeLoadStore(TENSORS)
+    for name, anno in annos:
+        via_node.ingest_node_annotations(name, anno)
+
+    via_bulk = NodeLoadStore(TENSORS)
+    via_bulk.bulk_ingest(annos)
+
+    n = len(slow)
+    for fast in (via_node, via_bulk):
+        np.testing.assert_array_equal(fast.values[:n], slow.values[:n])
+        np.testing.assert_array_equal(fast.ts[:n], slow.ts[:n])
+        np.testing.assert_array_equal(fast.hot_value[:n], slow.hot_value[:n])
+        np.testing.assert_array_equal(fast.hot_ts[:n], slow.hot_ts[:n])
+
+
+# -- incremental risk rescan ----------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_incremental_overrides_match_full_scan(seed):
+    """Across advancing clocks, sparse dirty rows, and validity flips,
+    the incremental rescan's override vectors — and therefore the
+    f64-rescued row set — stay bit-identical to a full
+    compute_overrides pass at every tick."""
+    store = build_store(300, seed)
+    rng = np.random.default_rng(seed)
+    n = len(store)
+    values = store.values[:n].copy()
+    ts = store.ts[:n].copy()
+    hot = store.hot_value[:n].copy()
+    hot_ts = store.hot_ts[:n].copy()
+    valid = np.ones((n,), dtype=bool)
+    valid[rng.integers(0, n, 5)] = False
+
+    cache = None
+    total_scanned = 0
+    for tick in range(14):
+        now = NOW + tick * 19.0
+        if tick:
+            dirty = rng.integers(0, n, rng.integers(0, 8))
+            values[dirty] = rng.uniform(0, 1, (dirty.size, values.shape[1]))
+            ts[dirty] = now - rng.uniform(0, 400, (dirty.size, ts.shape[1]))
+            if tick == 7:  # validity change: cache must fully rebuild
+                valid[rng.integers(0, n)] ^= True
+        else:
+            dirty = None
+        want = compute_overrides(
+            TENSORS, values, ts, hot, hot_ts, valid, now
+        )
+        got_mask, got_sched, got_score, changed, cache, scanned = (
+            compute_overrides_incremental(
+                TENSORS, values, ts, hot, hot_ts, valid, now,
+                cache=cache, dirty_rows=dirty,
+            )
+        )
+        total_scanned += scanned
+        np.testing.assert_array_equal(got_mask, want[0])
+        np.testing.assert_array_equal(got_sched, want[1])
+        np.testing.assert_array_equal(got_score, want[2])
+        # the rescued set is exactly the valid risky rows of a full scan
+        risk = risk_mask_f64(TENSORS, values, ts, hot, hot_ts, now)
+        np.testing.assert_array_equal(got_mask, risk & valid)
+    # incrementality is real: most ticks scan a small fraction of rows
+    assert total_scanned < 14 * n / 2
+
+
+def test_incremental_overrides_with_rebase_age_tolerance():
+    """rebase_age widens the staleness band; the incremental path must
+    stay identical to the full scan under the widened tolerance too."""
+    store = build_store(200, 11)
+    n = len(store)
+    values, ts = store.values[:n], store.ts[:n]
+    hot, hot_ts = store.hot_value[:n], store.hot_ts[:n]
+    valid = np.ones((n,), dtype=bool)
+    age = 3000.0
+    cache = None
+    for tick in range(6):
+        now = NOW + tick * 31.0
+        want = compute_overrides(
+            TENSORS, values, ts, hot, hot_ts, valid, now, rebase_age=age
+        )
+        got = compute_overrides_incremental(
+            TENSORS, values, ts, hot, hot_ts, valid, now,
+            cache=cache, dirty_rows=None if tick == 0 else [],
+            rebase_age=age,
+        )
+        cache = got[4]
+        np.testing.assert_array_equal(got[0], want[0])
+        np.testing.assert_array_equal(got[1], want[1])
+        np.testing.assert_array_equal(got[2], want[2])
+
+
+# -- refresh_stats counters -----------------------------------------------
+
+
+def _sim_batch(n_nodes=6, seed=9, direct=True):
+    from crane_scheduler_tpu.framework.scheduler import BatchScheduler
+    from crane_scheduler_tpu.sim import SimConfig, Simulator
+
+    sim = Simulator(SimConfig(n_nodes=n_nodes, seed=seed))
+    sim.sync_metrics()
+    if direct:
+        ann = sim.annotator
+        ann.config.bulk_sync = True
+        ann.config.direct_store = True
+        batch = BatchScheduler(
+            sim.cluster, sim.policy, dtype=jnp.float32, clock=sim.clock,
+            snapshot_bucket=16, refresh_from_cluster=False,
+        )
+        ann.attach_store(batch.store)
+        ann.sync_all_once_bulk(sim.clock())
+    else:
+        batch = BatchScheduler(
+            sim.cluster, sim.policy, dtype=jnp.float32, clock=sim.clock,
+            snapshot_bucket=16,
+        )
+    return sim, batch
+
+
+def test_refresh_stats_counters_on_each_path():
+    """The new counters attribute work to the intended paths: a full
+    prepare scans every row; an annotator column sweep serves via
+    `columns` with a bounded rescan; sparse foreign dirt serves via
+    `delta`; a layout change falls back to `full`."""
+    sim, batch = _sim_batch()
+    ann = sim.annotator
+    names = [f"p{i}" for i in range(4)]
+
+    batch.schedule_pod_burst("a", names)
+    assert batch.refresh_stats["full"] == 1
+    npad = batch._prepared.capacity.shape[0]
+    assert batch.refresh_stats["risk_rescan_rows"] == npad
+
+    # unchanged store, same tick shape: hit; the margin-based rescan
+    # must not rescan rows whose boundaries are far from the clock
+    batch.schedule_pod_burst("b", names, bind=False)
+    assert batch.refresh_stats["hit"] == 1
+
+    sim.clock.advance(30.0)
+    ann.sync_all_once_bulk(sim.clock())  # whole-column sweep
+    before = batch.refresh_stats["risk_rescan_rows"]
+    batch.schedule_pod_burst("c", names, bind=False)
+    assert batch.refresh_stats["columns"] == 1
+    # dirty set is the store's rows (6), not the padded matrix (16) —
+    # plus any rows whose staleness margin the 30s clock move crossed
+    assert batch.refresh_stats["risk_rescan_rows"] - before <= npad
+
+    node = batch.store.node_names[0]
+    batch.store.set_metric(node, batch.tensors.metric_names[0], 0.5, sim.clock())
+    batch.schedule_pod_burst("d", names, bind=False)
+    assert batch.refresh_stats["delta"] == 1
+
+    batch.store.add_node("brand-new-node")  # layout change: full only
+    batch.schedule_pod_burst("e", names, bind=False)
+    assert batch.refresh_stats["full"] == 2
+
+
+def test_refresh_ingest_ms_accumulates():
+    sim, batch = _sim_batch(direct=False)
+    assert batch.refresh_stats["ingest_ms"] == 0.0
+    batch.schedule_pod_burst("a", ["p0", "p1"])
+    assert batch.refresh_stats["ingest_ms"] > 0.0
+
+
+def test_delta_path_rescan_is_dirty_bounded():
+    """A sparse foreign write rescans O(dirty + boundary band) rows, not
+    the whole store: on a fresh store with far-from-boundary stamps the
+    delta tick's rescan must be exactly the dirty row."""
+    sim, batch = _sim_batch(n_nodes=12)
+    names = [f"p{i}" for i in range(3)]
+    batch.schedule_pod_burst("a", names)
+
+    node = batch.store.node_names[4]
+    batch.store.set_metric(node, batch.tensors.metric_names[0], 0.42, sim.clock())
+    before = batch.refresh_stats["risk_rescan_rows"]
+    batch.schedule_pod_burst("b", names, bind=False)
+    assert batch.refresh_stats["delta"] == 1
+    delta_scan = batch.refresh_stats["risk_rescan_rows"] - before
+    assert delta_scan <= 2  # the dirty row (+ at most a boundary row)
+
+
+# -- overlapped refresh ----------------------------------------------------
+
+
+def test_overlap_refresh_identical_results_and_counts_hits(monkeypatch):
+    """With a slow cluster ingest, the overlapped loop must (a) never
+    block cycles on the in-flight refresh (overlap_hits > 0), and (b)
+    produce placements identical to the blocking loop when the
+    annotations are static."""
+    sim, batch = _sim_batch(n_nodes=8, direct=False)
+    real_list = sim.cluster.list_nodes
+
+    def slow_list(*a, **k):
+        time.sleep(0.05)
+        return real_list(*a, **k)
+
+    monkeypatch.setattr(sim.cluster, "list_nodes", slow_list)
+    bursts = [("ns", [f"p{i}-{k}" for i in range(4)]) for k in range(5)]
+    overlapped = list(
+        batch.schedule_bursts_pipelined(bursts, depth=2, overlap_refresh=True)
+    )
+    assert len(overlapped) == 5
+    assert batch.refresh_stats["overlap_hits"] > 0
+
+    sim2, batch2 = _sim_batch(n_nodes=8, direct=False)
+    bursts2 = [("ns", [f"p{i}-{k}" for i in range(4)]) for k in range(5)]
+    blocking = list(batch2.schedule_bursts_pipelined(bursts2, depth=2))
+    for a, b in zip(overlapped, blocking):
+        np.testing.assert_array_equal(
+            np.asarray(a.node_idx), np.asarray(b.node_idx)
+        )
+
+
+def test_overlap_refresh_surfaces_worker_errors(monkeypatch):
+    sim, batch = _sim_batch(n_nodes=4, direct=False)
+    calls = {"n": 0}
+
+    def boom():
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise RuntimeError("ingest exploded")
+
+    monkeypatch.setattr(batch, "refresh", boom)
+    bursts = [("ns", [f"p{k}"]) for k in range(8)]
+    with pytest.raises(RuntimeError, match="ingest exploded"):
+        # plenty of cycles: the error lands on the tick after the
+        # failing background refresh completes
+        list(batch.schedule_bursts_pipelined(
+            bursts, depth=1, overlap_refresh=True, bind=False,
+        ))
