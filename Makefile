@@ -8,7 +8,7 @@ GIT_VERSION ?= $(shell git describe --tags --always --dirty 2>/dev/null || echo 
 IMAGE_ANNOTATOR := $(REGISTRY)/crane-annotator-tpu:$(GIT_VERSION)
 IMAGE_SCHEDULER := $(REGISTRY)/crane-scheduler-tpu:$(GIT_VERSION)
 
-.PHONY: all native test test-fast bench sim e2e clean \
+.PHONY: all native test test-fast bench sim e2e metrics-smoke clean \
 	images image-annotator image-scheduler push-images
 
 all: native test
@@ -30,6 +30,11 @@ sim:
 
 e2e:
 	$(PYTHON) examples/run_cpu_stress.py
+
+# scrape /metrics from a live sidecar and validate it with the strict
+# exposition parser (fails CI before a real scraper chokes)
+metrics-smoke:
+	$(PYTHON) tools/metrics_smoke.py
 
 # -- images (one parameterized Dockerfile per binary, like the
 # reference's ARG PKGNAME build; ref: Makefile images target) ----------

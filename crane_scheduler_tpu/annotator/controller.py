@@ -43,6 +43,7 @@ from ..utils.timeutil import format_local_time
 from ..loadstore.store import NodeLoadStore
 from ..metrics.source import MetricsQueryError, MetricsSource
 from ..policy.types import DynamicSchedulerPolicy
+from ..telemetry import Telemetry, active as active_telemetry
 from .bindings import BindingRecords, max_hot_value_time_range
 from .events import EventIngestor
 from .workqueue import RateLimitedQueue
@@ -104,11 +105,39 @@ class NodeAnnotator:
         metrics: MetricsSource,
         policy: DynamicSchedulerPolicy,
         config: AnnotatorConfig | None = None,
+        telemetry: Telemetry | None = None,
     ):
         self.cluster = cluster
         self.metrics = metrics
         self.policy = policy
         self.config = config or AnnotatorConfig()
+        self._telemetry = (
+            telemetry if telemetry is not None else active_telemetry()
+        )
+        self._m_sync_seconds = self._m_flush_seconds = None
+        self._m_queue_depth = self._m_backoff = self._m_errors = None
+        if self._telemetry is not None:
+            reg = self._telemetry.registry
+            self._m_sync_seconds = reg.histogram(
+                "crane_annotator_sync_seconds",
+                "Bulk metric sweep duration", ("metric",),
+            )
+            self._m_flush_seconds = reg.histogram(
+                "crane_annotator_patch_flush_seconds",
+                "Deferred annotation-patch flush latency",
+            )
+            self._m_queue_depth = reg.gauge(
+                "crane_annotator_workqueue_depth",
+                "Per-node work items queued or in backoff",
+            )
+            self._m_backoff = reg.counter(
+                "crane_annotator_backoff_retries_total",
+                "Sync items re-queued with exponential backoff",
+            )
+            self._m_errors = reg.counter(
+                "crane_annotator_sync_errors_total",
+                "Failed node/metric sync attempts",
+            )
         self.binding_records = None
         if self.config.use_native_bindings:
             try:
@@ -199,6 +228,16 @@ class NodeAnnotator:
         thread in threaded mode, or explicitly in synchronous tests).
         Uses the cluster's bulk patch primitive when present (one
         lock/PATCH per node instead of per (node, key))."""
+        m = self._m_flush_seconds
+        if m is None:
+            return self._flush_annotations_impl()
+        t0 = time.perf_counter()
+        total = self._flush_annotations_impl()
+        if total:  # idle emitter ticks must not pollute the latency hist
+            m.observe(time.perf_counter() - t0)
+        return total
+
+    def _flush_annotations_impl(self) -> int:
         with self._anno_lock:
             cols, self._anno_cols = self._anno_cols, []
         if not cols:
@@ -269,6 +308,8 @@ class NodeAnnotator:
             self.annotate_node_hot_value(node, now)
         except MetricsQueryError:
             self.sync_errors += 1
+            if self._m_errors is not None:
+                self._m_errors.inc()
             return False
         self.synced += 1
         return True
@@ -408,6 +449,25 @@ class NodeAnnotator:
         still gets its hot value from a later pass). Default None writes
         hot for every node, the standalone per-tick behavior.
         """
+        tel = self._telemetry
+        if tel is None:
+            return self._sync_metric_bulk_impl(
+                metric_name, now, hot_by_node, hot_emitted
+            )
+        t0 = time.perf_counter()
+        with tel.spans.span("annotator_sync", metric=metric_name):
+            patched = self._sync_metric_bulk_impl(
+                metric_name, now, hot_by_node, hot_emitted
+            )
+        self._m_sync_seconds.labels(metric=metric_name).observe(
+            time.perf_counter() - t0
+        )
+        self._m_queue_depth.set(len(self.queue))
+        return patched
+
+    def _sync_metric_bulk_impl(
+        self, metric_name, now, hot_by_node, hot_emitted
+    ) -> int:
         if now is None:
             now = time.time()
         self._prune_direct_store()
@@ -723,6 +783,10 @@ class NodeAnnotator:
                 self.queue.forget(item)
             else:
                 self.queue.add_rate_limited(item)
+                if self._m_backoff is not None:
+                    self._m_backoff.inc()
+            if self._m_queue_depth is not None:
+                self._m_queue_depth.set(len(self.queue))
 
     def _ticker(self, sync_policy) -> None:
         period = max(sync_policy.period_seconds, 0.01)

@@ -5,6 +5,11 @@ Serves the scoring API (POST /v1/score, POST /v1/assign, POST
 state: a live apiserver mirror (``--master``), or a simulated cluster
 with one annotator pass (``--demo-nodes``) so the service has data.
 
+``GET /metrics`` content-negotiates (Prometheus text exposition for
+scrapers, legacy JSON otherwise); ``GET /debug/decisions`` serves
+sampled decision traces and ``GET /debug/trace`` the Chrome
+trace-event spans — see doc/observability.md.
+
 Usage:
   python -m crane_scheduler_tpu.cli.service_main --port 8080 --demo-nodes 100
   python -m crane_scheduler_tpu.cli.service_main --port 8099 \
@@ -93,7 +98,11 @@ def main(argv=None) -> int:
     service.refresh()
     server = ScoringHTTPServer(service, port=args.port)
     server.start()
-    print(f"scoring service on :{server.port}", flush=True)
+    print(
+        f"scoring service on :{server.port} "
+        "(/v1/score /v1/assign /metrics /debug/decisions /debug/trace)",
+        flush=True,
+    )
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())

@@ -58,6 +58,7 @@ from .state import (
     Pod,
     ResourceRequirements,
 )
+from ..telemetry import Telemetry, active as active_telemetry
 
 DEFAULT_TIMEOUT_SECONDS = 10.0
 WATCH_TIMEOUT_SECONDS = 300.0
@@ -293,7 +294,14 @@ class _RawHTTPConnection:
                 size_line = self._rf.readline(65537).partition(b";")[0]
                 size = int(size_line.strip() or b"0", 16)
                 if size == 0:
-                    self._rf.readline(65537)  # blank line after last chunk
+                    # trailer section: a server may emit trailer fields
+                    # after the terminal chunk — consume lines until the
+                    # blank line (or EOF), or the next keep-alive
+                    # response on this connection parses as status 0
+                    while True:
+                        t = self._rf.readline(65537)
+                        if t in (b"\r\n", b"\n", b""):
+                            break
                     break
                 _keep(self._rf.read(size))
                 self._rf.readline(65537)  # chunk-trailing CRLF
@@ -332,8 +340,11 @@ class _PooledWriter(threading.Thread):
         token: str | None,
         context: ssl.SSLContext | None,
         timeout: float,
+        retry_counter=None,
     ):
         super().__init__(daemon=True)
+        # optional telemetry counter bumped per status-retry sleep
+        self._retry_counter = retry_counter
         u = urlsplit(base_url)
         self._scheme = u.scheme
         self._host = u.hostname or "127.0.0.1"
@@ -465,6 +476,8 @@ class _PooledWriter(threading.Thread):
             if not retryable or status_retries >= _MAX_STATUS_RETRIES:
                 return WriteResult(False, status, snippet, attempts - 1)
             status_retries += 1
+            if self._retry_counter is not None:
+                self._retry_counter.inc()
             retry_after = getattr(resp, "retry_after", None)
             if retry_after is None and hasattr(resp, "getheader"):
                 retry_after = resp.getheader("Retry-After")
@@ -570,8 +583,29 @@ class KubeClusterClient:
         seen_events_cap: int = 65536,
         list_page_limit: int = 500,
         concurrent_syncs: int = 4,
+        telemetry: Telemetry | None = None,
     ):
         self.base_url = base_url.rstrip("/")
+        self._telemetry = (
+            telemetry if telemetry is not None else active_telemetry()
+        )
+        self._m_flush_seconds = None
+        self._m_status_retries = None
+        self._m_native_failures = None
+        if self._telemetry is not None:
+            reg = self._telemetry.registry
+            self._m_flush_seconds = reg.histogram(
+                "crane_kube_flush_seconds",
+                "Write-through pool batch flush latency", ("kind",),
+            )
+            self._m_status_retries = reg.counter(
+                "crane_kube_status_retries_total",
+                "Pooled-writer retries on retryable HTTP statuses",
+            )
+            self._m_native_failures = reg.counter(
+                "crane_kube_native_flush_failures_total",
+                "Native flush-engine request failures", ("status",),
+            )
         u = urlsplit(self.base_url)
         self._scheme = u.scheme
         self._host = u.hostname or "127.0.0.1"
@@ -690,6 +724,7 @@ class KubeClusterClient:
                     w = _PooledWriter(
                         self.base_url, self._token, self._context,
                         self._timeout,
+                        retry_counter=self._m_status_retries,
                     )
                     w.start()
                     workers.append(w)
@@ -945,6 +980,8 @@ class KubeClusterClient:
         with self._native_lock:
             self._native_status_failures[status] = (
                 self._native_status_failures.get(status, 0) + 1)
+        if self._m_native_failures is not None:
+            self._m_native_failures.labels(status=str(status)).inc()
 
     @property
     def write_failures_by_status(self) -> dict[int, int]:
@@ -1241,6 +1278,16 @@ class KubeClusterClient:
         the annotator is the only node-annotation writer and flushes
         from one thread, so bypassing the per-key FIFO pool for the
         batch cannot reorder writes to a node."""
+        m = self._m_flush_seconds
+        if m is None:
+            return self._patch_node_annotations_bulk_impl(per_node)
+        t0 = time.perf_counter()
+        try:
+            return self._patch_node_annotations_bulk_impl(per_node)
+        finally:
+            m.labels(kind="annotations").observe(time.perf_counter() - t0)
+
+    def _patch_node_annotations_bulk_impl(self, per_node) -> int:
         items = list(per_node.items())
         patched = 0
         if len(items) >= _NATIVE_FLUSH_MIN:
@@ -1390,6 +1437,16 @@ class KubeClusterClient:
         small batches do; any other failure is durable. Single-sourced
         here so bind_pods/add_pod_burst/bind_burst can't drift apart in
         retry policy. Returns per-item success."""
+        m = self._m_flush_seconds
+        if m is None:
+            return self._post_batch_impl(items)
+        t0 = time.perf_counter()
+        try:
+            return self._post_batch_impl(items)
+        finally:
+            m.labels(kind="post_batch").observe(time.perf_counter() - t0)
+
+    def _post_batch_impl(self, items: list[tuple[str, str, dict]]) -> list[bool]:
         n = len(items)
         ok = [False] * n
         retry: list[int] = []

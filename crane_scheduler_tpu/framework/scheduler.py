@@ -32,9 +32,10 @@ from ..framework.types import CycleState, NodeInfo, Status
 from ..loadstore.store import NodeLoadStore
 from ..policy.compile import compile_policy
 from ..policy.types import DynamicSchedulerPolicy
+from ..telemetry import Telemetry, active as active_telemetry, maybe_span
 
 
-def _submit_fetch(pool, dev):
+def _submit_fetch(pool, dev, telemetry: Telemetry | None = None):
     """Fetch future for a dispatched device result: prefetched on the
     pool's worker when pipelining (exceptions are retrieved either by
     the drain or by the done-callback, so an abandoned generator never
@@ -43,9 +44,39 @@ def _submit_fetch(pool, dev):
         fut = Future()
         fut.set_result(np.asarray(dev))
         return fut
-    fut = pool.submit(np.asarray, dev)
+    if telemetry is None:
+        fut = pool.submit(np.asarray, dev)
+    else:
+        def _fetch():
+            # the async-D2H stage, on the prefetch worker's own track
+            with telemetry.spans.span("d2h_fetch"):
+                return np.asarray(dev)
+
+        fut = pool.submit(_fetch)
     fut.add_done_callback(lambda f: f.cancelled() or f.exception())
     return fut
+
+
+class _MirroredStats(dict):
+    """``refresh_stats`` view that folds increments into registry
+    counters (the positive deltas — counters are monotone) while staying
+    a plain dict for every existing reader/test. Thread-safe the same
+    way the raw dict was: single-writer per key on the loop thread, the
+    overlap worker's writes land through the same GIL-serialized ops."""
+
+    __slots__ = ("_counters",)
+
+    def __init__(self, init: dict, counters: dict):
+        super().__init__(init)
+        self._counters = counters
+
+    def __setitem__(self, key, value):
+        counter = self._counters.get(key)
+        if counter is not None:
+            delta = value - self.get(key, 0)
+            if delta > 0:
+                counter.inc(delta)
+        super().__setitem__(key, value)
 
 
 @dataclass
@@ -79,7 +110,10 @@ class _OverlappedRefresh:
         from concurrent.futures import ThreadPoolExecutor
 
         self._scheduler = scheduler
-        self._pool = ThreadPoolExecutor(max_workers=1)
+        # the prefix names the worker's span track in the Chrome trace
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="overlap-refresh"
+        )
         self._fut: Future | None = None
         self._first = True
 
@@ -114,11 +148,26 @@ class Scheduler:
     annotator — are fine; the snapshot cache detects their writes and
     rebuilds)."""
 
-    def __init__(self, cluster: ClusterState, clock=time.time):
+    def __init__(
+        self,
+        cluster: ClusterState,
+        clock=time.time,
+        telemetry: Telemetry | None = None,
+    ):
         self.cluster = cluster
         self._clock = clock
         self._plugins: list[_WeightedPlugin] = []
         self._cache: tuple[int, list[NodeInfo]] | None = None  # (version, snap)
+        self._telemetry = (
+            telemetry if telemetry is not None else active_telemetry()
+        )
+        self._m_decisions = None
+        if self._telemetry is not None:
+            self._m_decisions = self._telemetry.registry.counter(
+                "crane_drip_decisions_total",
+                "schedule_one outcomes",
+                ("outcome",),
+            )
 
     def register(self, plugin, weight: int = 1) -> None:
         """Order matters like the scheduler-config plugin list
@@ -173,6 +222,31 @@ class Scheduler:
         self._cache = (pre_version + 1, cache[1])
 
     def schedule_one(self, pod: Pod) -> ScheduleResult:
+        tel = self._telemetry
+        if tel is None:
+            return self._schedule_one(pod, None)
+        reasons: dict[str, int] = {}
+        with tel.spans.span("schedule_one"):
+            result = self._schedule_one(pod, reasons)
+        self._m_decisions.labels(
+            outcome="scheduled" if result.node else "failed"
+        ).inc()
+        top = sorted(result.scores.items(), key=lambda kv: (-kv[1], kv[0]))[:5]
+        tel.decisions.record(
+            pod=result.pod_key,
+            node=result.node,
+            reason=result.reason,
+            feasible=result.feasible,
+            top_scores=top,
+            staleness_seconds=-1.0,  # drip reads the live cluster mirror
+            source="drip",
+            filter_reasons=reasons,
+        )
+        return result
+
+    def _schedule_one(
+        self, pod: Pod, reasons: dict | None
+    ) -> ScheduleResult:
         state = CycleState()
         nodes = self.snapshot()
 
@@ -201,6 +275,8 @@ class Scheduler:
                 feasible.append(node_info)
             else:
                 last_reason = verdict.reason
+                if reasons is not None:
+                    reasons[verdict.reason] = reasons.get(verdict.reason, 0) + 1
         if not feasible:
             return ScheduleResult(pod.key(), None, 0, last_reason or "no feasible nodes")
 
@@ -338,6 +414,7 @@ class BatchScheduler:
         store: NodeLoadStore | None = None,
         refresh_from_cluster: bool = True,
         hybrid: bool | None = None,
+        telemetry: Telemetry | None = None,
     ):
         """``store``/``refresh_from_cluster``: pass the annotator's
         direct-mode store (NodeAnnotator.attach_store) with
@@ -378,8 +455,12 @@ class BatchScheduler:
         # f64 is already the parity mode; hybrid only means something for
         # narrower dtypes (ShardedScheduleStep applies the same rule)
         self._hybrid = bool(hybrid) and jnp.dtype(dtype) != jnp.dtype(jnp.float64)
+        self._telemetry = (
+            telemetry if telemetry is not None else active_telemetry()
+        )
         self._sharded = ShardedScheduleStep(
-            self.tensors, mesh, dtype=dtype, hybrid=self._hybrid
+            self.tensors, mesh, dtype=dtype, hybrid=self._hybrid,
+            telemetry=self._telemetry,
         )
         self.scorer = self._sharded.scorer
         self.gang = self._sharded.gang
@@ -393,7 +474,7 @@ class BatchScheduler:
         # _prepare call (the judge of steady-state health at scale —
         # `full` climbing in production means the column/delta paths are
         # being defeated by foreign store mutations)
-        self.refresh_stats = {
+        stats_init = {
             "hit": 0,  # unchanged store, resident snapshot reused
             "columns": 0,  # column-log replay ([N] vectors per column)
             "delta": 0,  # row-delta scatter
@@ -403,6 +484,37 @@ class BatchScheduler:
             "overlap_hits": 0,  # pipelined cycles served without blocking
             # on an in-flight background refresh (overlap_refresh mode)
         }
+        if self._telemetry is not None:
+            # fold refresh_stats into the registry: the dict stays the
+            # in-process API (tests, bench), the counters the scrape
+            # surface — increments mirror, the overlap worker included
+            reg = self._telemetry.registry
+            path = reg.counter(
+                "crane_refresh_path_total",
+                "Which upload path served each _prepare call",
+                ("path",),
+            )
+            counters = {
+                k: path.labels(path=k)
+                for k in ("hit", "columns", "delta", "full")
+            }
+            counters["ingest_ms"] = reg.counter(
+                "crane_refresh_ingest_ms_total",
+                "Host milliseconds spent in refresh() bulk ingest",
+            )
+            counters["risk_rescan_rows"] = reg.counter(
+                "crane_risk_rescan_rows_total",
+                "Rows the hybrid f64 risk rescan touched",
+            )
+            counters["overlap_hits"] = reg.counter(
+                "crane_overlap_hits_total",
+                "Pipelined cycles served without blocking on an "
+                "in-flight background refresh",
+            )
+            self.refresh_stats = _MirroredStats(stats_init, counters)
+        else:
+            self.refresh_stats = stats_init
+        self._last_refresh_wall = 0.0  # decision-trace staleness anchor
         # device-resident snapshot cache: (store version, padded N) it was
         # built from; an unchanged store re-dispatches with zero uploads
         self._prepared = None
@@ -421,10 +533,12 @@ class BatchScheduler:
         if not self._refresh_from_cluster:
             return
         t0 = time.perf_counter()
-        nodes = self.cluster.list_nodes()
-        self.store.bulk_ingest((n.name, n.annotations) for n in nodes)
-        self.store.prune_absent(n.name for n in nodes)
+        with maybe_span(self._telemetry, "ingest"):
+            nodes = self.cluster.list_nodes()
+            self.store.bulk_ingest((n.name, n.annotations) for n in nodes)
+            self.store.prune_absent(n.name for n in nodes)
         self.refresh_stats["ingest_ms"] += (time.perf_counter() - t0) * 1e3
+        self._last_refresh_wall = self._clock()
 
     # Delta uploads only pay off while the dirt is sparse: past this
     # fraction of rows a full column re-upload is cheaper than the
@@ -566,17 +680,21 @@ class BatchScheduler:
     def schedule_batch(self, pods: list[Pod], bind: bool = True) -> BatchResult:
         import numpy as np
 
+        tel = self._telemetry
         now = self._clock()
         self.refresh()
-        prepared = self._prepare(now)
+        with maybe_span(tel, "prepare"):
+            prepared = self._prepare(now)
 
-        packed = np.asarray(
-            self._sharded.packed(prepared, len(pods), now=now)
-        )  # the cycle's single device->host fetch
+        with maybe_span(tel, "exec_fetch", pods=len(pods)):
+            packed = np.asarray(
+                self._sharded.packed(prepared, len(pods), now=now)
+            )  # the cycle's single device->host fetch
         result = self._build_result(packed, [pod.key() for pod in pods], now=now)
 
         if bind:
-            self._apply_binds(result, now)
+            with maybe_span(tel, "bind_flush"):
+                self._apply_binds(result, now)
         return result
 
     def _apply_binds(self, result: BatchResult, now: float) -> None:
@@ -640,20 +758,27 @@ class BatchScheduler:
         # cycle's host work (annotator sync, bind application). One
         # worker keeps fetches in dispatch order; ALL cluster mutation
         # stays on this thread, so semantics are unchanged.
-        pool = ThreadPoolExecutor(max_workers=1) if depth > 1 else None
+        tel = self._telemetry
+        pool = (
+            ThreadPoolExecutor(max_workers=1, thread_name_prefix="d2h-prefetch")
+            if depth > 1 else None
+        )
         try:
             for pods in batches:
                 now = self._clock()
-                if refresher is not None:
-                    refresher.tick()
-                else:
-                    self.refresh()
-                prepared = self._prepare(now)
-                dev = self._sharded.packed(prepared, len(pods), now=now)
-                dev.copy_to_host_async()
+                with maybe_span(tel, "refresh_tick"):
+                    if refresher is not None:
+                        refresher.tick()
+                    else:
+                        self.refresh()
+                with maybe_span(tel, "prepare"):
+                    prepared = self._prepare(now)
+                with maybe_span(tel, "dispatch", pods=len(pods)):
+                    dev = self._sharded.packed(prepared, len(pods), now=now)
+                    dev.copy_to_host_async()
                 keys = [pod.key() for pod in pods]
                 pending.append((
-                    _submit_fetch(pool, dev), keys, now,
+                    _submit_fetch(pool, dev, tel), keys, now,
                     self._prepared_names, self._prepared_n,
                 ))
                 if len(pending) >= depth:
@@ -669,11 +794,14 @@ class BatchScheduler:
                 pool.shutdown(wait=False, cancel_futures=True)
 
     def _drain_pipelined(self, pending, bind: bool) -> BatchResult:
+        tel = self._telemetry
         fut, keys, now, names, n = pending
-        packed = fut.result()  # the only synchronization point
+        with maybe_span(tel, "d2h_wait"):
+            packed = fut.result()  # the only synchronization point
         result = self._build_result(packed, keys, now=now, names=names, n=n)
         if bind:
-            self._apply_binds(result, now)
+            with maybe_span(tel, "bind_flush"):
+                self._apply_binds(result, now)
         return result
 
     # -- columnar bursts (pods as rows, binds as one array transaction) ----
@@ -724,20 +852,27 @@ class BatchScheduler:
         pending = deque()
         # same single prefetch worker as schedule_batches_pipelined
         # (depth > 1 only); mutation order is unchanged
-        pool = ThreadPoolExecutor(max_workers=1) if depth > 1 else None
+        tel = self._telemetry
+        pool = (
+            ThreadPoolExecutor(max_workers=1, thread_name_prefix="d2h-prefetch")
+            if depth > 1 else None
+        )
         try:
             for namespace, names in bursts:
                 now = self._clock()
-                if refresher is not None:
-                    refresher.tick()
-                else:
-                    self.refresh()
-                prepared = self._prepare(now)
-                dev = self._sharded.packed(prepared, len(names), now=now)
-                dev.copy_to_host_async()
+                with maybe_span(tel, "refresh_tick"):
+                    if refresher is not None:
+                        refresher.tick()
+                    else:
+                        self.refresh()
+                with maybe_span(tel, "prepare"):
+                    prepared = self._prepare(now)
+                with maybe_span(tel, "dispatch", pods=len(names)):
+                    dev = self._sharded.packed(prepared, len(names), now=now)
+                    dev.copy_to_host_async()
                 handle = add_burst(namespace, names) if bind else None
                 pending.append(
-                    (_submit_fetch(pool, dev), namespace, names,
+                    (_submit_fetch(pool, dev, tel), namespace, names,
                      handle, now, self._prepared_names, self._prepared_n)
                 )
                 if len(pending) >= depth:
@@ -753,8 +888,10 @@ class BatchScheduler:
     def _drain_burst(self, item, bind: bool) -> BurstResult:
         import numpy as np
 
+        tel = self._telemetry
         fut, namespace, names, handle, now, node_names, n = item
-        packed = fut.result()  # the only synchronization point
+        with maybe_span(tel, "d2h_wait"):
+            packed = fut.result()  # the only synchronization point
         schedulable, scores, counts, _unassigned, _ = self._sharded.unpack(
             packed, n
         )
@@ -768,9 +905,15 @@ class BatchScheduler:
         k = min(len(order), len(names))
         node_idx[:k] = order[:k]
         table = self._burst_node_table(node_names, n)
+        if tel is not None:
+            self._trace_batch_decision(
+                tel, scores, schedulable, counts, n, node_names,
+                len(names), now, source="burst",
+            )
         bound = None
         if bind and handle is not None:
-            bound = self.cluster.bind_burst(handle, table, node_idx, now)
+            with maybe_span(tel, "bind_flush"):
+                bound = self.cluster.bind_burst(handle, table, node_idx, now)
             if len(bound) != int((node_idx >= 0).sum()):
                 # reconcile with what actually bound (rows deleted or
                 # shadowed between dispatch and drain) — reporting them
@@ -823,6 +966,51 @@ class BatchScheduler:
         unassigned = list(keys[len(order):])
         return assignments, unassigned
 
+    def _trace_batch_decision(
+        self, tel, scores, schedulable, counts, n, names, num_pods, now,
+        source: str,
+    ) -> None:
+        """Offer one sampled decision trace for a whole batch/burst cycle
+        (pods in a burst are interchangeable — the cycle IS the
+        decision): top-k candidate scores with their placement counts,
+        feasible-node count, and the staleness of the annotations the
+        verdicts consulted (age of the last completed ingest). The top-k
+        argpartition only runs when the sampling stride keeps the entry."""
+        import numpy as np
+
+        def _build():
+            body = np.asarray(scores[:n])
+            k = min(5, n)
+            if n > k:
+                idx = np.argpartition(-body, k - 1)[:k]
+            else:
+                idx = np.arange(n)
+            idx = idx[np.argsort(-body[idx], kind="stable")]
+            assigned = int(np.asarray(counts[:n]).sum())
+            return {
+                "pod": f"{source}[{num_pods}]",
+                "node": None,
+                "reason": (
+                    "" if assigned >= num_pods
+                    else f"{num_pods - assigned} unassigned"
+                ),
+                "feasible": int(np.asarray(schedulable[:n]).sum()),
+                "top_scores": [
+                    (names[int(i)], int(body[int(i)])) for i in idx
+                ],
+                "staleness_seconds": (
+                    now - self._last_refresh_wall
+                    if self._last_refresh_wall else -1.0
+                ),
+                "source": source,
+                "counts_top": {
+                    names[int(i)]: int(counts[int(i)])
+                    for i in idx if int(counts[int(i)])
+                },
+            }
+
+        tel.decisions.offer(_build)
+
     def _build_result(self, packed, keys, now=0.0, names=None, n=None) -> BatchResult:
         """``names``/``n`` default to the current prepared snapshot; the
         pipelined path passes the values captured at dispatch time.
@@ -832,6 +1020,11 @@ class BatchScheduler:
         if n is None:
             n = self._prepared_n
         schedulable, scores, counts, _unassigned, _ = self._sharded.unpack(packed, n)
+        if self._telemetry is not None:
+            self._trace_batch_decision(
+                self._telemetry, scores, schedulable, counts, n, names,
+                len(keys), now, source="batch",
+            )
         assignments, unassigned = self._expand_counts(scores, counts, names, keys)
         return BatchResult(
             assignments=assignments,
@@ -857,6 +1050,7 @@ class BatchScheduler:
                 dynamic_weight=dynamic_weight,
                 max_offset=MAX_NODE_SCORE * topology_weight,
                 hybrid=self._hybrid,
+                telemetry=self._telemetry,
             )
             # bounded LRU: each entry holds two jitted executables; a
             # caller cycling many weight pairs must not grow this forever

@@ -21,6 +21,7 @@ only changes *where* it runs.
 from __future__ import annotations
 
 import functools
+import time
 from dataclasses import dataclass
 from typing import Any
 
@@ -31,6 +32,7 @@ from jax.sharding import Mesh
 
 from ..policy.compile import PolicyTensors
 from ..scorer.batched import BatchedScorer
+from ..telemetry import Telemetry, maybe_span
 
 # compact packed layout (single source of truth for pack AND unpack):
 # per-node uint32 = counts(COMPACT_COUNT_BITS) | score | schedulable(msb).
@@ -104,13 +106,18 @@ class ShardedScheduleStep:
         dynamic_weight: int = 1,
         max_offset: int = 0,
         hybrid: bool = False,
+        telemetry: Telemetry | None = None,
     ):
         """``hybrid=True`` (f32 dtype only): every prepared snapshot
         carries host-computed f64 rescue rows (scorer.hybrid) that the
         device step substitutes, giving bit-for-bit Go/f64 placement
-        parity at f32 throughput."""
+        parity at f32 throughput.
+
+        ``telemetry``: optional span recording for the H2D upload and
+        risk-rescan stages (None = zero-cost no-op)."""
         self.mesh = mesh
         self.tensors = tensors
+        self.telemetry = telemetry
         self.hybrid = bool(hybrid) and jnp.dtype(dtype) != jnp.dtype(jnp.float64)
         self.scorer = BatchedScorer(tensors, dtype=dtype)
         self.gang = GangScheduler(
@@ -214,6 +221,10 @@ class ShardedScheduleStep:
         the hybrid risk scan runs on host WHILE that async transfer is in
         flight, so the scan is no longer on the upload's critical path.
         """
+        with maybe_span(self.telemetry, "h2d_prepare", n=int(snapshot.n_nodes)):
+            return self._prepare_impl(snapshot, now, capacity, offsets)
+
+    def _prepare_impl(self, snapshot, now, capacity, offsets):
         np_dtype = jnp.dtype(self.scorer.dtype)
         ts = np.asarray(snapshot.ts, np.float64)
         hot_ts = np.asarray(snapshot.hot_ts, np.float64)
@@ -324,6 +335,27 @@ class ShardedScheduleStep:
         its tolerance to match, and past ~6h the whole snapshot is
         re-prepared with a fresh epoch to keep the rescue fraction small.
         """
+        tel = self.telemetry
+        if tel is None or not self.hybrid or (
+            not force and prepared.ovr_now == float(now)
+        ):
+            return self._with_overrides_impl(
+                prepared, snapshot, now, force, dirty_rows
+            )
+        t0 = time.perf_counter()
+        out = self._with_overrides_impl(
+            prepared, snapshot, now, force, dirty_rows
+        )
+        tel.spans.record(
+            "risk_rescan", t0, time.perf_counter(),
+            args={"rows": int(out.ovr_rescan_rows)},
+        )
+        return out
+
+    def _with_overrides_impl(
+        self, prepared: PreparedSnapshot, snapshot, now: float,
+        force: bool = False, dirty_rows=None,
+    ) -> PreparedSnapshot:
         import dataclasses
 
         if not self.hybrid or (not force and prepared.ovr_now == float(now)):
@@ -447,9 +479,18 @@ class ShardedScheduleStep:
         k = len(rows)
         if k == 0:
             return prepared
+        with maybe_span(self.telemetry, "h2d_delta", rows=int(k)):
+            return self._apply_delta_impl(
+                prepared, rows, values_rows, ts_rows, hot_rows, hot_ts_rows
+            )
+
+    def _apply_delta_impl(
+        self, prepared, rows, values_rows, ts_rows, hot_rows, hot_ts_rows
+    ):
         import dataclasses
         import math as _math
 
+        k = len(rows)
         dtype = self.scorer.dtype
         kpad = 1 << max(0, _math.ceil(_math.log2(k)))
         npad = int(prepared.capacity.shape[0])
@@ -493,6 +534,12 @@ class ShardedScheduleStep:
         epoch (pad rows may carry a fresher ts under a uniform-ts column
         set; they are node_valid=False and never score).
         """
+        with maybe_span(
+            self.telemetry, "h2d_columns", entries=int(len(entries))
+        ):
+            return self._apply_columns_impl(prepared, entries, n)
+
+    def _apply_columns_impl(self, prepared: PreparedSnapshot, entries, n: int):
         import dataclasses
         import math as _math
 

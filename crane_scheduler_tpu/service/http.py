@@ -7,6 +7,14 @@
   per-node verdicts; ``GET /metrics`` exports the counters the reference
   never had; ``GET /healthz`` for probes.
 
+``/metrics`` content-negotiates: a scraper Accept header mentioning
+``text/plain`` or ``openmetrics`` gets the Prometheus text exposition
+(rendered by the telemetry registry); anything else gets the legacy
+JSON counters, so pre-telemetry clients keep working unchanged.
+``GET /debug/decisions`` serves the sampled decision-trace ring
+(``?n=`` caps the newest entries) and ``GET /debug/trace`` the
+Chrome trace-event JSON of the recorded spans.
+
 Stdlib-only (http.server with a thread pool via ThreadingHTTPServer).
 """
 
@@ -30,11 +38,50 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_text(self, code: int, text: str, content_type: str) -> None:
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _wants_exposition(self) -> bool:
+        """Prometheus/OpenMetrics scrapers name text formats in Accept;
+        legacy JSON clients (no Accept, */*, application/json) don't."""
+        accept = (self.headers.get("Accept") or "").lower()
+        return "text/plain" in accept or "openmetrics" in accept
+
     def do_GET(self):
-        if self.path == "/healthz":
+        path, _, query = self.path.partition("?")
+        if path == "/healthz":
             self._send(200, {"status": "ok"})
-        elif self.path == "/metrics":
-            self._send(200, self.service.metrics())
+        elif path == "/metrics":
+            if self._wants_exposition():
+                self._send_text(
+                    200,
+                    self.service.render_prometheus(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            else:
+                self._send(200, self.service.metrics())
+        elif path == "/debug/decisions":
+            limit = None
+            from urllib.parse import parse_qs
+
+            try:
+                n = parse_qs(query).get("n", [None])[0]
+                limit = int(n) if n is not None else None
+            except ValueError:
+                self._send(400, {"error": "n must be an integer"})
+                return
+            buf = self.service.telemetry.decisions
+            self._send(
+                200,
+                {"stats": buf.stats(), "decisions": buf.snapshot(limit=limit)},
+            )
+        elif path == "/debug/trace":
+            self._send(200, self.service.telemetry.export_chrome_trace())
         else:
             self._send(404, {"error": "not found"})
 

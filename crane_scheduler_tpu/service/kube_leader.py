@@ -65,15 +65,19 @@ class KubeLeaderElector:
         identity: str,
         on_started_leading,
         on_stopped_leading=None,
-        namespace: str = "crane-system",
+        namespace: str | None = None,
         lease_duration: float = DEFAULT_LEASE_DURATION,
         renew_deadline: float = DEFAULT_RENEW_DEADLINE,
         retry_period: float = DEFAULT_RETRY_PERIOD,
     ):
+        from ..utils import system_namespace
+
         self.client = client
         self.lease_name = lease_name
         self.identity = identity
-        self.namespace = namespace
+        # default resolves CRANE_SYSTEM_NAMESPACE -> "crane-system"
+        # (ref: utils.go:47-55, consumed at options.go:52)
+        self.namespace = namespace if namespace else system_namespace()
         self.on_started_leading = on_started_leading
         self.on_stopped_leading = on_stopped_leading
         self.lease_duration = lease_duration

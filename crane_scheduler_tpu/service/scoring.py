@@ -26,6 +26,7 @@ from ..policy.types import DynamicSchedulerPolicy
 from ..loadstore.store import NodeLoadStore
 from ..scorer import oracle
 from ..scorer.batched import BatchedScorer
+from ..telemetry import Telemetry
 
 
 @dataclass
@@ -65,6 +66,7 @@ class ScoringService:
         clock=time.time,
         snapshot_bucket: int = 2048,
         backend: str = "xla",
+        telemetry: Telemetry | None = None,
     ):
         import jax.numpy as jnp
 
@@ -85,15 +87,47 @@ class ScoringService:
         self._bucket = snapshot_bucket
         self._clock = clock
         self._lock = threading.RLock()
+        # the service IS the /metrics surface, so it always carries a
+        # registry (unlike hot-path modules, which gate on None); the
+        # legacy JSON counters in ``stats`` stay authoritative for the
+        # back-compat payload, the registry for the exposition format
+        self.telemetry = telemetry if telemetry is not None else Telemetry()
+        reg = self.telemetry.registry
+        self._m_refreshes = reg.counter(
+            "crane_scoring_refreshes_total", "Store refreshes served"
+        )
+        self._m_score_calls = reg.counter(
+            "crane_scoring_score_calls_total", "score_batch calls"
+        )
+        self._m_fallbacks = reg.counter(
+            "crane_scoring_fallbacks_total",
+            "Fail-open falls to the scalar oracle / host solver",
+        )
+        self._m_score_seconds = reg.histogram(
+            "crane_scoring_score_seconds", "score_batch latency"
+        )
+        self._m_staleness = reg.gauge(
+            "crane_scoring_staleness_seconds",
+            "Age of the store data at the last score call (-1 = never "
+            "refreshed)",
+        )
+        self._m_nodes = reg.gauge(
+            "crane_scoring_nodes", "Rows in the columnar load store"
+        )
+        self._m_assign_calls = reg.counter(
+            "crane_scoring_assign_calls_total", "assign_batch calls"
+        )
 
     def refresh(self) -> None:
         """Bulk re-read of node annotations into the columnar store."""
-        with self._lock:
+        with self._lock, self.telemetry.spans.span("refresh"):
             nodes = self.cluster.list_nodes()
             self.store.bulk_ingest((n.name, n.annotations) for n in nodes)
             self.store.prune_absent(n.name for n in nodes)
             self.stats.refreshes += 1
             self.stats.last_refresh_at = self._clock()
+            self._m_refreshes.inc()
+            self._m_nodes.set(len(self.store))
 
     def score_batch(self, now: float | None = None) -> BatchVerdicts:
         """Score every node; never raises (fail-open to the oracle)."""
@@ -102,17 +136,22 @@ class ScoringService:
         start = time.perf_counter()
         with self._lock:
             self.stats.score_calls += 1
+            self._m_score_calls.inc()
             staleness = (
                 now - self.stats.last_refresh_at if self.stats.last_refresh_at else -1.0
             )
+            self._m_staleness.set(staleness)
             try:
-                verdicts = self._score_tpu(now)
+                with self.telemetry.spans.span("score_batch"):
+                    verdicts = self._score_tpu(now)
             except Exception:
                 self.stats.fallbacks += 1
+                self._m_fallbacks.inc()
                 verdicts = self._score_oracle(now)
             elapsed = time.perf_counter() - start
             self.stats.last_score_seconds = elapsed
             self.stats.score_seconds_total += elapsed
+            self._m_score_seconds.observe(elapsed)
             self.stats.latencies.append(elapsed)
             if len(self.stats.latencies) > 1024:
                 del self.stats.latencies[:512]
@@ -178,14 +217,17 @@ class ScoringService:
                 [int(capacity.get(n, 1 << 30)) for n in names], np.int64
             )
         with self._lock:
+            self._m_assign_calls.inc()
             try:
-                result = self._gang(scores, schedulable, num_pods, cap)
+                with self.telemetry.spans.span("assign_batch"):
+                    result = self._gang(scores, schedulable, num_pods, cap)
                 counts = np.asarray(result.counts)
                 unassigned = int(result.unassigned)
                 waterline = int(result.waterline)
                 backend = verdicts.backend
             except Exception:
                 self.stats.fallbacks += 1
+                self._m_fallbacks.inc()
                 host = gang_assign_host(
                     scores, schedulable, num_pods, self.tensors.hv_count,
                     capacity=cap,
@@ -194,13 +236,32 @@ class ScoringService:
                 unassigned = int(host.unassigned)
                 waterline = int(host.waterline)
                 backend = "host-fallback"
-        return BatchAssignment(
+        assignment = BatchAssignment(
             counts={names[i]: int(c) for i, c in enumerate(counts) if c},
             unassigned=unassigned,
             waterline=waterline,
             backend=backend,
             staleness_seconds=verdicts.staleness_seconds,
         )
+        # one decision trace per assignment call: the top-k candidates
+        # (by score) with their placement counts, the solver backend,
+        # and how stale the consulted annotations were
+        order = np.argsort(-scores, kind="stable")[:5]
+        self.telemetry.decisions.record(
+            pod=f"assign[{num_pods}]",
+            node=None,
+            reason="" if not unassigned else f"{unassigned} unassigned",
+            feasible=int(schedulable.sum()),
+            top_scores=[(names[int(i)], int(scores[int(i)])) for i in order],
+            staleness_seconds=verdicts.staleness_seconds,
+            source="assign_batch",
+            backend=backend,
+            counts_top={
+                names[int(i)]: int(counts[int(i)])
+                for i in order if counts[int(i)]
+            },
+        )
+        return assignment
 
     @property
     def _gang(self):
@@ -213,7 +274,8 @@ class ScoringService:
         return gang
 
     def metrics(self) -> dict:
-        """Exported counters (SURVEY §5: the reference has none)."""
+        """Exported counters, legacy JSON shape (the ``/metrics``
+        back-compat payload; scrapers get ``render_prometheus``)."""
         import numpy as np
 
         with self._lock:
@@ -229,3 +291,8 @@ class ScoringService:
                 "score_p99_seconds": float(p99),
                 "nodes": len(self.store),
             }
+
+    def render_prometheus(self) -> str:
+        """The registry in Prometheus text exposition format."""
+        self._m_nodes.set(len(self.store))
+        return self.telemetry.registry.render()

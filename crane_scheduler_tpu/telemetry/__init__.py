@@ -1,0 +1,127 @@
+"""Unified telemetry: metrics registry + span recorder + decision traces.
+
+One coherent measurement layer threaded through scheduler, parallel,
+annotator, cluster, and service (SURVEY §5: the reference exports no
+metrics at all). Three surfaces, one bundle:
+
+- ``MetricsRegistry`` — Counter/Gauge/log-bucketed Histogram with real
+  Prometheus text exposition (``/metrics``);
+- ``SpanRecorder`` — pipelined-loop stage spans exported as Chrome
+  trace-event JSON (Perfetto / ``chrome://tracing``), alongside the
+  ``jax_trace`` device-level hook;
+- ``DecisionTraceBuffer`` — sampled per-decision traces
+  (``/debug/decisions``), bounded memory.
+
+Instrumented modules accept ``telemetry=`` and fall back to the
+process-global instance (``active()``), which is None unless enabled —
+so the disabled hot path costs one attribute check. Enable explicitly
+with ``telemetry.enable()`` or by setting ``CRANE_TELEMETRY=1`` in the
+environment before first use.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+from .decisions import DecisionTraceBuffer
+from .registry import (
+    DEFAULT_LATENCY_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    log_buckets,
+)
+from .spans import SpanRecorder
+
+__all__ = [
+    "Telemetry",
+    "MetricsRegistry",
+    "SpanRecorder",
+    "DecisionTraceBuffer",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "log_buckets",
+    "DEFAULT_LATENCY_BUCKETS",
+    "enable",
+    "disable",
+    "active",
+    "maybe_span",
+]
+
+
+class Telemetry:
+    """The bundle instrumented modules share."""
+
+    def __init__(
+        self,
+        registry: MetricsRegistry | None = None,
+        spans: SpanRecorder | None = None,
+        decisions: DecisionTraceBuffer | None = None,
+        span_capacity: int = 16384,
+        decision_capacity: int = 512,
+        decision_sample_every: int = 1,
+    ):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.spans = (
+            spans if spans is not None else SpanRecorder(capacity=span_capacity)
+        )
+        self.decisions = (
+            decisions
+            if decisions is not None
+            else DecisionTraceBuffer(
+                capacity=decision_capacity,
+                sample_every=decision_sample_every,
+            )
+        )
+
+    def render_prometheus(self) -> str:
+        return self.registry.render()
+
+    def export_chrome_trace(self) -> dict:
+        return self.spans.export_chrome_trace()
+
+
+_active: Telemetry | None = None
+_lock = threading.Lock()
+
+
+def enable(telemetry: Telemetry | None = None) -> Telemetry:
+    """Install (and return) the process-global telemetry instance."""
+    global _active
+    with _lock:
+        if telemetry is not None:
+            _active = telemetry
+        elif _active is None:
+            _active = Telemetry()
+        return _active
+
+
+def disable() -> None:
+    global _active
+    with _lock:
+        _active = None
+
+
+def active() -> Telemetry | None:
+    """The process-global instance, or None when disabled. Honors
+    ``CRANE_TELEMETRY=1`` (any non-empty value but ``0``/``false``)."""
+    if _active is None:
+        env = os.environ.get("CRANE_TELEMETRY", "").strip().lower()
+        if env and env not in ("0", "false", "no"):
+            return enable()
+    return _active
+
+
+_NULL_CTX = contextlib.nullcontext()
+
+
+def maybe_span(telemetry: Telemetry | None, name: str, **args):
+    """``telemetry.spans.span(...)`` when enabled, a shared no-op context
+    otherwise — the hot-path gating idiom."""
+    if telemetry is None:
+        return _NULL_CTX
+    return telemetry.spans.span(name, **args)

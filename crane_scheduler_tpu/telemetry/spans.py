@@ -1,0 +1,113 @@
+"""Span recorder: pipeline stage timing -> Chrome trace-event JSON.
+
+SURVEY §5: the reference's only latency visibility is log lines timing
+each sync. ``jax_trace`` (utils/profiling.py) covers *device*-level
+analysis; this recorder covers the *host* pipeline — the stages of the
+pipelined scheduling loop (ingest, risk rescan, H2D, dispatch, async
+D2H drain, bind flush, the overlap-refresh worker) land in a bounded
+ring buffer and export as Chrome trace-event JSON, viewable in Perfetto
+(https://ui.perfetto.dev) or ``chrome://tracing`` next to the JAX
+profiler's own traces.
+
+Tracks default to the recording thread's name, so the overlap-refresh
+worker's spans land on their own track and visibly overlap the
+scheduling thread's cycles — exactly the picture "why did cycle N's p99
+spike" needs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from collections import deque
+
+
+class SpanRecorder:
+    """Bounded ring buffer of completed spans (oldest evicted first)."""
+
+    def __init__(self, capacity: int = 16384, clock=time.perf_counter):
+        self._clock = clock
+        self._epoch = clock()
+        self._buf: deque = deque(maxlen=int(capacity))
+        self._lock = threading.Lock()
+        self.recorded = 0  # total ever recorded (evictions included)
+
+    @contextlib.contextmanager
+    def span(self, name: str, track: str | None = None, **args):
+        """Record the wrapped block as one complete ('X') span. ``track``
+        defaults to the current thread's name."""
+        start = self._clock()
+        try:
+            yield
+        finally:
+            self.record(name, start, self._clock(), track=track, args=args)
+
+    def record(
+        self,
+        name: str,
+        start: float,
+        end: float,
+        track: str | None = None,
+        args: dict | None = None,
+    ) -> None:
+        """Record a span from explicit ``clock()`` readings (for callers
+        that only learn the span's metadata after it finished)."""
+        if track is None:
+            track = threading.current_thread().name
+        ts_us = (start - self._epoch) * 1e6
+        dur_us = max(0.0, (end - start) * 1e6)
+        with self._lock:
+            self.recorded += 1
+            self._buf.append((ts_us, dur_us, name, track, args or None))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+    def export_chrome_trace(self) -> dict:
+        """Chrome trace-event JSON object (``{"traceEvents": [...]}``):
+        one ``ph: "X"`` complete event per span plus ``thread_name``
+        metadata per track, events sorted by timestamp."""
+        with self._lock:
+            spans = sorted(self._buf)
+        tids: dict[str, int] = {}
+        events: list[dict] = []
+        for ts_us, dur_us, name, track, args in spans:
+            tid = tids.get(track)
+            if tid is None:
+                tid = tids[track] = len(tids) + 1
+            event = {
+                "name": name,
+                "ph": "X",
+                "pid": 1,
+                "tid": tid,
+                "ts": round(ts_us, 3),
+                "dur": round(dur_us, 3),
+            }
+            if args:
+                event["args"] = args
+            events.append(event)
+        meta = [
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": 1,
+                "tid": tid,
+                "args": {"name": track},
+            }
+            for track, tid in tids.items()
+        ]
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+    def dump(self, path: str) -> int:
+        """Write the Chrome trace to ``path``; returns the span count."""
+        trace = self.export_chrome_trace()
+        with open(path, "w") as f:
+            json.dump(trace, f)
+        return sum(1 for e in trace["traceEvents"] if e["ph"] == "X")

@@ -9,8 +9,16 @@ from .timeutil import (
     now_epoch,
 )
 from .score import normalize_score, go_trunc
+from .system import (
+    DEFAULT_SYSTEM_NAMESPACE,
+    SYSTEM_NAMESPACE_ENV,
+    system_namespace,
+)
 
 __all__ = [
+    "DEFAULT_SYSTEM_NAMESPACE",
+    "SYSTEM_NAMESPACE_ENV",
+    "system_namespace",
     "parse_go_duration",
     "format_go_duration",
     "TIME_FORMAT",

@@ -6,7 +6,11 @@ log lines timing each sync. Here:
 - ``phase_timer``: lightweight wall-clock phase timing with counters
   (always available, no deps);
 - ``jax_trace``: wraps a block in a JAX profiler trace (viewable with
-  TensorBoard / xprof) for device-level analysis of the scorer.
+  TensorBoard / xprof) for device-level analysis of the scorer;
+- ``chrome_trace``: dumps a telemetry ``SpanRecorder``'s host-side
+  pipeline spans as Chrome trace-event JSON at block exit — the host
+  twin of ``jax_trace``, viewable in Perfetto / ``chrome://tracing``
+  (see crane_scheduler_tpu.telemetry).
 """
 
 from __future__ import annotations
@@ -53,3 +57,16 @@ def jax_trace(log_dir: str | None):
 
     with jax.profiler.trace(log_dir):
         yield
+
+
+@contextlib.contextmanager
+def chrome_trace(recorder, path: str | None):
+    """Write ``recorder``'s spans (telemetry.SpanRecorder) as a Chrome
+    trace-event JSON file when the block exits; no-op when either side
+    is unset. Pairs with ``jax_trace`` for host+device pictures of the
+    same run."""
+    try:
+        yield
+    finally:
+        if recorder is not None and path:
+            recorder.dump(path)

@@ -524,7 +524,13 @@ int read_response(BufConn& c, bool* close_after) {
       if (semi) *semi = 0;
       int64_t size = std::strtoll(line, nullptr, 16);
       if (size == 0) {
-        if (!c.read_line(line, sizeof(line))) return 0;  // trailer/blank
+        // trailer section: consume lines until the blank line — a
+        // single read would desync the keep-alive parse when the
+        // server emits trailer fields after the terminal chunk
+        while (true) {
+          if (!c.read_line(line, sizeof(line))) return 0;
+          if (line[0] == 0) break;  // blank line: end of trailers
+        }
         break;
       }
       if (!c.skip(size)) return 0;

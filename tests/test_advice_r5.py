@@ -1,0 +1,182 @@
+"""Round-5 advice regressions: HTTP chunked-trailer desync (both the
+Python raw connection and the native C++ flush engine must survive a
+server that emits trailer fields after the terminal chunk without
+desyncing the next keep-alive response) and the CRANE_SYSTEM_NAMESPACE
+env contract."""
+
+import json
+import socket
+import threading
+
+import pytest
+
+# a chunked body followed by REAL trailer fields, then a blank line —
+# the desync case: parsers that consume exactly one line after the
+# terminal chunk leave "Expires: 0" + blank in the stream, so the next
+# response on the connection parses as status 0
+CHUNKED_WITH_TRAILERS = (
+    b"HTTP/1.1 200 OK\r\n"
+    b"Transfer-Encoding: chunked\r\n"
+    b"Trailer: X-Checksum, Expires\r\n"
+    b"\r\n"
+    b"6\r\nchunk1\r\n"
+    b"6\r\nchunk2\r\n"
+    b"0\r\n"
+    b"X-Checksum: abc123\r\n"
+    b"Expires: 0\r\n"
+    b"\r\n"
+)
+PLAIN_OK = (
+    b"HTTP/1.1 201 Created\r\n"
+    b"Content-Length: 2\r\n"
+    b"\r\n"
+    b"{}"
+)
+
+
+class _TrailerStub:
+    """Single-connection stub: first response chunked + trailers, every
+    later response a plain 201. Records how many requests it parsed."""
+
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+        self.requests = 0
+        self._threads = []
+        self._accept_thread = threading.Thread(target=self._accept, daemon=True)
+        self._accept_thread.start()
+
+    def _accept(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn):
+        buf = b""
+        first = True
+        try:
+            while True:
+                while b"\r\n\r\n" not in buf:
+                    data = conn.recv(65536)
+                    if not data:
+                        return
+                    buf += data
+                head, buf = buf.split(b"\r\n\r\n", 1)
+                length = 0
+                for line in head.split(b"\r\n")[1:]:
+                    k, _, v = line.partition(b":")
+                    if k.strip().lower() == b"content-length":
+                        length = int(v.strip())
+                while len(buf) < length:
+                    data = conn.recv(65536)
+                    if not data:
+                        return
+                    buf += data
+                buf = buf[length:]
+                self.requests += 1
+                conn.sendall(CHUNKED_WITH_TRAILERS if first else PLAIN_OK)
+                first = False
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def close(self):
+        self.sock.close()
+
+
+@pytest.fixture()
+def stub():
+    s = _TrailerStub()
+    try:
+        yield s
+    finally:
+        s.close()
+
+
+def test_raw_connection_survives_chunked_trailers(stub):
+    from crane_scheduler_tpu.cluster.kube import _RawHTTPConnection
+
+    conn = _RawHTTPConnection("127.0.0.1", stub.port, timeout=5.0)
+    try:
+        conn.request("PATCH", "/x", body=b"{}",
+                     headers={"Content-Type": "application/json"})
+        first = conn.getresponse()
+        assert first.status == 200
+        assert not first.will_close
+        # the next keep-alive response must parse cleanly (pre-fix: the
+        # leftover trailer line desyncs the stream -> BadStatusLine /
+        # bogus status on THIS response)
+        conn.request("PATCH", "/x", body=b"{}",
+                     headers={"Content-Type": "application/json"})
+        second = conn.getresponse()
+        assert second.status == 201
+        assert second.read() == b"{}"
+    finally:
+        conn.close()
+
+
+def test_native_flush_engine_survives_chunked_trailers(stub):
+    httpflush = pytest.importorskip(
+        "crane_scheduler_tpu.native.httpflush"
+    )
+    try:
+        flusher = httpflush.NativeHTTPFlusher(
+            "127.0.0.1", stub.port, workers=1, timeout=5.0
+        )
+    except Exception:
+        pytest.skip("native library unavailable")
+    body = json.dumps({"metadata": {}}).encode()
+    req = (
+        b"PATCH /x HTTP/1.1\r\n"
+        b"Host: 127.0.0.1\r\n"
+        b"Content-Type: application/json\r\n"
+        b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n" + body
+    )
+    # one worker => both requests ride ONE keep-alive connection; the
+    # second status is the desync detector
+    statuses = flusher.flush([req, req], idempotent=True)
+    assert list(statuses) == [200, 201]
+    assert stub.requests == 2
+
+
+def test_system_namespace_env(monkeypatch):
+    from crane_scheduler_tpu.utils import system_namespace
+
+    monkeypatch.delenv("CRANE_SYSTEM_NAMESPACE", raising=False)
+    assert system_namespace() == "crane-system"
+    monkeypatch.setenv("CRANE_SYSTEM_NAMESPACE", "custom-ns")
+    assert system_namespace() == "custom-ns"
+    monkeypatch.setenv("CRANE_SYSTEM_NAMESPACE", "")
+    assert system_namespace() == "crane-system"  # empty = unset (ref)
+
+
+def test_kube_leader_honors_system_namespace_env(monkeypatch):
+    from crane_scheduler_tpu.service.kube_leader import KubeLeaderElector
+
+    monkeypatch.setenv("CRANE_SYSTEM_NAMESPACE", "lease-ns")
+    elector = KubeLeaderElector(
+        client=object(),
+        lease_name="crane-scheduler",
+        identity="me",
+        on_started_leading=lambda stop: None,
+    )
+    assert elector.namespace == "lease-ns"
+    assert "/namespaces/lease-ns/" in elector._lease_path()
+    # explicit namespace still wins over the env
+    explicit = KubeLeaderElector(
+        client=object(),
+        lease_name="crane-scheduler",
+        identity="me",
+        on_started_leading=lambda stop: None,
+        namespace="explicit",
+    )
+    assert explicit.namespace == "explicit"

@@ -1,0 +1,364 @@
+"""Unified telemetry tests: registry concurrency, golden Prometheus
+exposition, Chrome trace-event schema/ordering, decision-trace sampling
+and bounded memory, /metrics content negotiation, and the pipelined
+loop's stage spans."""
+
+import json
+import os
+import threading
+import urllib.request
+
+import pytest
+
+from crane_scheduler_tpu.telemetry import (
+    DecisionTraceBuffer,
+    MetricsRegistry,
+    SpanRecorder,
+    Telemetry,
+    log_buckets,
+    maybe_span,
+)
+from crane_scheduler_tpu.telemetry.expfmt import (
+    ExpositionError,
+    parse_exposition,
+)
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "data", "metrics_golden.txt")
+
+
+# -- registry -----------------------------------------------------------
+
+
+def test_registry_counter_gauge_histogram_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", "help", ("path",))
+    c.labels(path="hit").inc()
+    c.labels(path="hit").inc(2)
+    assert c.labels(path="hit").value == 3
+    with pytest.raises(ValueError):
+        c.labels(path="hit").inc(-1)  # counters are monotone
+    g = reg.gauge("t_gauge")
+    g.set(5)
+    g.dec(2)
+    assert g.value == 3
+    h = reg.histogram("t_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    counts, total_sum, total = h.labels().snapshot()
+    assert counts == [1, 1] and total == 3 and total_sum == pytest.approx(5.55)
+
+
+def test_registry_get_or_create_and_type_conflicts():
+    reg = MetricsRegistry()
+    a = reg.counter("same_total", "x")
+    assert reg.counter("same_total") is a
+    with pytest.raises(ValueError):
+        reg.gauge("same_total")  # type conflict
+    with pytest.raises(ValueError):
+        reg.counter("same_total", labelnames=("x",))  # label-set conflict
+    reg.histogram("lat_seconds")
+    with pytest.raises(ValueError):
+        reg.counter("lat_seconds_bucket")  # collides with histogram suffix
+
+
+def test_registry_thread_storm_is_exact():
+    """8 threads x 10k increments/observes: totals must be exact (the
+    per-child lock is the contract, not best-effort)."""
+    reg = MetricsRegistry()
+    c = reg.counter("storm_total", "x", ("worker",))
+    shared = reg.counter("storm_shared_total")
+    h = reg.histogram("storm_seconds", buckets=tuple(log_buckets(1e-3, 2, 8)))
+    g = reg.gauge("storm_gauge")
+    n_threads, n_iter = 8, 10_000
+
+    def work(i):
+        mine = c.labels(worker=str(i))
+        for k in range(n_iter):
+            mine.inc()
+            shared.inc()
+            h.observe(0.004 * ((k % 4) + 1))
+            g.inc()
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert shared.value == n_threads * n_iter
+    for i in range(n_threads):
+        assert c.labels(worker=str(i)).value == n_iter
+    _, _, total = h.labels().snapshot()
+    assert total == n_threads * n_iter
+    assert g.value == n_threads * n_iter
+    parse_exposition(reg.render())  # storm output still strictly valid
+
+
+def _golden_registry() -> MetricsRegistry:
+    reg = MetricsRegistry()
+    c = reg.counter("crane_demo_requests_total", "Requests served", ("code",))
+    c.labels(code="200").inc(41)
+    c.labels(code="500").inc()
+    g = reg.gauge("crane_demo_nodes", "Rows in the store")
+    g.set(12)
+    h = reg.histogram(
+        "crane_demo_latency_seconds",
+        "Request latency",
+        buckets=(0.001, 0.01, 0.1, 1.0),
+    )
+    for v in (0.0005, 0.0005, 0.05, 0.5, 2.0):
+        h.observe(v)
+    esc = reg.gauge("crane_demo_escapes", 'Help with \\ and "quotes"', ("path",))
+    esc.labels(path='with"quote\nand\\slash').set(1)
+    return reg
+
+
+def test_prometheus_exposition_golden_file():
+    """Exact byte-for-byte rendering (regenerate by running this test
+    with CRANE_REGEN_GOLDEN=1)."""
+    text = _golden_registry().render()
+    if os.environ.get("CRANE_REGEN_GOLDEN"):
+        os.makedirs(os.path.dirname(GOLDEN), exist_ok=True)
+        with open(GOLDEN, "w") as f:
+            f.write(text)
+    with open(GOLDEN) as f:
+        assert text == f.read()
+    families = parse_exposition(text)
+    assert families["crane_demo_requests_total"]["type"] == "counter"
+    assert families["crane_demo_latency_seconds"]["type"] == "histogram"
+
+
+def test_strict_parser_rejects_malformed_payloads():
+    good = _golden_registry().render()
+    parse_exposition(good)
+    with pytest.raises(ExpositionError):
+        parse_exposition(good + "no_type_declared 1\n")
+    with pytest.raises(ExpositionError):
+        parse_exposition(good.rstrip("\n"))  # missing trailing newline
+    with pytest.raises(ExpositionError):
+        parse_exposition("# TYPE x counter\nx 1\nx 1\n")  # duplicate series
+    with pytest.raises(ExpositionError):
+        parse_exposition("# TYPE x counter\nx -1\n")  # negative counter
+    with pytest.raises(ExpositionError):  # non-cumulative histogram
+        parse_exposition(
+            "# TYPE h histogram\n"
+            'h_bucket{le="0.1"} 5\nh_bucket{le="1"} 3\n'
+            'h_bucket{le="+Inf"} 3\nh_sum 1\nh_count 3\n'
+        )
+    with pytest.raises(ExpositionError):  # missing +Inf bucket
+        parse_exposition(
+            "# TYPE h histogram\n"
+            'h_bucket{le="0.1"} 1\nh_sum 1\nh_count 1\n'
+        )
+
+
+# -- spans --------------------------------------------------------------
+
+
+def test_span_recorder_chrome_trace_schema_and_ordering():
+    rec = SpanRecorder(capacity=64)
+    with rec.span("outer", track="loop"):
+        with rec.span("inner", track="loop", rows=7):
+            pass
+    with rec.span("worker-side", track="worker"):
+        pass
+    trace = rec.export_chrome_trace()
+    events = trace["traceEvents"]
+    json.loads(json.dumps(trace))  # serializable
+    meta = [e for e in events if e["ph"] == "M"]
+    spans = [e for e in events if e["ph"] == "X"]
+    assert {m["args"]["name"] for m in meta} == {"loop", "worker"}
+    assert len(spans) == 3
+    for e in spans:
+        assert set(e) >= {"name", "ph", "pid", "tid", "ts", "dur"}
+        assert e["ts"] >= 0 and e["dur"] >= 0
+    # sorted by start timestamp
+    assert [e["ts"] for e in spans] == sorted(e["ts"] for e in spans)
+    inner = next(e for e in spans if e["name"] == "inner")
+    outer = next(e for e in spans if e["name"] == "outer")
+    assert inner["args"] == {"rows": 7}
+    # the inner span nests within the outer one
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+
+
+def test_span_recorder_ring_is_bounded():
+    rec = SpanRecorder(capacity=10)
+    for i in range(100):
+        rec.record(f"s{i}", 0.0, 0.001)
+    assert len(rec) == 10 and rec.recorded == 100
+    names = [
+        e["name"]
+        for e in rec.export_chrome_trace()["traceEvents"]
+        if e["ph"] == "X"
+    ]
+    assert names == [f"s{i}" for i in range(90, 100)]  # newest kept
+
+
+def test_maybe_span_disabled_is_noop():
+    with maybe_span(None, "x"):
+        pass  # no telemetry: shared null context, nothing recorded
+
+
+# -- decision traces ----------------------------------------------------
+
+
+def test_decision_trace_sampling_and_bounded_memory():
+    buf = DecisionTraceBuffer(capacity=8, sample_every=2, clock=lambda: 123.0)
+    kept = sum(
+        buf.record(pod=f"ns/p{i}", node="n1", top_scores=[("n1", 50)])
+        for i in range(100)
+    )
+    assert kept == 50 and buf.seen == 100 and buf.recorded == 50
+    snap = buf.snapshot()
+    assert len(snap) == 8  # ring bound, newest kept
+    assert snap[-1]["pod"] == "ns/p98"
+    assert snap[0]["pod"] == "ns/p84"
+    assert buf.stats()["buffered"] == 8
+    assert buf.snapshot(limit=3) == snap[-3:]
+
+
+def test_decision_trace_offer_is_lazy():
+    buf = DecisionTraceBuffer(capacity=4, sample_every=3)
+    built = []
+
+    def build():
+        built.append(1)
+        return {"pod": "ns/x", "top_scores": [("a", 1)], "extra_field": 7}
+
+    for _ in range(9):
+        buf.offer(build)
+    assert len(built) == 3  # built only when the stride keeps it
+    assert buf.snapshot()[-1]["extra_field"] == 7
+
+
+# -- service surfaces ---------------------------------------------------
+
+
+@pytest.fixture()
+def scoring_server():
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+    from crane_scheduler_tpu.service import ScoringService
+    from crane_scheduler_tpu.service.http import ScoringHTTPServer
+    from crane_scheduler_tpu.sim.simulator import SimConfig, Simulator
+
+    sim = Simulator(SimConfig(n_nodes=4, seed=7))
+    sim.sync_metrics()
+    svc = ScoringService(sim.cluster, DEFAULT_POLICY)
+    svc.refresh()
+    svc.score_batch(now=sim.clock.now())
+    svc.assign_batch(3, now=sim.clock.now())
+    server = ScoringHTTPServer(svc, port=0)
+    server.start()
+    try:
+        yield f"http://127.0.0.1:{server.port}", svc
+    finally:
+        server.stop()
+
+
+def test_metrics_content_negotiation(scoring_server):
+    base, svc = scoring_server
+    # legacy clients (no Accept): JSON, same counters as before
+    with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
+        assert "application/json" in r.headers["Content-Type"]
+        payload = json.load(r)
+    assert payload["score_calls"] >= 2 and payload["refreshes"] == 1
+    # scrapers: strict Prometheus text exposition
+    req = urllib.request.Request(
+        f"{base}/metrics", headers={"Accept": "text/plain;version=0.0.4"}
+    )
+    with urllib.request.urlopen(req, timeout=5) as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        text = r.read().decode()
+    families = parse_exposition(text)
+    assert "crane_scoring_score_calls_total" in families
+    assert "crane_scoring_score_seconds" in families
+    calls = dict(
+        ((name, labels), v)
+        for name, labels, v in families["crane_scoring_score_calls_total"][
+            "samples"
+        ]
+    )
+    assert calls[("crane_scoring_score_calls_total", ())] == payload[
+        "score_calls"
+    ]
+
+
+def test_debug_decisions_endpoint(scoring_server):
+    base, svc = scoring_server
+    with urllib.request.urlopen(f"{base}/debug/decisions", timeout=5) as r:
+        payload = json.load(r)
+    assert payload["stats"]["recorded"] >= 1
+    entry = payload["decisions"][-1]
+    assert entry["source"] == "assign_batch"
+    assert entry["top_scores"] and entry["backend"]
+    with urllib.request.urlopen(f"{base}/debug/decisions?n=1", timeout=5) as r:
+        assert len(json.load(r)["decisions"]) == 1
+    with urllib.request.urlopen(f"{base}/debug/trace", timeout=5) as r:
+        trace = json.load(r)
+    assert any(e["ph"] == "X" for e in trace["traceEvents"])
+
+
+# -- instrumented scheduling paths --------------------------------------
+
+
+def test_pipelined_loop_emits_stage_spans_and_mirrored_counters():
+    from crane_scheduler_tpu.framework.scheduler import BatchScheduler
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+    from crane_scheduler_tpu.sim.simulator import SimConfig, Simulator
+
+    sim = Simulator(SimConfig(n_nodes=6, seed=3))
+    sim.sync_metrics()
+    tel = Telemetry(decision_sample_every=1)
+    sched = BatchScheduler(
+        sim.cluster, DEFAULT_POLICY, clock=sim.clock, telemetry=tel
+    )
+    batches = [
+        [sim.make_pod(cpu_milli=100) for _ in range(3)] for _ in range(4)
+    ]
+    results = list(
+        sched.schedule_batches_pipelined(iter(batches), depth=2,
+                                         overlap_refresh=True)
+    )
+    assert len(results) == 4 and all(r.assignments for r in results)
+    trace = tel.spans.export_chrome_trace()
+    stage_names = {e["name"] for e in trace["traceEvents"] if e["ph"] == "X"}
+    for stage in ("refresh_tick", "prepare", "dispatch", "d2h_wait",
+                  "bind_flush", "ingest", "d2h_fetch"):
+        assert stage in stage_names, f"missing span {stage}"
+    # refresh_stats folded into the registry without perturbing the dict
+    flat = tel.registry.snapshot()
+    path_total = sum(
+        v for k, v in flat.items() if k.startswith("crane_refresh_path_total")
+    )
+    assert path_total == sum(
+        sched.refresh_stats[k] for k in ("hit", "columns", "delta", "full")
+    )
+    assert path_total >= 4
+    # decision traces: one per batch cycle with top-k candidate scores
+    decisions = tel.decisions.snapshot()
+    assert len(decisions) == 4
+    assert all(d["source"] == "batch" and d["top_scores"] for d in decisions)
+    # exposition stays strictly valid with the full instrument set live
+    parse_exposition(tel.registry.render())
+
+
+def test_drip_scheduler_records_decision_traces():
+    from crane_scheduler_tpu.framework.scheduler import Scheduler
+    from crane_scheduler_tpu.plugins.dynamic import DynamicPlugin
+    from crane_scheduler_tpu.sim.simulator import SimConfig, Simulator
+
+    sim = Simulator(SimConfig(n_nodes=4, seed=11))
+    sim.sync_metrics()
+    tel = Telemetry()
+    sched = Scheduler(sim.cluster, clock=sim.clock, telemetry=tel)
+    sched.register(DynamicPlugin(sim.policy, clock=sim.clock), weight=3)
+    result = sched.schedule_one(sim.make_pod(cpu_milli=100))
+    assert result.node is not None
+    entry = tel.decisions.snapshot()[-1]
+    assert entry["source"] == "drip"
+    assert entry["node"] == result.node
+    assert entry["pod"] == result.pod_key
+    assert entry["top_scores"][0][1] >= entry["top_scores"][-1][1]
+    flat = tel.registry.snapshot()
+    assert flat['crane_drip_decisions_total{outcome="scheduled"}'] == 1

@@ -1,0 +1,114 @@
+"""Metrics smoke gate (``make metrics-smoke``): boot the scoring sidecar
+on a small simulated cluster, scrape ``/metrics``, and validate the
+payload with the strict exposition parser — plus the JSON back-compat
+shape and the ``/debug/decisions`` surface.
+
+Exit 0 = every check passed; any violation prints the failure and exits
+nonzero, so CI fails on an exposition regression before a real scraper
+ever sees it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+    from crane_scheduler_tpu.service import ScoringService
+    from crane_scheduler_tpu.service.http import ScoringHTTPServer
+    from crane_scheduler_tpu.sim.simulator import SimConfig, Simulator
+    from crane_scheduler_tpu.telemetry.expfmt import (
+        ExpositionError,
+        parse_exposition,
+    )
+
+    sim = Simulator(SimConfig(n_nodes=8, seed=1))
+    sim.sync_metrics()
+    svc = ScoringService(sim.cluster, DEFAULT_POLICY)
+    svc.refresh()
+    svc.score_batch(now=sim.clock.now())
+    svc.assign_batch(4, now=sim.clock.now())
+    server = ScoringHTTPServer(svc, port=0)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    failures = 0
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        nonlocal failures
+        mark = "ok" if ok else "FAIL"
+        print(f"[metrics-smoke] {name}: {mark}{' — ' + detail if detail else ''}")
+        if not ok:
+            failures += 1
+
+    try:
+        # 1. strict exposition scrape
+        req = urllib.request.Request(
+            f"{base}/metrics",
+            headers={"Accept": "text/plain;version=0.0.4"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            ctype = r.headers["Content-Type"]
+            text = r.read().decode()
+        check("content-type", ctype.startswith("text/plain"), ctype)
+        try:
+            families = parse_exposition(text)
+            check(
+                "strict exposition parse", True,
+                f"{len(families)} families, {len(text.splitlines())} lines",
+            )
+        except ExpositionError as e:
+            families = {}
+            check("strict exposition parse", False, str(e))
+        for required in (
+            "crane_scoring_score_calls_total",
+            "crane_scoring_score_seconds",
+            "crane_scoring_staleness_seconds",
+            "crane_scoring_nodes",
+        ):
+            check(f"family {required}", required in families)
+
+        # 2. JSON back-compat (no Accept header = legacy client)
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            legacy = json.load(r)
+        check(
+            "legacy JSON shape",
+            all(k in legacy for k in ("score_calls", "fallbacks", "nodes")),
+            f"score_calls={legacy.get('score_calls')}",
+        )
+
+        # 3. decision traces
+        with urllib.request.urlopen(f"{base}/debug/decisions", timeout=10) as r:
+            decisions = json.load(r)
+        check(
+            "/debug/decisions",
+            decisions["stats"]["recorded"] >= 1
+            and decisions["decisions"][-1]["top_scores"],
+        )
+
+        # 4. trace export loads as Chrome trace-event JSON
+        with urllib.request.urlopen(f"{base}/debug/trace", timeout=10) as r:
+            trace = json.load(r)
+        check(
+            "/debug/trace",
+            any(e.get("ph") == "X" for e in trace.get("traceEvents", ())),
+        )
+    finally:
+        server.stop()
+
+    print(f"[metrics-smoke] {'PASS' if not failures else 'FAIL'}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
