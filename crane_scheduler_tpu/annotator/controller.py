@@ -39,6 +39,7 @@ from ..loadstore.codec import (
     go_parse_float,
 )
 from ..native.codec import bulk_parse_values
+from ..utils.logging import vlog
 from ..utils.timeutil import format_local_time
 from ..loadstore.store import NodeLoadStore
 from ..metrics.source import MetricsQueryError, MetricsSource
@@ -235,6 +236,8 @@ class NodeAnnotator:
         total = self._flush_annotations_impl()
         if total:  # idle emitter ticks must not pollute the latency hist
             m.observe(time.perf_counter() - t0)
+            vlog(1, f"annotation flush: {total} keys, "
+                    f"{(time.perf_counter() - t0) * 1e3:.1f} ms")
         return total
 
     def _flush_annotations_impl(self) -> int:
@@ -709,12 +712,18 @@ class NodeAnnotator:
         one ``now`` are identical)."""
         if now is None:
             now = time.time()
+        t0 = time.perf_counter()
         hot_by_node = self.hot_values_batch(now)
         hot_emitted: set[str] = set()
         for sp in self.policy.spec.sync_period:
             self.sync_metric_bulk(
                 sp.name, now, hot_by_node=hot_by_node, hot_emitted=hot_emitted
             )
+        # per-sweep hot-path line, quiet by default (ref [crane]-prefix
+        # convention: plugins.go:59,64 logs at klog V-levels)
+        vlog(1, f"sync sweep: {len(self.policy.spec.sync_period)} metrics, "
+                f"{len(hot_by_node)} hot nodes, "
+                f"{(time.perf_counter() - t0) * 1e3:.1f} ms")
 
     # -- TPU-native bulk refresh ------------------------------------------
 

@@ -49,7 +49,16 @@ def main(argv=None) -> int:
                              "reference's unused offset API)")
     parser.add_argument("--run-seconds", type=float, default=0.0,
                         help="exit after N seconds (0 = run forever)")
+    parser.add_argument("-v", "--verbose", action="count", default=0,
+                        help="raise [crane] log verbosity (-v sweeps/"
+                             "windows, -vv cycles, -vvv per-pod); "
+                             "default run is quiet")
     args = parser.parse_args(argv)
+
+    from ..utils.logging import set_verbosity
+
+    if args.verbose:
+        set_verbosity(args.verbose)
 
     from ..annotator import AnnotatorConfig, NodeAnnotator
     from ..cluster import ClusterState, Node, NodeAddress

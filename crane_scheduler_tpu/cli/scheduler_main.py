@@ -46,7 +46,21 @@ def main(argv=None) -> int:
     parser.add_argument("--concurrent-syncs", type=int, default=4,
                         help="parallel kube write workers (binds/patches "
                              "over pooled keep-alive connections)")
+    parser.add_argument("--tie-break-seed", type=int, default=None,
+                        help="drip mode: seeded RANDOM choice among "
+                             "equal-score feasible nodes (the stock "
+                             "framework's dispersion behavior); default "
+                             "off = lowest node index, deterministic")
+    parser.add_argument("-v", "--verbose", action="count", default=0,
+                        help="raise [crane] log verbosity (-v sweeps/"
+                             "windows, -vv cycles, -vvv per-pod); "
+                             "default run is quiet")
     args = parser.parse_args(argv)
+
+    from ..utils.logging import set_verbosity
+
+    if args.verbose:
+        set_verbosity(args.verbose)
 
     from ..config import build_scheduler_from_config
     from ..config.scheme import load_scheduler_config_from_file
@@ -103,7 +117,8 @@ def main(argv=None) -> int:
                 # CRD is installed; empty lister otherwise (plugin
                 # treats a missing CR as Unschedulable only for
                 # guaranteed-CPU pods it enforces)
-                cluster, config, nrt_lister=cluster.nrt_lister, policy=policy
+                cluster, config, nrt_lister=cluster.nrt_lister, policy=policy,
+                tie_break_seed=args.tie_break_seed,
             )
             for pod in pending:
                 result = sched.schedule_one(pod)
@@ -141,6 +156,7 @@ def main(argv=None) -> int:
             sim.cluster, config,
             nrt_lister=InMemoryNRTLister(),
             clock=sim.clock, policy=sim.policy,
+            tie_break_seed=args.tie_break_seed,
         )
         for _ in range(n_pods):
             result = sched.schedule_one(sim.make_pod())

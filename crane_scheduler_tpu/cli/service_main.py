@@ -43,7 +43,16 @@ def main(argv=None) -> int:
                         help="host:port of process 0 (jax.distributed)")
     parser.add_argument("--num-processes", type=int, default=None)
     parser.add_argument("--process-id", type=int, default=None)
+    parser.add_argument("-v", "--verbose", action="count", default=0,
+                        help="raise [crane] log verbosity (-v sweeps/"
+                             "windows, -vv cycles, -vvv per-pod); "
+                             "default run is quiet")
     args = parser.parse_args(argv)
+
+    from ..utils.logging import set_verbosity
+
+    if args.verbose:
+        set_verbosity(args.verbose)
 
     import jax
     import jax.numpy as jnp

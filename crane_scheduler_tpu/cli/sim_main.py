@@ -32,7 +32,16 @@ def main(argv=None) -> int:
     parser.add_argument("--devices", type=int, default=0,
                         help="sharded mode: mesh size (0 = all)")
     parser.add_argument("--f32", action="store_true", help="float32 fast path")
+    parser.add_argument("-v", "--verbose", action="count", default=0,
+                        help="raise [crane] log verbosity (-v sweeps/"
+                             "windows, -vv cycles, -vvv per-pod); "
+                             "default run is quiet")
     args = parser.parse_args(argv)
+
+    from ..utils.logging import set_verbosity
+
+    if args.verbose:
+        set_verbosity(args.verbose)
 
     import jax
     import jax.numpy as jnp

@@ -146,6 +146,11 @@ _RETRYABLE_IDEMPOTENT = frozenset({500, 502, 503, 504})
 # built and the scheme is plain http): below it, thread spawn + connect
 # overhead beats the GIL savings
 _NATIVE_FLUSH_MIN = 128
+# batches at least this large ride the PYTHON pipelined multi-connection
+# flush when the native engine is unavailable (https, or no .so): the
+# pipelining win needs enough requests per connection to amortize the
+# fill/drain machinery over the per-request round-trips it removes
+_PIPELINE_FLUSH_MIN = 64
 _MAX_STATUS_RETRIES = 3
 # retained response-body prefix: enough for an apiserver Status object's
 # message, small enough to be free on the hot path. Also caps the
@@ -227,6 +232,10 @@ class _RawHTTPConnection:
             self._sock = context.wrap_socket(self._sock, server_hostname=host)
         self._rf = self._sock.makefile("rb")
         self._host_hdr = f"{host}:{port}" if port else host
+
+    def send_raw(self, data: bytes) -> None:
+        """Write pre-rendered request bytes (pipelined flush path)."""
+        self._sock.sendall(data)
 
     def request(self, method: str, path: str, body=None, headers=None):
         data = body or b""
@@ -409,7 +418,12 @@ class _PooledWriter(threading.Thread):
         return min(backoff, _MAX_RETRY_SLEEP)
 
     def _do(self, method: str, path: str, body, content_type: str) -> WriteResult:
-        data = None if body is None else json.dumps(body).encode()
+        if body is None:
+            data = None
+        elif isinstance(body, bytes):
+            data = body  # pre-rendered payload (hot bind/patch paths)
+        else:
+            data = json.dumps(body).encode()
         headers = {}
         if data is not None:
             headers["Content-Type"] = content_type
@@ -583,6 +597,7 @@ class KubeClusterClient:
         seen_events_cap: int = 65536,
         list_page_limit: int = 500,
         concurrent_syncs: int = 4,
+        pipeline_depth: int = 16,
         telemetry: Telemetry | None = None,
     ):
         self.base_url = base_url.rstrip("/")
@@ -592,6 +607,9 @@ class KubeClusterClient:
         self._m_flush_seconds = None
         self._m_status_retries = None
         self._m_native_failures = None
+        self._m_pipeline_stalls = None
+        self._m_pipeline_indeterminate = None
+        self._m_pipeline_inflight = None
         if self._telemetry is not None:
             reg = self._telemetry.registry
             self._m_flush_seconds = reg.histogram(
@@ -605,6 +623,20 @@ class KubeClusterClient:
             self._m_native_failures = reg.counter(
                 "crane_kube_native_flush_failures_total",
                 "Native flush-engine request failures", ("status",),
+            )
+            self._m_pipeline_stalls = reg.counter(
+                "crane_kube_pipeline_stalls_total",
+                "Full-depth response waits in the pipelined write path",
+            )
+            self._m_pipeline_indeterminate = reg.counter(
+                "crane_kube_pipeline_indeterminate_total",
+                "Pipelined non-idempotent requests whose outcome a "
+                "transport failure made unknowable (never re-POSTed)",
+            )
+            self._m_pipeline_inflight = reg.gauge(
+                "crane_kube_pipeline_inflight",
+                "In-flight pipelined requests, by connection",
+                ("conn",),
             )
         u = urlsplit(self.base_url)
         self._scheme = u.scheme
@@ -660,6 +692,12 @@ class KubeClusterClient:
         # write pool: --concurrent-syncs keep-alive workers, spawned on
         # first write (read-only clients never pay the threads)
         self._write_workers = max(1, int(concurrent_syncs))
+        # pipelined write path: max requests in flight per connection
+        # (HTTP/1.1 pipelining with strict in-order response accounting).
+        # _pipeline_disabled forces the round-5 serial engines (bench
+        # before/after comparisons; not a supported production knob)
+        self._pipeline_depth = max(1, int(pipeline_depth))
+        self._pipeline_disabled = False
         self._pool: list[_PooledWriter] = []
         self._pool_closed = False
         self._pool_lock = threading.Lock()
@@ -956,18 +994,28 @@ class KubeClusterClient:
                 try:
                     from ..native.httpflush import NativeHTTPFlusher
 
+                    # connection count honors --concurrent-syncs (the
+                    # operator's parallelism contract). The round-5
+                    # max(workers, 8) floor oversubscribed small
+                    # apiservers: against a single-core server, 8
+                    # concurrently-busy connections convoy its handler
+                    # threads into a ~6x throughput collapse (measured on
+                    # the wire stub), while the pipeline depth below
+                    # keeps each connection saturated without adding
+                    # server-side concurrency.
                     self._native_flusher = NativeHTTPFlusher(
                         self._host, self._port or 80,
-                        workers=max(self._write_workers, 8),
+                        workers=self._write_workers,
                         timeout=self._timeout,
+                        pipeline_depth=self._pipeline_depth,
                     )
                 except (RuntimeError, OSError):
                     self._native_flush_disabled = True
             return self._native_flusher
 
-    def _render_request(self, method: str, path: str, body: dict,
+    def _render_request(self, method: str, path: str, body,
                         content_type: str = "application/json") -> bytes:
-        data = json.dumps(body).encode()
+        data = body if isinstance(body, bytes) else json.dumps(body).encode()
         host = f"{self._host}:{self._port}" if self._port else self._host
         auth = f"Authorization: Bearer {self._token}\r\n" if self._token else ""
         return (
@@ -975,6 +1023,216 @@ class KubeClusterClient:
             f"Content-Length: {len(data)}\r\n"
             f"Content-Type: {content_type}\r\n{auth}\r\n"
         ).encode("latin-1") + data
+
+    @staticmethod
+    def _json_name(name: str) -> str:
+        """K8s object names are DNS labels, so embedding them in a JSON
+        template without escaping is exact; anything else (tests,
+        adversarial input) falls back to the real encoder."""
+        if '"' in name or "\\" in name or any(ord(c) < 0x20 for c in name):
+            return json.dumps(name)[1:-1]
+        return name
+
+    def _render_binding_body(self, namespace: str, name: str,
+                             node_name: str) -> bytes:
+        """The binding subresource body, rendered from a literal
+        template: at bind-burst rates ``json.dumps`` per pod is a
+        measurable share of the one host core the stub benchmarks pin.
+        Byte-compatible JSON (the apiserver parses it; nothing diffs the
+        exact encoder output)."""
+        return (
+            '{"metadata": {"name": "%s", "namespace": "%s"}, '
+            '"target": {"kind": "Node", "name": "%s"}}'
+            % (self._json_name(name), self._json_name(namespace),
+               self._json_name(node_name))
+        ).encode()
+
+    def _note_pipeline_stats(self, flusher) -> None:
+        """Fold the engine's cumulative pipelined counters into the
+        telemetry registry (delta since the last fold)."""
+        if self._m_pipeline_stalls is None or flusher is None:
+            return
+        stats = getattr(flusher, "last_stats", None)
+        if stats is None:
+            return
+        last = getattr(flusher, "_telemetry_folded", None)
+        if last is None:
+            last = flusher._telemetry_folded = {
+                "stalls": 0, "indeterminate": 0}
+        d = stats["stalls"] - last["stalls"]
+        if d > 0:
+            self._m_pipeline_stalls.inc(d)
+            last["stalls"] = stats["stalls"]
+        d = stats["indeterminate"] - last["indeterminate"]
+        if d > 0:
+            self._m_pipeline_indeterminate.inc(d)
+            last["indeterminate"] = stats["indeterminate"]
+
+    # -- Python pipelined multi-connection flush ---------------------------
+
+    def _connect_raw(self) -> _RawHTTPConnection:
+        if self._scheme == "https":
+            context = self._context
+            if context is None:
+                context = ssl.create_default_context()
+            return _RawHTTPConnection(
+                self._host, self._port, self._timeout, context=context
+            )
+        return _RawHTTPConnection(self._host, self._port, self._timeout)
+
+    def _pipelined_flush(self, rendered: list[bytes],
+                         idempotent: bool) -> list[int]:
+        """Pipelined fan-out in pure Python (the https / no-.so twin of
+        the native engine): the batch stripes across up to
+        ``concurrent_syncs`` keep-alive connections, each connection
+        keeps up to ``pipeline_depth`` requests in flight, and responses
+        are accounted strictly in request order.
+
+        POST-safety contract (shared with the native engine): a
+        response-phase transport failure marks the awaited request and
+        everything already sent behind it on that connection
+        INDETERMINATE — non-idempotent requests (binds) are never
+        re-POSTed (status 0); idempotent merge-patches retry once on a
+        fresh connection. A send-phase failure only ever reroutes
+        requests the server cannot have parsed completely (each request
+        is its own ``sendall``, so the failed one was at most partially
+        written). Returns per-request statuses (0 = transport failure /
+        indeterminate); status-based retry stays with the caller."""
+        n = len(rendered)
+        statuses = [0] * n
+        conns = max(1, min(self._write_workers, n))
+        bounds = [n * w // conns for w in range(conns + 1)]
+        stall_total = [0] * conns
+        indet_total = [0] * conns
+        threads = []
+        for w in range(conns):
+            t = threading.Thread(
+                target=self._pipelined_conn_worker,
+                args=(w, rendered, range(bounds[w], bounds[w + 1]),
+                      statuses, idempotent, stall_total, indet_total),
+                daemon=True,
+            )
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        if self._m_pipeline_stalls is not None:
+            stalls = sum(stall_total)
+            if stalls:
+                self._m_pipeline_stalls.inc(stalls)
+            indet = sum(indet_total)
+            if indet:
+                self._m_pipeline_indeterminate.inc(indet)
+        return statuses
+
+    def _pipelined_conn_worker(self, conn_id: int, rendered, indices,
+                               statuses, idempotent: bool,
+                               stall_total, indet_total) -> None:
+        from collections import deque
+
+        gauge = None
+        if self._m_pipeline_inflight is not None:
+            gauge = self._m_pipeline_inflight.labels(conn=str(conn_id))
+        depth = self._pipeline_depth
+        local: deque = deque()  # (idx, attempt) retries, served first
+        todo = iter(indices)
+        inflight: deque = deque()
+        conn = None
+
+        def claim():
+            if local:
+                return local.popleft()
+            nxt = next(todo, None)
+            return None if nxt is None else (nxt, 0)
+
+        def drop_conn():
+            nonlocal conn
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                conn = None
+
+        def fail_inflight():
+            # transport failure: everything in flight is indeterminate
+            while inflight:
+                idx, attempt = inflight.popleft()
+                if idempotent and attempt < 1:
+                    local.append((idx, attempt + 1))
+                else:
+                    statuses[idx] = 0
+                    if not idempotent:
+                        indet_total[conn_id] += 1
+
+        try:
+            while True:
+                # fill phase: pipeline up to depth requests
+                batch = []
+                while len(inflight) + len(batch) < depth:
+                    item = claim()
+                    if item is None:
+                        break
+                    batch.append(item)
+                if batch and conn is None:
+                    try:
+                        conn = self._connect_raw()
+                    except OSError:
+                        for idx, _ in batch:
+                            statuses[idx] = 0
+                        if not inflight and not local:
+                            return
+                        continue
+                send_failed = False
+                for item in batch:
+                    try:
+                        conn.send_raw(rendered[item[0]])
+                    except (OSError, http.client.HTTPException):
+                        # the failed request was at most partially
+                        # written (its own sendall) — the server cannot
+                        # have parsed it: always safe to reroute, like
+                        # everything after it that was never sent
+                        drop_conn()
+                        at = item[1]
+                        for b in [item] + batch[batch.index(item) + 1:]:
+                            if b[1] < 1:
+                                local.append((b[0], b[1] + 1))
+                            else:
+                                statuses[b[0]] = 0
+                        fail_inflight()
+                        send_failed = True
+                        break
+                    inflight.append(item)
+                if send_failed:
+                    continue
+                if not inflight:
+                    if not local:
+                        return
+                    continue
+                if gauge is not None:
+                    gauge.set(len(inflight))
+                # drain phase: responses strictly in request order
+                if len(inflight) >= depth:
+                    stall_total[conn_id] += 1
+                while inflight:
+                    try:
+                        resp = conn.getresponse()
+                    except (OSError, http.client.HTTPException):
+                        drop_conn()
+                        fail_inflight()
+                        break
+                    idx, _ = inflight.popleft()
+                    statuses[idx] = resp.status
+                    if resp.will_close:
+                        # server ends the connection: responses behind
+                        # this one will never arrive
+                        drop_conn()
+                        fail_inflight()
+                        break
+                if gauge is not None:
+                    gauge.set(0)
+        finally:
+            drop_conn()
 
     def _count_native_failure(self, status: int) -> None:
         with self._native_lock:
@@ -1287,45 +1545,63 @@ class KubeClusterClient:
         finally:
             m.labels(kind="annotations").observe(time.perf_counter() - t0)
 
+    def _render_annotation_patch(self, name: str, kv) -> bytes:
+        return self._render_request(
+            "PATCH",
+            f"/api/v1/nodes/{name}",
+            {"metadata": {"annotations": dict(kv)}},
+            "application/merge-patch+json",
+        )
+
     def _patch_node_annotations_bulk_impl(self, per_node) -> int:
         items = list(per_node.items())
         patched = 0
-        if len(items) >= _NATIVE_FLUSH_MIN:
-            flusher = self._get_native_flusher()
+        statuses = None
+        if len(items) >= _PIPELINE_FLUSH_MIN:
+            flusher = (
+                self._get_native_flusher()
+                if len(items) >= _NATIVE_FLUSH_MIN else None
+            )
             if flusher is not None:
-                reqs = [
-                    self._render_request(
-                        "PATCH",
-                        f"/api/v1/nodes/{name}",
-                        {"metadata": {"annotations": dict(kv)}},
-                        "application/merge-patch+json",
-                    )
-                    for name, kv in items
-                ]
-                statuses = flusher.flush(reqs, idempotent=True)
-                retry_items = []
-                ok_updates: dict[str, dict] = {}
-                for (name, kv), status in zip(items, statuses.tolist()):
-                    if 200 <= status < 300:
-                        ok_updates[name] = kv
-                    elif status == 0 or status in _RETRYABLE_ANY \
-                            or status in _RETRYABLE_IDEMPOTENT:
-                        # transport loss / transient status: re-drive
-                        # through the pool, which owns backoff +
-                        # Retry-After (transient statuses count here,
-                        # matching the pool's per-occurrence counting;
-                        # transport absorptions don't, also matching)
-                        if status:
-                            self._count_native_failure(int(status))
-                        retry_items.append((name, kv))
-                    else:
-                        # durable failure (404/422/...): count ONCE and
-                        # drop — the pool wouldn't retry it either
+                reqs = [self._render_annotation_patch(n, kv)
+                        for n, kv in items]
+                if self._pipeline_disabled:
+                    statuses = flusher.flush(reqs, idempotent=True).tolist()
+                else:
+                    statuses = flusher.flush_pipelined(
+                        reqs, idempotent=True).tolist()
+                    self._note_pipeline_stats(flusher)
+            elif not self._pipeline_disabled:
+                # https / no-.so / sub-native-threshold storm path:
+                # Python pipelined fan-out with idempotent (merge-patch)
+                # retry semantics
+                reqs = [self._render_annotation_patch(n, kv)
+                        for n, kv in items]
+                statuses = self._pipelined_flush(reqs, idempotent=True)
+        if statuses is not None:
+            retry_items = []
+            ok_updates: dict[str, dict] = {}
+            for (name, kv), status in zip(items, statuses):
+                if 200 <= status < 300:
+                    ok_updates[name] = kv
+                elif status == 0 or status in _RETRYABLE_ANY \
+                        or status in _RETRYABLE_IDEMPOTENT:
+                    # transport loss / transient status: re-drive
+                    # through the pool, which owns backoff +
+                    # Retry-After (transient statuses count here,
+                    # matching the pool's per-occurrence counting;
+                    # transport absorptions don't, also matching)
+                    if status:
                         self._count_native_failure(int(status))
-                if ok_updates:
-                    self._mirror.patch_node_annotations_bulk(ok_updates)
-                    patched += len(ok_updates)
-                items = retry_items  # slow path owns retries/backoff
+                    retry_items.append((name, kv))
+                else:
+                    # durable failure (404/422/...): count ONCE and
+                    # drop — the pool wouldn't retry it either
+                    self._count_native_failure(int(status))
+            if ok_updates:
+                self._mirror.patch_node_annotations_bulk(ok_updates)
+                patched += len(ok_updates)
+            items = retry_items  # slow path owns retries/backoff
         futs = []
         for name, kv in items:
             body = {"metadata": {"annotations": dict(kv)}}
@@ -1450,22 +1726,42 @@ class KubeClusterClient:
         n = len(items)
         ok = [False] * n
         retry: list[int] = []
+        statuses = None
         flusher = self._get_native_flusher()
         if flusher is not None and n >= _NATIVE_FLUSH_MIN:
             reqs = [
                 self._render_request("POST", path, body)
                 for _, path, body in items
             ]
-            statuses = flusher.flush(reqs, idempotent=False)
-            for i, status in enumerate(statuses.tolist()):
+            if self._pipeline_disabled:
+                statuses = flusher.flush(reqs, idempotent=False).tolist()
+            else:
+                statuses = flusher.flush_pipelined(
+                    reqs, idempotent=False).tolist()
+                self._note_pipeline_stats(flusher)
+        elif n >= _PIPELINE_FLUSH_MIN and not self._pipeline_disabled:
+            # no native engine (https, or no .so): the Python pipelined
+            # fan-out still beats one-request-per-round-trip pooled
+            # writers for storm-sized POST batches
+            reqs = [
+                self._render_request("POST", path, body)
+                for _, path, body in items
+            ]
+            statuses = self._pipelined_flush(reqs, idempotent=False)
+        if statuses is None:
+            retry = list(range(n))
+        else:
+            for i, status in enumerate(statuses):
                 if 200 <= status < 300:
                     ok[i] = True
                 else:
+                    # status 0 covers transport loss AND the pipelined
+                    # indeterminate set: those POSTs are never re-driven
+                    # (the server may have processed them; the watch
+                    # delivers the authoritative outcome either way)
                     self._count_native_failure(int(status))
                     if status in _RETRYABLE_ANY:
                         retry.append(i)
-        else:
-            retry = list(range(n))
         if retry:
             futs = [
                 (i, self._submit_write(
@@ -1507,12 +1803,9 @@ class KubeClusterClient:
             self._mirror.retire_burst_rows(burst, sorted(failed))
         return _KubeBurstHandle(burst, failed)
 
-    def bind_burst(self, handle, node_table, node_idx, now=None) -> list[int]:
-        """Columnar bind through the binding subresource: one POST per
-        bound row streamed over the native engine, the mirror applying
-        placements for the rows the server accepted — WITHOUT local
-        event emission (the apiserver's Scheduled events arrive through
-        the watch, exactly like ``bind_pod``). Returns bound rows."""
+    def _burst_bind_items(self, handle, node_table, node_idx):
+        """Shared front half of ``bind_burst``/``bind_bursts``: the
+        bindable rows and their rendered POST items."""
         import numpy as _np2
 
         burst = handle.burst
@@ -1521,40 +1814,97 @@ class KubeClusterClient:
             row for row in range(len(node_idx))
             if node_idx[row] >= 0 and row not in handle.failed
         ]
-        if not rows:
-            return []
         ns = burst.namespace
         names = burst.names
         items = []
         for row in rows:
-            pod_key = f"{ns}/{names[row]}"
-            path, body = self._binding_request(
-                pod_key, node_table[int(node_idx[row])]
-            )
-            items.append((pod_key, path, body))
-        ok = self._post_batch(items)
-        ok_rows = [row for row, good in zip(rows, ok) if good]
-        # Optimistic mirror apply for accepted rows, no local events.
-        # The pods watch echoes creations quickly, shadowing burst rows
-        # into object pods — the columnar apply covers rows still in
-        # burst form; echoed rows take the object path (_apply_bound),
-        # exactly like per-pod bind_pod's optimistic apply.
+            name = names[row]
+            node_name = node_table[int(node_idx[row])]
+            items.append((
+                f"{ns}/{name}",
+                f"/api/v1/namespaces/{ns}/pods/{name}/binding",
+                self._render_binding_body(ns, name, node_name),
+            ))
+        return node_idx, rows, items
+
+    def _burst_bind_apply(self, handle, node_table, node_idx, rows, ok,
+                          now) -> list[int]:
+        """Shared back half: optimistic mirror apply for the rows the
+        server accepted, no local events. The pods watch echoes
+        creations quickly, shadowing burst rows into object pods — the
+        columnar apply covers rows still in burst form; echoed rows take
+        one batched object-path apply, exactly like per-pod
+        ``bind_pod``'s optimistic apply."""
+        import numpy as _np2
+
+        burst = handle.burst
+        ns = burst.namespace
+        names = burst.names
+        ok_rows = sorted(row for row, good in zip(rows, ok) if good)
+        if not ok_rows:
+            return []
         mirror_idx = _np2.full((len(node_idx),), -1, dtype=_np2.int32)
-        ok_rows = sorted(ok_rows)
         mirror_idx[ok_rows] = node_idx[ok_rows]
         columnar_bound = set(
             int(r) for r in self._mirror.bind_burst(
                 burst, node_table, mirror_idx, now, notify=False
             )
         )
-        for row in ok_rows:
-            if row not in columnar_bound:
-                self._apply_bound(
-                    f"{ns}/{names[row]}", node_table[int(node_idx[row])]
-                )
+        echoed = [
+            (f"{ns}/{names[row]}", node_table[int(node_idx[row])])
+            for row in ok_rows if row not in columnar_bound
+        ]
+        if echoed:
+            self._mirror.bind_pods(echoed, now, notify=False)
         # the SERVER's acceptance defines what bound (the mirror is a
         # cache in whatever form each row currently takes)
         return ok_rows
+
+    def bind_burst(self, handle, node_table, node_idx, now=None) -> list[int]:
+        """Columnar bind through the binding subresource: one POST per
+        bound row streamed over the pipelined engine, the mirror
+        applying placements for the rows the server accepted — WITHOUT
+        local event emission (the apiserver's Scheduled events arrive
+        through the watch, exactly like ``bind_pod``). Returns bound
+        rows."""
+        node_idx, rows, items = self._burst_bind_items(
+            handle, node_table, node_idx
+        )
+        if not rows:
+            return []
+        ok = self._post_batch(items)
+        return self._burst_bind_apply(
+            handle, node_table, node_idx, rows, ok, now
+        )
+
+    def bind_bursts(self, bursts, now=None) -> list[list[int]]:
+        """Coalesced multi-burst bind: ``bursts`` yields ``(handle,
+        node_table, node_idx)`` triples whose binding POSTs ride ONE
+        shared batch through the pipelined engine (a flush window's
+        worth of cycles pays one engine crossing instead of one per
+        burst), then each burst's mirror apply runs as usual. Returns
+        one bound-rows list per burst, in input order."""
+        prepped = []
+        all_items: list = []
+        for handle, node_table, node_idx in bursts:
+            node_idx, rows, items = self._burst_bind_items(
+                handle, node_table, node_idx
+            )
+            prepped.append(
+                (handle, node_table, node_idx, rows, len(all_items),
+                 len(items))
+            )
+            all_items.extend(items)
+        ok = self._post_batch(all_items) if all_items else []
+        out = []
+        for handle, node_table, node_idx, rows, off, cnt in prepped:
+            if not rows:
+                out.append([])
+                continue
+            out.append(self._burst_bind_apply(
+                handle, node_table, node_idx, rows, ok[off:off + cnt], now
+            ))
+        return out
 
     @staticmethod
     def _binding_request(pod_key: str, node_name: str) -> tuple[str, dict]:
@@ -1588,21 +1938,30 @@ class KubeClusterClient:
 
     def bind_pods(self, assignments, now: float | None = None) -> list[str]:
         """Bind a batch through the binding subresource: POSTs stream
-        over the shared batch path (native engine when large, pooled
-        workers otherwise; 429s re-driven — see ``_post_batch``),
+        over the shared batch path (pipelined native engine when large,
+        pooled workers otherwise; 429s re-driven — see ``_post_batch``),
         gathered in input order so the returned bound-key list is
-        deterministic."""
+        deterministic. The optimistic mirror apply for the accepted
+        subset is ONE batched placement transaction (no local events —
+        the apiserver's Scheduled events arrive through the watch)."""
         pairs = list(
             assignments.items() if hasattr(assignments, "items") else assignments
         )
         items = []
         for pod_key, node_name in pairs:
-            path, body = self._binding_request(pod_key, node_name)
-            items.append((pod_key, path, body))
+            namespace, name = pod_key.split("/", 1)
+            items.append((
+                pod_key,
+                f"/api/v1/namespaces/{namespace}/pods/{name}/binding",
+                self._render_binding_body(namespace, name, node_name),
+            ))
         ok = self._post_batch(items)
         bound = []
+        bound_pairs = []
         for (pod_key, node_name), good in zip(pairs, ok):
             if good:
-                self._apply_bound(pod_key, node_name)
                 bound.append(pod_key)
+                bound_pairs.append((pod_key, node_name))
+        if bound_pairs:
+            self._mirror.bind_pods(bound_pairs, now, notify=False)
         return bound

@@ -612,7 +612,8 @@ class ClusterState:
         ``bind_pods``)."""
         return bool(self.bind_pods(((pod_key, node_name),), now))
 
-    def bind_pods(self, assignments, now: float | None = None) -> list[str]:
+    def bind_pods(self, assignments, now: float | None = None,
+                  notify: bool = True) -> list[str]:
         """Batch bind: one lock hold mutates every pod and stamps every
         ``Scheduled`` event, then handlers run outside the lock in bind
         order — semantically identical to calling ``bind_pod`` per pod
@@ -620,7 +621,13 @@ class ClusterState:
         round-trips that dominate 100k-pod bursts. ``assignments`` is a
         ``{pod_key: node_name}`` mapping (or iterable of pairs); returns
         the keys actually bound (missing pods are skipped, mirroring
-        ``bind_pod``'s False)."""
+        ``bind_pod``'s False).
+
+        ``notify=False`` applies the placements WITHOUT recording or
+        delivering Scheduled events — the kube client's batched
+        optimistic mirror apply (the apiserver's authoritative events
+        arrive through the watch; local emission would double-count hot
+        values, exactly the ``bind_burst(notify=False)`` rule)."""
         if now is None:
             now = time.time()
         items = assignments.items() if hasattr(assignments, "items") else assignments
@@ -659,6 +666,8 @@ class ClusterState:
                 self._sched_version += 1
                 self._note_pod_change_locked(node_name)
                 bound.append(pod_key)
+                if not notify:
+                    continue
                 event = Event(
                     namespace=pod.namespace,
                     name=f"{pod.name}.scheduled",

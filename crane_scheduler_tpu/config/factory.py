@@ -22,19 +22,23 @@ def build_scheduler_from_config(
     nrt_lister=None,
     clock=None,
     policy=None,
+    tie_break_seed=None,
 ) -> Scheduler:
     """Build a Scheduler for the first profile.
 
     ``policy`` overrides reading DynamicArgs.policy_config_path from disk
     (useful in tests/sim); ``nrt_lister`` is required when the NRT plugin
-    is enabled.
+    is enabled. ``tie_break_seed`` opts into the stock framework's
+    random-among-ties host selection (seeded; default off = lowest
+    snapshot index, deterministic).
     """
     import time
 
     if not config.profiles:
         raise ValueError("scheduler configuration has no profiles")
     profile = config.profiles[0]
-    sched = Scheduler(cluster, clock=clock or time.time)
+    sched = Scheduler(cluster, clock=clock or time.time,
+                      tie_break_seed=tie_break_seed)
 
     weights = {pw.name: pw.weight for pw in profile.score_enabled}
     enabled = set(profile.filter_enabled) | set(weights)

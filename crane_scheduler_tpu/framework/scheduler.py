@@ -21,6 +21,7 @@ plugin — that is the framework's core acceptance criterion.
 
 from __future__ import annotations
 
+import threading
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
@@ -33,6 +34,7 @@ from ..loadstore.store import NodeLoadStore
 from ..policy.compile import compile_policy
 from ..policy.types import DynamicSchedulerPolicy
 from ..telemetry import Telemetry, active as active_telemetry, maybe_span
+from ..utils.logging import vlog, verbosity
 
 
 def _submit_fetch(pool, dev, telemetry: Telemetry | None = None):
@@ -140,6 +142,203 @@ class _OverlappedRefresh:
         self._pool.shutdown(wait=False, cancel_futures=True)
 
 
+class _BindFlushQueue:
+    """Coalescing, overlapped bind flush for the pipelined loops — the
+    write-side twin of ``_OverlappedRefresh``: binds accumulate for up
+    to a small time/size window, each window flushes as ONE columnar
+    transaction (``bind_bursts``/``bind_pods``) on a background worker,
+    and the scheduling thread never waits on the wire. Wire latency
+    stops serializing cycles; the cost is bounded settlement lag — a
+    yielded result's bind fields (``bound_rows``/``node_idx`` masks,
+    ``assignments``/``unassigned``) settle when its window flushes, and
+    consuming the generator to completion settles everything (the
+    loop's ``finally`` closes the queue). The feedback lag this adds
+    (≤ one window) is the same order as the pipeline's own bind lag.
+
+    For burst items the pod-creation POST rides the worker too (create
+    must precede bind on the wire; keeping them on one FIFO preserves
+    that order while both overlap the next cycle's host work)."""
+
+    def __init__(self, scheduler: "BatchScheduler",
+                 window_s: float = 0.005, max_pods: int = 200_000):
+        import queue as _queue
+
+        self._scheduler = scheduler
+        self._window = float(window_s)
+        self._max_pods = int(max_pods)
+        self._q: _queue.SimpleQueue = _queue.SimpleQueue()
+        self._outstanding = 0
+        self._lock = threading.Lock()
+        self._drained = threading.Condition(self._lock)
+        self._closed = False
+        self._error: BaseException | None = None
+        self.stats = {"windows": 0, "flushed_pods": 0, "max_window_pods": 0}
+        tel = scheduler._telemetry
+        self._m_window_pods = None
+        self._m_window_seconds = None
+        if tel is not None:
+            reg = tel.registry
+            self._m_window_pods = reg.histogram(
+                "crane_bind_flush_window_pods",
+                "Pods coalesced into one bind flush window",
+            )
+            self._m_window_seconds = reg.histogram(
+                "crane_bind_flush_window_seconds",
+                "Open time of each bind flush window",
+            )
+        self._worker = threading.Thread(
+            target=self._run, daemon=True, name="bind-flush",
+        )
+        self._worker.start()
+
+    # -- producer side (scheduling thread) --------------------------------
+
+    def submit_batch(self, result: "BatchResult", now: float) -> None:
+        with self._lock:
+            self._outstanding += 1
+        self._q.put(("batch", result, now))
+
+    def submit_burst(self, namespace: str, names: list, node_table,
+                     node_idx, result: "BurstResult", now: float) -> None:
+        with self._lock:
+            self._outstanding += 1
+        self._q.put(
+            ("burst", namespace, names, node_table, node_idx, result, now)
+        )
+
+    def flush(self) -> None:
+        """Block until every submitted bind has flushed; re-raises a
+        worker error (binds must not fail silently)."""
+        with self._drained:
+            while self._outstanding > 0:
+                self._drained.wait(timeout=0.5)
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+
+    def close(self) -> None:
+        self.flush()
+        self._closed = True
+        self._q.put(None)
+        self._worker.join(timeout=5.0)
+
+    # -- worker side -------------------------------------------------------
+
+    def _run(self) -> None:
+        import queue as _queue
+
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            window = [item]
+            pods = self._item_pods(item)
+            t0 = time.perf_counter()
+            # time/size window: keep accumulating while more cycles'
+            # binds arrive, up to the window deadline or the size cap
+            while pods < self._max_pods:
+                remaining = self._window - (time.perf_counter() - t0)
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=remaining)
+                except _queue.Empty:
+                    break
+                if nxt is None:
+                    self._flush_window(window, time.perf_counter() - t0)
+                    return
+                window.append(nxt)
+                pods += self._item_pods(nxt)
+            self._flush_window(window, time.perf_counter() - t0)
+
+    @staticmethod
+    def _item_pods(item) -> int:
+        if item[0] == "batch":
+            return len(item[1].assignments)
+        return len(item[2])
+
+    def _flush_window(self, window: list, open_seconds: float) -> None:
+        sched = self._scheduler
+        tel = sched._telemetry
+        count = sum(self._item_pods(i) for i in window)
+        try:
+            with maybe_span(tel, "bind_flush", pods=count,
+                            cycles=len(window)):
+                self._flush_window_inner(window)
+        except BaseException as exc:  # noqa: BLE001 — surface via flush()
+            with self._lock:
+                self._error = exc
+        finally:
+            self.stats["windows"] += 1
+            self.stats["flushed_pods"] += count
+            if count > self.stats["max_window_pods"]:
+                self.stats["max_window_pods"] = count
+            vlog(1, f"bind flush window: {count} pods across "
+                    f"{len(window)} cycles, open {open_seconds * 1e3:.1f} ms")
+            if self._m_window_pods is not None:
+                self._m_window_pods.observe(count)
+                self._m_window_seconds.observe(open_seconds)
+            with self._drained:
+                self._outstanding -= len(window)
+                self._drained.notify_all()
+
+    def _flush_window_inner(self, window: list) -> None:
+        import numpy as np
+
+        sched = self._scheduler
+        cluster = sched.cluster
+        batches = [i for i in window if i[0] == "batch"]
+        bursts = [i for i in window if i[0] == "burst"]
+        if batches:
+            # one merged bind transaction for the window's batch results
+            merged: dict = {}
+            for _, result, _now in batches:
+                merged.update(result.assignments)
+            now = batches[-1][2]
+            bound = set(cluster.bind_pods(merged, now))
+            for _, result, _now in batches:
+                failed = [k for k in result.assignments if k not in bound]
+                for k in failed:
+                    del result.assignments[k]
+                result.unassigned.extend(failed)
+        if bursts:
+            # creations first (a bind of an uncreated pod is refused),
+            # then one coalesced columnar bind across the window
+            add_burst = cluster.add_pod_burst
+            handles = [
+                add_burst(ns, names)
+                for _, ns, names, _t, _i, _r, _n in bursts
+            ]
+            triples = []
+            for handle, (_, _ns, _names, table, node_idx, result, _now) in zip(
+                    handles, bursts):
+                failed = getattr(handle, "failed", None)
+                if failed:
+                    # rows the server refused to create can never bind
+                    node_idx = np.asarray(node_idx, dtype=np.int32).copy()
+                    node_idx[sorted(failed)] = -1
+                    result.node_idx = node_idx
+                triples.append((handle, table, node_idx))
+            bind_bursts = getattr(cluster, "bind_bursts", None)
+            now = bursts[-1][6]
+            if bind_bursts is not None:
+                bound_lists = bind_bursts(triples, now)
+            else:
+                bound_lists = [
+                    cluster.bind_burst(h, t, i, now) for h, t, i in triples
+                ]
+            for (_, _ns, _names, table, _i, result, _now), bound in zip(
+                    bursts, bound_lists):
+                result.bound_rows = bound
+                node_idx = np.asarray(result.node_idx)
+                if len(bound) != int((node_idx >= 0).sum()):
+                    mask = np.zeros((len(node_idx),), dtype=bool)
+                    mask[bound] = True
+                    result.node_idx = np.where(
+                        mask, node_idx, -1
+                    ).astype(np.int32)
+
+
 class Scheduler:
     """Plugin-driven single-pod scheduler (the reference-shaped path).
 
@@ -153,10 +352,27 @@ class Scheduler:
         cluster: ClusterState,
         clock=time.time,
         telemetry: Telemetry | None = None,
+        tie_break_seed: int | None = None,
     ):
+        """``tie_break_seed``: opt-in reference-faithful host selection —
+        the stock kube-scheduler samples RANDOMLY among equal-score
+        feasible hosts, while this rebuild defaults to lowest snapshot
+        index for determinism (module docstring). A seed turns on
+        seeded-random choice among exact ties (score parity is
+        untouched; only which tied winner is picked changes), spreading
+        load across identically-scored nodes instead of piling onto
+        index order until hot-value feedback kicks in. Default off, so
+        the parity suite and every existing caller see byte-identical
+        behavior."""
+        import random
+
         self.cluster = cluster
         self._clock = clock
         self._plugins: list[_WeightedPlugin] = []
+        self._tie_rng = (
+            random.Random(tie_break_seed)
+            if tie_break_seed is not None else None
+        )
         self._cache: tuple[int, list[NodeInfo]] | None = None  # (version, snap)
         self._telemetry = (
             telemetry if telemetry is not None else active_telemetry()
@@ -297,8 +513,15 @@ class Scheduler:
                 total += value * wp.weight
             totals[node_info.node.name] = total
 
-        # select host: max score, first (snapshot order) among ties
+        # select host: max score, first (snapshot order) among ties —
+        # or seeded-random among ties when tie_break_seed is set (the
+        # stock framework's dispersion behavior, opt-in)
         best = max(feasible, key=lambda ni: totals[ni.node.name])
+        if self._tie_rng is not None:
+            top = totals[best.node.name]
+            ties = [ni for ni in feasible if totals[ni.node.name] == top]
+            if len(ties) > 1:
+                best = ties[self._tie_rng.randrange(len(ties))]
         best_name = best.node.name
 
         # Reserve
@@ -318,6 +541,12 @@ class Scheduler:
                 if not status.ok():
                     self._unreserve(state, pod, best_name)
                     return ScheduleResult(pod.key(), None, len(feasible), status.reason)
+
+        # per-pod decision line (the plugins.go:59,64 analogue): quiet
+        # unless the operator raised verbosity to the per-pod level
+        if verbosity() >= 3:
+            vlog(3, f"schedule_one {pod.key()}: {len(feasible)} feasible, "
+                    f"picked {best_name} score {totals[best_name]}")
 
         prev = self.cluster.get_pod(pod.key())
         was_bound = prev is not None and bool(prev.node_name)
@@ -695,6 +924,9 @@ class BatchScheduler:
         if bind:
             with maybe_span(tel, "bind_flush"):
                 self._apply_binds(result, now)
+        if verbosity() >= 2:
+            vlog(2, f"batch cycle: {len(result.assignments)}/{len(pods)} "
+                    f"assigned, {len(result.unassigned)} unassigned")
         return result
 
     def _apply_binds(self, result: BatchResult, now: float) -> None:
@@ -712,7 +944,9 @@ class BatchScheduler:
 
     def schedule_batches_pipelined(self, batches, bind: bool = True,
                                    depth: int = 4,
-                                   overlap_refresh: bool = False):
+                                   overlap_refresh: bool = False,
+                                   overlap_bind: bool = False,
+                                   bind_window_s: float = 0.005):
         """Pipelined burst scheduling: dispatch up to ``depth`` cycles
         ahead (JAX dispatch is asynchronous) and start each result's
         device->host copy immediately (``copy_to_host_async``) BEFORE
@@ -740,7 +974,15 @@ class BatchScheduler:
         (the reference's annotator/scheduler decoupling; adds at most
         one refresh interval of annotation lag, same order as the
         pipeline's own bind lag). ``refresh_stats["overlap_hits"]``
-        counts the cycles that skipped the wait."""
+        counts the cycles that skipped the wait.
+
+        ``overlap_bind``: route binds through a coalescing background
+        flush (``_BindFlushQueue``): assignments accumulate for up to
+        ``bind_window_s`` (or the size cap) and each window flushes as
+        one bind transaction overlapped against the next cycle, so wire
+        latency stops serializing cycles. A yielded result's bind
+        fields settle when its window flushes; consuming the generator
+        to completion settles every result."""
         from collections import deque
         from concurrent.futures import ThreadPoolExecutor
 
@@ -749,6 +991,10 @@ class BatchScheduler:
         refresher = (
             _OverlappedRefresh(self)
             if overlap_refresh and self._refresh_from_cluster else None
+        )
+        bindq = (
+            _BindFlushQueue(self, window_s=bind_window_s)
+            if bind and overlap_bind else None
         )
         pending = deque()  # (fetch future, keys, now, names, n)
         # single prefetch worker (depth > 1 only — at depth 1 the drain
@@ -782,10 +1028,15 @@ class BatchScheduler:
                     self._prepared_names, self._prepared_n,
                 ))
                 if len(pending) >= depth:
-                    yield self._drain_pipelined(pending.popleft(), bind)
+                    yield self._drain_pipelined(pending.popleft(), bind, bindq)
             while pending:
-                yield self._drain_pipelined(pending.popleft(), bind)
+                yield self._drain_pipelined(pending.popleft(), bind, bindq)
         finally:
+            if bindq is not None:
+                # settles every yielded result's bind fields before the
+                # consumer's loop finishes (generator finally runs on
+                # exhaustion, before StopIteration reaches the caller)
+                bindq.close()
             if refresher is not None:
                 refresher.close()
             if pool is not None:
@@ -793,15 +1044,21 @@ class BatchScheduler:
                 # fetches; the worker finishes in the background
                 pool.shutdown(wait=False, cancel_futures=True)
 
-    def _drain_pipelined(self, pending, bind: bool) -> BatchResult:
+    def _drain_pipelined(self, pending, bind: bool,
+                         bindq: "_BindFlushQueue | None" = None) -> BatchResult:
         tel = self._telemetry
         fut, keys, now, names, n = pending
         with maybe_span(tel, "d2h_wait"):
             packed = fut.result()  # the only synchronization point
         result = self._build_result(packed, keys, now=now, names=names, n=n)
         if bind:
-            with maybe_span(tel, "bind_flush"):
-                self._apply_binds(result, now)
+            if bindq is not None:
+                # coalesced background flush: the result's bind fields
+                # settle when the window flushes
+                bindq.submit_batch(result, now)
+            else:
+                with maybe_span(tel, "bind_flush"):
+                    self._apply_binds(result, now)
         return result
 
     # -- columnar bursts (pods as rows, binds as one array transaction) ----
@@ -823,16 +1080,22 @@ class BatchScheduler:
 
     def schedule_bursts_pipelined(
         self, bursts, bind: bool = True, depth: int = 4,
-        overlap_refresh: bool = False,
+        overlap_refresh: bool = False, overlap_bind: bool = False,
+        bind_window_s: float = 0.005,
     ):
         """Pipelined columnar bursts: ``bursts`` yields ``(namespace,
         names)`` pairs; one ``BurstResult`` per burst, in order. Same
         dispatch/drain overlap (and the same bounded feedback lag) as
         ``schedule_batches_pipelined``, including ``overlap_refresh``
         (background double-buffered ingest — cycles consume the
-        last-completed store state instead of blocking on it). Requires
-        a burst-capable cluster (``add_pod_burst``/``bind_burst`` —
-        ClusterState has them)."""
+        last-completed store state instead of blocking on it) and
+        ``overlap_bind`` (coalescing background bind flush: each
+        time/size window's creations + binds run as ONE columnar
+        transaction overlapped against the next cycle — results'
+        ``bound_rows``/``node_idx`` settle when their window flushes;
+        full consumption settles everything). Requires a burst-capable
+        cluster (``add_pod_burst``/``bind_burst`` — ClusterState has
+        them)."""
         from collections import deque
 
         if depth < 1:
@@ -848,6 +1111,10 @@ class BatchScheduler:
         refresher = (
             _OverlappedRefresh(self)
             if overlap_refresh and self._refresh_from_cluster else None
+        )
+        bindq = (
+            _BindFlushQueue(self, window_s=bind_window_s)
+            if bind and overlap_bind else None
         )
         pending = deque()
         # same single prefetch worker as schedule_batches_pipelined
@@ -870,22 +1137,31 @@ class BatchScheduler:
                 with maybe_span(tel, "dispatch", pods=len(names)):
                     dev = self._sharded.packed(prepared, len(names), now=now)
                     dev.copy_to_host_async()
-                handle = add_burst(namespace, names) if bind else None
+                # with a bind queue, the creation POST rides the flush
+                # worker too (ordered before the bind on its FIFO), so
+                # the dispatch thread never waits on the wire
+                handle = (
+                    add_burst(namespace, names)
+                    if bind and bindq is None else None
+                )
                 pending.append(
                     (_submit_fetch(pool, dev, tel), namespace, names,
                      handle, now, self._prepared_names, self._prepared_n)
                 )
                 if len(pending) >= depth:
-                    yield self._drain_burst(pending.popleft(), bind)
+                    yield self._drain_burst(pending.popleft(), bind, bindq)
             while pending:
-                yield self._drain_burst(pending.popleft(), bind)
+                yield self._drain_burst(pending.popleft(), bind, bindq)
         finally:
+            if bindq is not None:
+                bindq.close()  # settles all yielded results' bind fields
             if refresher is not None:
                 refresher.close()
             if pool is not None:
                 pool.shutdown(wait=False, cancel_futures=True)
 
-    def _drain_burst(self, item, bind: bool) -> BurstResult:
+    def _drain_burst(self, item, bind: bool,
+                     bindq: "_BindFlushQueue | None" = None) -> BurstResult:
         import numpy as np
 
         tel = self._telemetry
@@ -921,7 +1197,7 @@ class BatchScheduler:
                 mask = np.zeros((len(names),), dtype=bool)
                 mask[bound] = True
                 node_idx = np.where(mask, node_idx, -1).astype(np.int32)
-        return BurstResult(
+        result = BurstResult(
             namespace=namespace,
             names=names,
             node_idx=node_idx,
@@ -931,6 +1207,11 @@ class BatchScheduler:
             schedulable_row=np.asarray(schedulable),
             now=now,
         )
+        if bind and bindq is not None:
+            # coalesced path: creation + bind run on the flush worker;
+            # bound_rows/node_idx settle when the window flushes
+            bindq.submit_burst(namespace, names, table, node_idx, result, now)
+        return result
 
     def _burst_node_table(self, node_names, n: int) -> tuple:
         """The burst's node table as a STABLE, IMMUTABLE tuple, cached

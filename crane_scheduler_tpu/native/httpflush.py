@@ -27,7 +27,7 @@ from .lib import load_native
 
 class NativeHTTPFlusher:
     def __init__(self, host: str, port: int, workers: int = 8,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, pipeline_depth: int = 8):
         lib = load_native()
         if lib is None:
             raise RuntimeError("libcrane_native unavailable")
@@ -36,6 +36,15 @@ class NativeHTTPFlusher:
         self._port = int(port)
         self._workers = int(workers)
         self._timeout_ms = max(1, int(timeout * 1000))
+        self._pipeline_depth = max(1, int(pipeline_depth))
+        # a prebuilt .so may predate the pipelined engine; flush() keeps
+        # working, flush_pipelined() degrades to the serial engine
+        self._has_pipelined = hasattr(lib, "crane_http_flush_pipelined")
+        # cumulative pipelined-engine counters (read by the kube client
+        # after each flush to mirror into telemetry)
+        self.last_stats = {
+            "stalls": 0, "indeterminate": 0, "reconnects": 0, "sends": 0,
+        }
         # the C engine takes an IPv4 literal; resolved up front, and
         # re-resolved when a whole batch comes back transport-dead (DNS
         # failover moved the apiserver while the client caches this
@@ -84,6 +93,53 @@ class NativeHTTPFlusher:
             # so a transient DNS outage can't zero out a working target.
             try:
                 self._ip = self._resolve()
+            except OSError:
+                pass
+        return statuses
+
+    def flush_pipelined(
+        self, requests: list[bytes], idempotent: bool = True,
+        depth: int | None = None, conns: int | None = None,
+    ) -> np.ndarray:
+        """Pipelined fan-out: ``conns`` keep-alive connections, up to
+        ``depth`` requests in flight per connection (responses accounted
+        strictly in order), fill phases coalesced into single sends.
+        Status 0 = transport failure OR indeterminate: for
+        non-idempotent batches a response-phase loss marks the awaited
+        request and everything already pipelined behind it on that
+        connection indeterminate — the engine NEVER re-POSTs them (the
+        server may have processed any prefix); idempotent batches retry
+        the same set once. Engine counters land in ``last_stats``.
+        Falls back to the serial engine on a pre-pipelining .so."""
+        if not self._has_pipelined:
+            return self.flush(requests, idempotent=idempotent)
+        n = len(requests)
+        statuses = np.zeros(n, np.int32)
+        if n == 0:
+            return statuses
+        blob = b"".join(requests)
+        offsets = np.zeros(n + 1, np.int64)
+        np.cumsum([len(r) for r in requests], out=offsets[1:])
+        stats = np.zeros(4, np.int64)
+        self._lib.crane_http_flush_pipelined(
+            self._ip,
+            self._port,
+            blob,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n,
+            conns or self._workers,
+            depth or self._pipeline_depth,
+            1 if idempotent else 0,
+            self._timeout_ms,
+            statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            stats.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        for i, key in enumerate(("stalls", "indeterminate", "reconnects",
+                                 "sends")):
+            self.last_stats[key] += int(stats[i])
+        if not statuses.any():
+            try:
+                self._ip = self._resolve()  # same failover logic as flush()
             except OSError:
                 pass
         return statuses

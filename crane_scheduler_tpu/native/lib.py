@@ -63,6 +63,16 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(i32),
     ]
     lib.crane_http_flush.restype = i64
+    try:
+        # pipelined flush engine (round 6); a prebuilt .so without it
+        # still serves every older symbol — callers probe with hasattr
+        lib.crane_http_flush_pipelined.argtypes = [
+            ctypes.c_char_p, i32, ctypes.c_char_p, p_i64, i64, i32, i32,
+            i32, i32, ctypes.POINTER(i32), p_i64,
+        ]
+        lib.crane_http_flush_pipelined.restype = i64
+    except AttributeError:
+        pass
     return lib
 
 

@@ -14,8 +14,12 @@ from .system import (
     SYSTEM_NAMESPACE_ENV,
     system_namespace,
 )
+from .logging import set_verbosity, verbosity, vlog
 
 __all__ = [
+    "set_verbosity",
+    "verbosity",
+    "vlog",
     "DEFAULT_SYSTEM_NAMESPACE",
     "SYSTEM_NAMESPACE_ENV",
     "system_namespace",

@@ -546,6 +546,19 @@ int read_response(BufConn& c, bool* close_after) {
   return status;
 }
 
+// send up to n bytes, returning how many were written before a failure
+// (callers delimit which pipelined requests fully reached the wire)
+int64_t send_some(int fd, const uint8_t* data, int64_t n) {
+  int64_t sent = 0;
+  while (sent < n) {
+    ssize_t w = ::send(fd, data + sent, static_cast<size_t>(n - sent),
+                       MSG_NOSIGNAL);
+    if (w <= 0) return sent;
+    sent += w;
+  }
+  return sent;
+}
+
 struct FlushCtx {
   const char* ip;
   int port;
@@ -593,6 +606,183 @@ void flush_worker(FlushCtx* ctx) {
   c.close_conn();
 }
 
+// ---------------------------------------------------------------------------
+// Pipelined flush engine
+// ---------------------------------------------------------------------------
+//
+// The serial engine above pays one full client<->server turn per request
+// per connection: send, wait, parse, send the next. The pipelined engine
+// keeps up to `depth` requests in flight per keep-alive connection
+// (HTTP/1.1 pipelining: responses arrive strictly in request order), and
+// coalesces the fill phase into ONE send() syscall for everything it can
+// batch — on a loopback stub the syscall + context-switch ping-pong is a
+// large share of per-request cost, so batching depth-k requests per
+// write is most of the win.
+//
+// POST-safety contract (the binding subresource is not idempotent): a
+// response-phase transport failure marks the awaited request AND every
+// request already sent behind it on that connection indeterminate —
+// they are never re-POSTed (statuses 0; the server may have processed
+// any prefix). Only requests that provably never reached the wire
+// (claimed but unsent, or sent partially so the server cannot have
+// parsed a complete request) reroute to a fresh connection. Idempotent
+// merge-patch batches retry the indeterminate set too (one transport
+// retry per request, like the serial engine).
+
+struct PipeStats {
+  std::atomic<int64_t> stalls{0};         // full-depth response waits
+  std::atomic<int64_t> indeterminate{0};  // never-retried unknown-outcome
+  std::atomic<int64_t> reconnects{0};     // connections (re)opened
+  std::atomic<int64_t> sends{0};          // send() syscalls issued
+};
+
+struct PipeCtx {
+  const char* ip;
+  int port;
+  int timeout_ms;
+  const uint8_t* blob;
+  const int64_t* offsets;
+  int64_t n;
+  int idempotent;
+  int depth;
+  std::atomic<int64_t> next{0};
+  int32_t* statuses;
+  PipeStats stats;
+};
+
+struct PipeItem {
+  int64_t idx;
+  int attempt;
+};
+
+void pipe_worker(PipeCtx* ctx) {
+  BufConn c;
+  std::vector<PipeItem> inflight;  // sent, awaiting response (FIFO)
+  std::vector<PipeItem> local;     // claimed, not yet sent (retries first)
+  std::vector<uint8_t> wire;       // batched send buffer
+  inflight.reserve(static_cast<size_t>(ctx->depth));
+
+  auto claim = [&](PipeItem* out) -> bool {
+    if (!local.empty()) {
+      *out = local.front();
+      local.erase(local.begin());
+      return true;
+    }
+    int64_t i = ctx->next.fetch_add(1, std::memory_order_relaxed);
+    if (i >= ctx->n) return false;
+    *out = PipeItem{i, 0};
+    return true;
+  };
+
+  // a transport failure makes every in-flight request indeterminate:
+  // idempotent batches re-drive them (budget: one transport retry per
+  // request), non-idempotent batches must leave them status 0
+  auto fail_inflight = [&]() {
+    for (const PipeItem& it : inflight) {
+      if (ctx->idempotent && it.attempt < 1) {
+        local.push_back(PipeItem{it.idx, it.attempt + 1});
+      } else {
+        ctx->statuses[it.idx] = 0;
+        if (!ctx->idempotent) {
+          ctx->stats.indeterminate.fetch_add(1, std::memory_order_relaxed);
+        }
+      }
+    }
+    inflight.clear();
+  };
+
+  while (true) {
+    // fill: claim up to depth, coalesce into one send
+    if (static_cast<int>(inflight.size()) < ctx->depth) {
+      wire.clear();
+      std::vector<PipeItem> batch;
+      std::vector<int64_t> ends;  // wire offset after each batched request
+      PipeItem it;
+      while (static_cast<int>(inflight.size() + batch.size()) < ctx->depth &&
+             claim(&it)) {
+        const uint8_t* req = ctx->blob + ctx->offsets[it.idx];
+        const int64_t len = ctx->offsets[it.idx + 1] - ctx->offsets[it.idx];
+        wire.insert(wire.end(), req, req + len);
+        ends.push_back(static_cast<int64_t>(wire.size()));
+        batch.push_back(it);
+      }
+      if (!batch.empty()) {
+        if (!c.is_open()) {
+          c.fd = connect_nodelay(ctx->ip, ctx->port, ctx->timeout_ms);
+          if (!c.is_open()) {
+            // connect failure: nothing reached the wire — but a dead
+            // server must not spin; fail this batch like the serial
+            // engine fails its per-request connect
+            for (const PipeItem& b : batch) ctx->statuses[b.idx] = 0;
+            if (inflight.empty() && local.empty()) break;
+            continue;
+          }
+          ctx->stats.reconnects.fetch_add(1, std::memory_order_relaxed);
+        }
+        ctx->stats.sends.fetch_add(1, std::memory_order_relaxed);
+        int64_t sent = send_some(c.fd, wire.data(),
+                                 static_cast<int64_t>(wire.size()));
+        if (sent == static_cast<int64_t>(wire.size())) {
+          for (const PipeItem& b : batch) inflight.push_back(b);
+        } else {
+          // partial send: requests fully written are on the wire (they
+          // join inflight, then fail as indeterminate with it); the
+          // partially-written one and everything after never formed a
+          // complete request server-side — always safe to reroute
+          c.close_conn();
+          size_t k = 0;
+          while (k < batch.size() && ends[k] <= sent) {
+            inflight.push_back(batch[k]);
+            ++k;
+          }
+          fail_inflight();
+          for (size_t j = k; j < batch.size(); ++j) {
+            if (batch[j].attempt < 1) {
+              local.push_back(PipeItem{batch[j].idx, batch[j].attempt + 1});
+            } else {
+              ctx->statuses[batch[j].idx] = 0;
+            }
+          }
+          continue;
+        }
+      }
+    }
+    if (inflight.empty()) {
+      if (local.empty()) break;
+      continue;
+    }
+    // drain responses, strictly in request order: one blocking read,
+    // then keep going while response bytes are already buffered — a
+    // deep drain refills the pipeline in ONE batched send instead of
+    // degenerating into send-one/read-one lockstep
+    if (static_cast<int>(inflight.size()) >= ctx->depth) {
+      ctx->stats.stalls.fetch_add(1, std::memory_order_relaxed);
+    }
+    while (!inflight.empty()) {
+      bool close_after = false;
+      int status = read_response(c, &close_after);
+      if (status == 0) {
+        // response-phase failure: the awaited request and everything
+        // already pipelined behind it are indeterminate
+        c.close_conn();
+        fail_inflight();
+        break;
+      }
+      ctx->statuses[inflight.front().idx] = status;
+      inflight.erase(inflight.begin());
+      if (close_after) {
+        // server ends the connection here: responses for the requests
+        // already sent behind this one will never arrive
+        c.close_conn();
+        fail_inflight();
+        break;
+      }
+      if (c.pos >= c.len) break;  // nothing buffered: go refill
+    }
+  }
+  c.close_conn();
+}
+
 }  // namespace
 
 extern "C" {
@@ -624,6 +814,50 @@ int64_t crane_http_flush(const char* ip, int32_t port, const uint8_t* blob,
   threads.reserve(static_cast<size_t>(nw));
   for (int w = 0; w < nw; ++w) threads.emplace_back(flush_worker, &ctx);
   for (auto& t : threads) t.join();
+  int64_t ok = 0;
+  for (int64_t i = 0; i < n; ++i)
+    if (statuses[i] >= 200 && statuses[i] < 300) ++ok;
+  return ok;
+}
+
+// Pipelined flush: `conns` keep-alive connections, up to `depth`
+// requests in flight per connection with strict in-order response
+// accounting, fill phases coalesced into single send() calls. statuses
+// as in crane_http_flush (0 = transport failure / indeterminate; no
+// status-based retry here). stats_out (nullable) receives 4 int64
+// counters: [0] pipeline stalls (full-depth response waits),
+// [1] indeterminate non-idempotent requests (never re-POSTed),
+// [2] connections opened, [3] send() syscalls. Returns 2xx count.
+int64_t crane_http_flush_pipelined(const char* ip, int32_t port,
+                                   const uint8_t* blob,
+                                   const int64_t* offsets, int64_t n,
+                                   int32_t conns, int32_t depth,
+                                   int32_t idempotent, int32_t timeout_ms,
+                                   int32_t* statuses, int64_t* stats_out) {
+  if (n <= 0) return 0;
+  PipeCtx ctx;
+  ctx.ip = ip;
+  ctx.port = port;
+  ctx.timeout_ms = timeout_ms > 0 ? timeout_ms : 30000;
+  ctx.blob = blob;
+  ctx.offsets = offsets;
+  ctx.n = n;
+  ctx.idempotent = idempotent;
+  ctx.depth = depth < 1 ? 1 : depth;
+  ctx.statuses = statuses;
+  std::memset(statuses, 0, sizeof(int32_t) * static_cast<size_t>(n));
+  int nw = conns < 1 ? 1 : conns;
+  if (static_cast<int64_t>(nw) > n) nw = static_cast<int>(n);
+  std::vector<std::thread> threads;
+  threads.reserve(static_cast<size_t>(nw));
+  for (int w = 0; w < nw; ++w) threads.emplace_back(pipe_worker, &ctx);
+  for (auto& t : threads) t.join();
+  if (stats_out != nullptr) {
+    stats_out[0] = ctx.stats.stalls.load();
+    stats_out[1] = ctx.stats.indeterminate.load();
+    stats_out[2] = ctx.stats.reconnects.load();
+    stats_out[3] = ctx.stats.sends.load();
+  }
   int64_t ok = 0;
   for (int64_t i = 0; i < n; ++i)
     if (statuses[i] >= 200 && statuses[i] < 300) ++ok;

@@ -18,6 +18,7 @@ import json
 import os
 import queue
 import threading
+import time
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -60,18 +61,32 @@ class KubeStubState:
         # (status, payload_dict, extra_headers) answered to the next
         # PATCH/POST (non-control) request INSTEAD of normal handling
         self.write_faults: deque = deque()
+        # processed (non-faulted) binding-subresource POSTs per pod key:
+        # the POST-safety oracle — a pod with >1 processed bind was
+        # double-POSTed, which the pipelined write path must never do
+        self.bind_posts: dict[str, int] = {}
 
     def inject_write_faults(self, *faults):
         """Queue canned failure responses for upcoming write requests.
         Each fault: (status, payload) or (status, payload, headers) —
         e.g. (429, {...}, {"Retry-After": "0.1"}) or
-        (301, {}, {"Location": "/elsewhere"})."""
+        (301, {}, {"Location": "/elsewhere"}). Two transport faults ride
+        the same queue: status 0 = close the connection without
+        responding (mid-pipeline reset — the request WAS read, its
+        outcome is unknowable to the client); status -1 = wedge (hold
+        the request for payload["seconds"] without responding, then
+        close — a hung apiserver that must surface as a client timeout,
+        not a stuck flush)."""
         with self.lock:
             for f in faults:
                 status, payload, *rest = f
                 self.write_faults.append(
                     (int(status), payload or {}, (rest[0] if rest else {}))
                 )
+
+    def duplicate_binds(self) -> int:
+        with self.lock:
+            return sum(1 for v in self.bind_posts.values() if v > 1)
 
     # -- mutations (each stamps a resourceVersion + history entry) ---------
 
@@ -260,11 +275,40 @@ def _make_handler(state: KubeStubState):
             )
 
         def _pop_write_fault(self):
-            """Serve one injected fault (body already read) or None."""
+            """Serve one injected fault (body already read) or None. A
+            fault whose payload carries ``_skip: k`` lets k writes pass
+            through normally first — that is how a test lands a fault on
+            the k+1-th request of a pipelined batch."""
             with state.lock:
                 if state.write_faults:
-                    return state.write_faults.popleft()
+                    status, payload, headers = state.write_faults[0]
+                    skip = (
+                        payload.get("_skip", 0)
+                        if isinstance(payload, dict) else 0
+                    )
+                    if skip > 0:
+                        payload["_skip"] = skip - 1
+                        return None
+                    state.write_faults.popleft()
+                    return (status, payload, headers)
             return None
+
+        def _serve_fault(self, fault) -> None:
+            """Answer (or transport-fail) one injected fault entry."""
+            status, payload, headers = fault
+            if status == 0:
+                # reset: the request was fully read but never answered —
+                # close the stream so everything pipelined behind it on
+                # this connection dies with it
+                self.close_connection = True
+                return
+            if status == -1:
+                # wedge: hold the request past the client's timeout,
+                # then die (a hung apiserver)
+                time.sleep(float(payload.get("seconds", 30.0)))
+                self.close_connection = True
+                return
+            self._send_raw(status, json.dumps(payload).encode(), headers)
 
         def _json(self, code: int, payload: dict):
             self._send_raw(code, json.dumps(payload).encode())
@@ -433,6 +477,10 @@ def _make_handler(state: KubeStubState):
                         "requests": by_method,
                         "rv": state._rv,
                         "events": len(state.events),
+                        "bind_posts": sum(state.bind_posts.values()),
+                        "duplicate_binds": sum(
+                            1 for v in state.bind_posts.values() if v > 1
+                        ),
                         "watchers": len(state.watchers),
                         "watcher_backlog": sum(
                             q.qsize() for _, q in state.watchers
@@ -503,9 +551,7 @@ def _make_handler(state: KubeStubState):
             body = self._read_body()
             fault = self._pop_write_fault()
             if fault is not None:
-                status, payload, headers = fault
-                return self._send_raw(
-                    status, json.dumps(payload).encode(), headers)
+                return self._serve_fault(fault)
             annotations = body.get("metadata", {}).get("annotations", {})
             parts = self.path.strip("/").split("/")
             code, payload, raw = 404, {"message": "bad patch path"}, None
@@ -556,9 +602,7 @@ def _make_handler(state: KubeStubState):
             if parts[0] != "__stub":
                 fault = self._pop_write_fault()
                 if fault is not None:
-                    status, fault_payload, headers = fault
-                    return self._send_raw(
-                        status, json.dumps(fault_payload).encode(), headers)
+                    return self._serve_fault(fault)
             if parts[0] == "__stub":
                 # control endpoints for subprocess mode
                 if parts[1] == "seed":
@@ -612,6 +656,9 @@ def _make_handler(state: KubeStubState):
                     namespace, name = parts[-4], parts[-2]
                     key = f"{namespace}/{name}"
                     pod = state.pods.get(key)
+                    # every PROCESSED bind counts (faulted ones returned
+                    # above, unprocessed): >1 per pod = a double-POST
+                    state.bind_posts[key] = state.bind_posts.get(key, 0) + 1
                     if pod is None:
                         code, payload = 404, {"message": "pod not found"}
                     else:
@@ -673,7 +720,14 @@ class KubeStubServer:
                 ("127.0.0.1", reuse_port), _make_handler(self.state),
                 bind_and_activate=False,
             )
-            self._server.allow_reuse_port = True
+            self._server.allow_reuse_port = True  # honored on py3.11+
+            # socketserver grew allow_reuse_port in 3.11; set the option
+            # directly so shard mode works on 3.10 too
+            import socket as _socket
+
+            self._server.socket.setsockopt(
+                _socket.SOL_SOCKET, _socket.SO_REUSEPORT, 1
+            )
             self._server.server_bind()
             self._server.server_activate()
         self._control_server = None
@@ -827,11 +881,14 @@ class KubeStubSubprocess:
         per = self._control_all("/__stub/stats")
         if len(per) == 1:
             return per[0]
-        agg: dict = {"requests": {}, "connections": 0, "shard_requests": []}
+        agg: dict = {"requests": {}, "connections": 0, "shard_requests": [],
+                     "bind_posts": 0, "duplicate_binds": 0}
         for s in per:
             for k, v in s.get("requests", {}).items():
                 agg["requests"][k] = agg["requests"].get(k, 0) + v
             agg["connections"] += s.get("connections", 0)
+            agg["bind_posts"] += s.get("bind_posts", 0)
+            agg["duplicate_binds"] += s.get("duplicate_binds", 0)
             agg["shard_requests"].append(
                 sum(s.get("requests", {}).values())
             )

@@ -1,0 +1,74 @@
+"""Opt-in seeded random tie-break among equal-score feasible nodes.
+
+The stock kube-scheduler samples randomly among tied hosts; this rebuild
+defaults to lowest snapshot index for determinism (a documented
+divergence — VERDICT missing #3). ``Scheduler(tie_break_seed=...)`` opts
+into the reference-faithful dispersion: seeded random choice among EXACT
+ties only, scores untouched. The distribution test drives ≥1k ties and
+asserts near-uniform spread; the default path stays byte-identical
+(parity suite unaffected).
+"""
+
+import time
+
+from crane_scheduler_tpu.cluster import ClusterState, Node, Pod
+from crane_scheduler_tpu.framework.scheduler import Scheduler
+from crane_scheduler_tpu.plugins import DynamicPlugin
+from crane_scheduler_tpu.policy import DEFAULT_POLICY
+from crane_scheduler_tpu.utils import format_local_time
+
+N_NODES = 10
+NOW = time.time()
+
+
+def _tied_cluster() -> ClusterState:
+    """A cluster whose nodes carry IDENTICAL fresh annotations — every
+    feasible node scores exactly the same."""
+    cluster = ClusterState()
+    ts = format_local_time(NOW - 30.0)
+    annos = {
+        sp.name: f"0.30000,{ts}" for sp in DEFAULT_POLICY.spec.sync_period
+    }
+    for i in range(N_NODES):
+        cluster.add_node(Node(name=f"node-{i:02d}", annotations=dict(annos)))
+    return cluster
+
+
+def _schedule(n_pods: int, seed=None) -> dict:
+    cluster = _tied_cluster()
+    sched = Scheduler(cluster, clock=lambda: NOW, tie_break_seed=seed)
+    sched.register(DynamicPlugin(DEFAULT_POLICY, clock=lambda: NOW), weight=3)
+    placements: dict[str, int] = {}
+    for i in range(n_pods):
+        pod = Pod(name=f"p{i}", namespace="d")
+        cluster.add_pod(pod)
+        result = sched.schedule_one(pod)
+        assert result.node is not None
+        assert result.feasible == N_NODES
+        # every node is an exact tie: identical weighted totals
+        assert len(set(result.scores.values())) == 1
+        placements[result.node] = placements.get(result.node, 0) + 1
+    return placements
+
+
+def test_default_tiebreak_is_lowest_index_deterministic():
+    placements = _schedule(50)
+    assert placements == {"node-00": 50}  # index-order pile-up, documented
+
+
+def test_seeded_random_tiebreak_spreads_near_uniform():
+    """≥1k ties: every node should receive close to n/N placements
+    (binomial sd ~13.4 at n=2000, N=10; the ±80 band is ~6 sigma)."""
+    n = 2000
+    placements = _schedule(n, seed=42)
+    assert sum(placements.values()) == n
+    assert len(placements) == N_NODES
+    expected = n / N_NODES
+    for node, count in placements.items():
+        assert abs(count - expected) < 80, (node, count)
+
+
+def test_seeded_tiebreak_is_reproducible():
+    assert _schedule(100, seed=7) == _schedule(100, seed=7)
+    # a different seed produces a different (but still valid) sequence
+    assert _schedule(100, seed=7) != _schedule(100, seed=8)

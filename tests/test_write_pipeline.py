@@ -1,0 +1,347 @@
+"""The pipelined kube write path's fault matrix, native and Python.
+
+The round-6 write path keeps N keep-alive connections per apiserver and
+pipelines requests per connection with strict in-order response
+accounting. Its hard contract is POST safety: the binding subresource is
+not idempotent, so a response-phase transport failure must mark the
+awaited request AND everything already pipelined behind it on that
+connection indeterminate — never re-POSTed — while idempotent
+merge-patches retry on a fresh connection. These tests drive both
+engines (native/crane_native.cpp crane_http_flush_pipelined and the
+Python ``_pipelined_flush``) against the wire stub through the four
+fault classes the ISSUE names: 409 bind conflict, 429 Retry-After,
+mid-pipeline connection reset, and a wedged (never-answering) server.
+The stub itself is the double-POST oracle: it counts every PROCESSED
+binding POST per pod (``bind_posts``/``duplicate_binds``).
+"""
+
+import importlib.util
+import os
+import time
+
+import numpy as np
+import pytest
+
+from crane_scheduler_tpu.cluster.kube import KubeClusterClient
+from crane_scheduler_tpu.native.httpflush import NativeHTTPFlusher
+from crane_scheduler_tpu.native.lib import load_native
+
+_STUB = os.path.join(os.path.dirname(__file__), "kube_stub.py")
+spec = importlib.util.spec_from_file_location("kube_stub", _STUB)
+kube_stub = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(kube_stub)
+
+_lib = load_native()
+needs_pipelined_native = pytest.mark.skipif(
+    _lib is None or not hasattr(_lib, "crane_http_flush_pipelined"),
+    reason="native pipelined engine unavailable",
+)
+
+
+@pytest.fixture()
+def stub():
+    server = kube_stub.KubeStubServer().start()
+    yield server
+    server.stop()
+
+
+def _host_port(stub):
+    host, port = stub.url[len("http://"):].split(":")
+    return host, int(port)
+
+
+def _seed(stub, nodes=4, pods=8, ns="t"):
+    for i in range(nodes):
+        stub.state.add_node(f"node-{i}", f"10.0.0.{i}")
+    for i in range(pods):
+        stub.state.add_pod(ns, f"p{i}")
+
+
+def _bind_requests(stub, n=8, ns="t"):
+    """Pre-rendered binding POSTs p0..p(n-1) -> node-(i%4)."""
+    client = KubeClusterClient(stub.url)
+    reqs = []
+    for i in range(n):
+        body = client._render_binding_body(ns, f"p{i}", f"node-{i % 4}")
+        reqs.append(client._render_request(
+            "POST", f"/api/v1/namespaces/{ns}/pods/p{i}/binding", body
+        ))
+    client.stop()
+    return reqs
+
+
+def _patch_requests(stub, n=8):
+    client = KubeClusterClient(stub.url)
+    reqs = [
+        client._render_request(
+            "PATCH", f"/api/v1/nodes/node-{i % 4}",
+            {"metadata": {"annotations": {f"k{i}": "v"}}},
+            "application/merge-patch+json",
+        )
+        for i in range(n)
+    ]
+    client.stop()
+    return reqs
+
+
+# -- native engine ---------------------------------------------------------
+
+
+@needs_pipelined_native
+def test_native_pipelined_clean_binds_exactly_once(stub):
+    _seed(stub)
+    host, port = _host_port(stub)
+    f = NativeHTTPFlusher(host, port, workers=1, timeout=5.0)
+    statuses = f.flush_pipelined(_bind_requests(stub), idempotent=False,
+                                 depth=8, conns=1)
+    assert (statuses == 201).all()
+    assert stub.state.duplicate_binds() == 0
+    assert sum(stub.state.bind_posts.values()) == 8
+    assert f.last_stats["indeterminate"] == 0
+
+
+@needs_pipelined_native
+def test_native_pipelined_409_bind_conflict_not_retried(stub):
+    """A 409 (bind conflict) is a durable, fully-delivered response:
+    exactly that request fails, nothing behind it is disturbed, and it
+    is never re-POSTed."""
+    _seed(stub)
+    host, port = _host_port(stub)
+    stub.state.inject_write_faults(
+        (409, {"message": "Operation cannot be fulfilled", "_skip": 2}),
+    )
+    f = NativeHTTPFlusher(host, port, workers=1, timeout=5.0)
+    statuses = f.flush_pipelined(_bind_requests(stub), idempotent=False,
+                                 depth=8, conns=1).tolist()
+    assert statuses[2] == 409
+    assert [s for i, s in enumerate(statuses) if i != 2] == [201] * 7
+    assert stub.state.duplicate_binds() == 0
+    # the 409'd POST was answered, not processed — and never re-sent
+    assert stub.state.bind_posts.get("t/p2", 0) == 0
+
+
+@needs_pipelined_native
+def test_native_pipelined_mid_pipeline_reset_posts_indeterminate(stub):
+    """A reset while awaiting response k kills the connection: request k
+    and everything already pipelined behind it are indeterminate
+    (status 0) and MUST NOT be re-POSTed — the server may have processed
+    any prefix of them. Requests answered before the reset keep their
+    statuses."""
+    _seed(stub)
+    host, port = _host_port(stub)
+    stub.state.inject_write_faults((0, {"_skip": 3}))
+    f = NativeHTTPFlusher(host, port, workers=1, timeout=5.0)
+    statuses = f.flush_pipelined(_bind_requests(stub), idempotent=False,
+                                 depth=8, conns=1).tolist()
+    assert statuses[:3] == [201] * 3
+    assert statuses[3:] == [0] * 5
+    assert f.last_stats["indeterminate"] == 5
+    # POST-safety oracle: p0-p2 bound exactly once, p3.. never re-POSTed
+    assert stub.state.duplicate_binds() == 0
+    assert sum(stub.state.bind_posts.values()) == 3
+    for i in range(3, 8):
+        assert stub.state.bind_posts.get(f"t/p{i}", 0) == 0
+
+
+@needs_pipelined_native
+def test_native_pipelined_reset_retries_idempotent_patches(stub):
+    """The same mid-pipeline reset on a merge-patch batch re-drives the
+    indeterminate set on a fresh connection: merge-patches are
+    idempotent, so every patch lands despite the reset."""
+    _seed(stub)
+    host, port = _host_port(stub)
+    stub.state.inject_write_faults((0, {"_skip": 3}))
+    f = NativeHTTPFlusher(host, port, workers=1, timeout=5.0)
+    statuses = f.flush_pipelined(_patch_requests(stub), idempotent=True,
+                                 depth=8, conns=1)
+    assert (statuses == 200).all()
+    assert f.last_stats["indeterminate"] == 0
+    # every key arrived despite the reset
+    anno = stub.state.nodes["node-3"]["metadata"]["annotations"]
+    assert "k3" in anno or "k7" in anno
+
+
+@needs_pipelined_native
+def test_native_pipelined_wedged_server_times_out(stub):
+    """A wedged apiserver (reads the request, never answers) must
+    surface as bounded indeterminate failures, not a hung flush."""
+    _seed(stub)
+    host, port = _host_port(stub)
+    stub.state.inject_write_faults((-1, {"seconds": 30.0}))
+    f = NativeHTTPFlusher(host, port, workers=1, timeout=1.0)
+    t0 = time.perf_counter()
+    statuses = f.flush_pipelined(_bind_requests(stub, n=4),
+                                 idempotent=False, depth=4, conns=1)
+    assert time.perf_counter() - t0 < 10.0
+    assert (statuses == 0).all()
+    assert stub.state.duplicate_binds() == 0
+    assert sum(stub.state.bind_posts.values()) == 0
+
+
+# -- Python pipelined path -------------------------------------------------
+
+
+def test_python_pipelined_clean_binds_exactly_once(stub):
+    _seed(stub)
+    client = KubeClusterClient(stub.url, concurrent_syncs=1)
+    statuses = client._pipelined_flush(_bind_requests(stub),
+                                       idempotent=False)
+    client.stop()
+    assert statuses == [201] * 8
+    assert stub.state.duplicate_binds() == 0
+    assert sum(stub.state.bind_posts.values()) == 8
+
+
+def test_python_pipelined_409_bind_conflict_not_retried(stub):
+    _seed(stub)
+    stub.state.inject_write_faults(
+        (409, {"message": "conflict", "_skip": 1}),
+    )
+    client = KubeClusterClient(stub.url, concurrent_syncs=1)
+    statuses = client._pipelined_flush(_bind_requests(stub),
+                                       idempotent=False)
+    client.stop()
+    assert statuses[1] == 409
+    assert [s for i, s in enumerate(statuses) if i != 1] == [201] * 7
+    assert stub.state.bind_posts.get("t/p1", 0) == 0
+    assert stub.state.duplicate_binds() == 0
+
+
+def test_python_pipelined_mid_pipeline_reset_posts_indeterminate(stub):
+    _seed(stub)
+    stub.state.inject_write_faults((0, {"_skip": 3}))
+    client = KubeClusterClient(stub.url, concurrent_syncs=1)
+    statuses = client._pipelined_flush(_bind_requests(stub),
+                                       idempotent=False)
+    client.stop()
+    assert statuses[:3] == [201] * 3
+    assert statuses[3:] == [0] * 5
+    assert stub.state.duplicate_binds() == 0
+    assert sum(stub.state.bind_posts.values()) == 3
+
+
+def test_python_pipelined_reset_retries_idempotent_patches(stub):
+    _seed(stub)
+    stub.state.inject_write_faults((0, {"_skip": 3}))
+    client = KubeClusterClient(stub.url, concurrent_syncs=1)
+    statuses = client._pipelined_flush(_patch_requests(stub),
+                                       idempotent=True)
+    client.stop()
+    assert statuses == [200] * 8
+
+
+def test_python_pipelined_wedged_server_times_out(stub):
+    _seed(stub)
+    stub.state.inject_write_faults((-1, {"seconds": 30.0}))
+    client = KubeClusterClient(stub.url, concurrent_syncs=1, timeout=1.0)
+    t0 = time.perf_counter()
+    statuses = client._pipelined_flush(_bind_requests(stub, n=4),
+                                       idempotent=False)
+    client.stop()
+    assert time.perf_counter() - t0 < 10.0
+    assert statuses == [0] * 4
+    assert sum(stub.state.bind_posts.values()) == 0
+
+
+# -- through the client's public write paths -------------------------------
+
+
+def test_bind_pods_429_redriven_exactly_once(stub):
+    """A 429 is explicitly not processed, so the batch path re-drives it
+    through the pool (which honors Retry-After) — the pod ends up bound
+    exactly once, never double-POSTed."""
+    _seed(stub, pods=0)
+    client = KubeClusterClient(stub.url, concurrent_syncs=1)
+    client.start()
+    handle = client.add_pod_burst("t", [f"q{i}" for i in range(130)])
+    assert not handle.failed
+    stub.state.inject_write_faults(
+        (429, {"message": "throttled", "_skip": 5}, {"Retry-After": "0.05"}),
+    )
+    pairs = [(f"t/q{i}", f"node-{i % 4}") for i in range(130)]
+    bound = client.bind_pods(pairs)
+    client.stop()
+    assert len(bound) == 130
+    assert stub.state.duplicate_binds() == 0
+    assert sum(stub.state.bind_posts.values()) == 130
+
+
+def test_bind_pods_mirror_apply_is_batched_and_eventless(stub):
+    """The optimistic mirror apply after a bind batch must not emit
+    local Scheduled events (the server's arrive via the watch) — and the
+    server's events are the ONLY ones subscribers see."""
+    _seed(stub, pods=0)
+    client = KubeClusterClient(stub.url, concurrent_syncs=1)
+    client.start()
+    seen = []
+    client.subscribe_events(seen.append)
+    handle = client.add_pod_burst("t", [f"e{i}" for i in range(10)])
+    assert not handle.failed
+    bound = client.bind_pods([(f"t/e{i}", f"node-{i % 4}") for i in range(10)])
+    assert len(bound) == 10
+    # mirror sees its own writes immediately (optimistic batched apply)
+    for i in range(10):
+        assert client.get_pod(f"t/e{i}").node_name == f"node-{i % 4}"
+    deadline = time.time() + 5.0
+    while len(seen) < 10 and time.time() < deadline:
+        time.sleep(0.02)
+    client.stop()
+    # exactly one Scheduled event per pod — all from the server
+    assert len(seen) == 10
+
+
+def test_overlap_bind_over_kube_boundary_settles_and_coalesces(stub):
+    """The scheduler's coalescing bind queue over the kube client:
+    every yielded result's bind fields settle by generator exhaustion,
+    the stub sees no duplicate binds, and the flush-window machinery
+    reports coalesced windows."""
+    import jax
+
+    from crane_scheduler_tpu.annotator import AnnotatorConfig, NodeAnnotator
+    from crane_scheduler_tpu.framework.scheduler import BatchScheduler
+    from crane_scheduler_tpu.metrics import FakeMetricsSource
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+
+    for i in range(8):
+        stub.state.add_node(f"node-{i}", f"10.0.1.{i}")
+    client = KubeClusterClient(stub.url, concurrent_syncs=1)
+    client.start()
+    fake = FakeMetricsSource()
+    for i in range(8):
+        for sp in DEFAULT_POLICY.spec.sync_period:
+            fake.set(sp.name, f"10.0.1.{i}", 0.2, by="ip")
+    ann = NodeAnnotator(client, fake, DEFAULT_POLICY,
+                        AnnotatorConfig(bulk_sync=True, direct_store=True))
+    batch = BatchScheduler(client, DEFAULT_POLICY, snapshot_bucket=16,
+                           refresh_from_cluster=False)
+    ann.attach_store(batch.store)
+    ann.sync_all_once_bulk()
+    streams = [("w", [f"c{c}x{i}" for i in range(40)]) for c in range(4)]
+    results = list(batch.schedule_bursts_pipelined(
+        streams, bind=True, overlap_bind=True, bind_window_s=0.05
+    ))
+    client.stop()
+    assert [len(r.bound_rows) for r in results] == [40] * 4
+    for r in results:
+        assert int((np.asarray(r.node_idx) >= 0).sum()) == 40
+    assert stub.state.duplicate_binds() == 0
+    assert sum(stub.state.bind_posts.values()) == 160
+
+
+def test_overlap_bind_in_memory_matches_synchronous(stub):
+    """overlap_bind must not change placements or bound counts vs the
+    synchronous flush on the in-memory cluster (same solver, same
+    store state — only flush timing moves)."""
+    from crane_scheduler_tpu.sim import SimConfig, Simulator
+
+    def run(overlap):
+        sim = Simulator(SimConfig(n_nodes=16, seed=7))
+        sim.sync_metrics()
+        batch = sim.build_batch_scheduler(bucket=32)
+        streams = [("s", [f"c{c}p{i}" for i in range(30)]) for c in range(3)]
+        out = list(batch.schedule_bursts_pipelined(
+            streams, bind=True, overlap_bind=overlap
+        ))
+        return [np.asarray(r.node_idx).tolist() for r in out]
+
+    assert run(False) == run(True)
