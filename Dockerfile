@@ -17,6 +17,9 @@ WORKDIR /app
 COPY crane_scheduler_tpu/ crane_scheduler_tpu/
 COPY deploy/ deploy/
 COPY --from=builder /src/native/libcrane_native.so native/libcrane_native.so
+# CPython-API LIST decoder (read path); built against the builder's
+# python3 headers — the official python images ship them
+COPY --from=builder /src/native/libcrane_pylist.so native/libcrane_pylist.so
 ARG ENTRYPOINT_MODULE=crane_scheduler_tpu.cli.annotator_main
 ENV ENTRYPOINT_MODULE=${ENTRYPOINT_MODULE}
 ENTRYPOINT ["sh", "-c", "exec python -m ${ENTRYPOINT_MODULE} \"$@\"", "--"]

@@ -328,6 +328,179 @@ class _RawHTTPConnection:
             self._sock.close()
 
 
+class _WatchStream:
+    """Raw-socket streaming watch response with an incremental chunked
+    de-framer. ``HTTPResponse.read1`` returns at most ONE chunk per call
+    — and a watch line is one chunk on the real apiserver and the stub
+    alike — so draining a storm through it paid two syscalls plus
+    ~10us of http.client bookkeeping per EVENT (measured ~28k events/s
+    ceiling). Here one ``recv`` pulls up to 64KB of raw stream and the
+    de-framer hands back every payload byte it covers, so the syscall
+    and parse cost amortize over the whole buffered backlog."""
+
+    def __init__(self, host: str, port: int | None, path: str,
+                 timeout: float, token: str | None = None,
+                 context: ssl.SSLContext | None = None):
+        import socket as _socket
+
+        self._sock = _socket.create_connection(
+            (host, port or (443 if context is not None else 80)),
+            timeout=timeout,
+        )
+        self._sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        if context is not None:
+            self._sock = context.wrap_socket(self._sock, server_hostname=host)
+        host_hdr = f"{host}:{port}" if port else host
+        auth = f"Authorization: Bearer {token}\r\n" if token else ""
+        # Connection: close — a watch stream is one-shot (urllib sent
+        # the same); without it the server holds the drained socket
+        # open for a next request and stream end is never observable
+        self._sock.sendall(
+            (f"GET {path} HTTP/1.1\r\nHost: {host_hdr}\r\n"
+             f"Connection: close\r\n{auth}\r\n").encode("latin-1")
+        )
+        # response head: status line + headers (read through a small
+        # line reader over recv; the body stays in OUR buffer)
+        self._raw = bytearray()
+        self._eof = False
+        status_line = self._head_line()
+        try:
+            status = int(status_line.split(None, 2)[1])
+        except (IndexError, ValueError) as exc:
+            self.close()
+            raise http.client.HTTPException(
+                f"watch: malformed status line {status_line!r}"
+            ) from exc
+        self._chunked = False
+        while True:
+            h = self._head_line()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = h.partition(b":")
+            if k.strip().lower() == b"transfer-encoding" \
+                    and b"chunked" in v.strip().lower():
+                self._chunked = True
+        if status != 200:
+            body = bytes(self._raw[:4096])
+            self.close()
+            raise urllib.error.HTTPError(
+                path, status, body.decode("utf-8", "replace"), None, None
+            )
+        # de-chunker state
+        self._chunk_left = 0  # payload bytes pending in current chunk
+        self._skip = 0  # chunk-trailing CRLF bytes to discard
+        self._in_trailers = False
+
+    def _head_line(self) -> bytes:
+        """One CRLF-terminated head line (blocking; head only)."""
+        while True:
+            idx = self._raw.find(b"\n")
+            if idx >= 0:
+                line = bytes(self._raw[: idx + 1])
+                del self._raw[: idx + 1]
+                return line
+            d = self._sock.recv(1 << 16)
+            if not d:
+                return bytes(self._raw)
+            self._raw += d
+
+    def fileno(self) -> int:
+        return self._sock.fileno()
+
+    def _recv(self) -> bool:
+        d = self._sock.recv(1 << 16)
+        if not d:
+            self._eof = True
+            return False
+        self._raw += d
+        return True
+
+    def _dechunk(self) -> bytes:
+        """Consume as much of the raw buffer as the framing allows;
+        returns the payload bytes covered (may be empty)."""
+        raw = self._raw
+        if not self._chunked:
+            out = bytes(raw)
+            raw.clear()
+            return out
+        out = bytearray()
+        pos = 0
+        n = len(raw)
+        while pos < n:
+            if self._chunk_left:
+                take = min(self._chunk_left, n - pos)
+                out += raw[pos:pos + take]
+                pos += take
+                self._chunk_left -= take
+                if self._chunk_left:
+                    break
+                self._skip = 2
+            if self._skip:
+                take = min(self._skip, n - pos)
+                pos += take
+                self._skip -= take
+                if self._skip:
+                    break
+            if self._in_trailers:
+                # trailer lines until the blank line, then stream end
+                ended = False
+                while pos < n:
+                    idx = raw.find(b"\n", pos)
+                    if idx < 0:
+                        n = pos  # retain the partial trailer line
+                        break
+                    line = raw[pos:idx]
+                    pos = idx + 1
+                    if line in (b"", b"\r"):
+                        self._eof = True
+                        ended = True
+                        break
+                if ended or pos >= n:
+                    break
+                continue
+            idx = raw.find(b"\n", pos)
+            if idx < 0:
+                break  # partial chunk-size line: wait for more bytes
+            size_str = bytes(raw[pos:idx]).partition(b";")[0].strip()
+            pos = idx + 1
+            if not size_str:
+                continue
+            try:
+                size = int(size_str, 16)
+            except ValueError:
+                # mid-protocol garbage/EOF: end the stream cleanly,
+                # like read1's IncompleteRead classification
+                self._eof = True
+                break
+            if size == 0:
+                self._in_trailers = True
+            else:
+                self._chunk_left = size
+        del raw[:pos]
+        return bytes(out)
+
+    def read_some(self) -> bytes:
+        """De-chunked payload after at most the necessary blocking
+        ``recv``s (the socket timeout bounds each); b'' = stream end."""
+        while True:
+            out = self._dechunk()
+            if out:
+                return out
+            if self._eof:
+                return b""
+            if not self._recv():
+                return b""  # abrupt EOF: clean end (torn tail raises)
+
+    def has_buffered(self) -> bool:
+        return len(self._raw) > 0
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
 class _PooledWriter(threading.Thread):
     """One write worker: a FIFO queue drained over a single persistent
     keep-alive connection.
@@ -610,6 +783,9 @@ class KubeClusterClient:
         self._m_pipeline_stalls = None
         self._m_pipeline_indeterminate = None
         self._m_pipeline_inflight = None
+        self._m_list_decode_seconds = None
+        self._m_watch_batch_pods = None
+        self._m_watch_coalesced = None
         if self._telemetry is not None:
             reg = self._telemetry.registry
             self._m_flush_seconds = reg.histogram(
@@ -638,6 +814,21 @@ class KubeClusterClient:
                 "In-flight pipelined requests, by connection",
                 ("conn",),
             )
+            self._m_list_decode_seconds = reg.histogram(
+                "crane_kube_list_decode_seconds",
+                "Columnar LIST page decode latency", ("kind",),
+            )
+            self._m_watch_batch_pods = reg.histogram(
+                "crane_kube_watch_apply_batch_pods",
+                "Pod watch events applied per coalesced mirror "
+                "transaction",
+            )
+            self._m_watch_coalesced = reg.counter(
+                "crane_kube_watch_coalesced_total",
+                "Watch apply batches that coalesced more than one "
+                "buffered event into a single mirror transaction",
+                ("kind",),
+            )
         u = urlsplit(self.base_url)
         self._scheme = u.scheme
         self._host = u.hostname or "127.0.0.1"
@@ -662,6 +853,29 @@ class KubeClusterClient:
         self._threads: list[threading.Thread] = []
         self.watch_errors = 0
         self.relists = 0  # full LISTs triggered by watch (re)connects
+        # read-path counters (cheap attributes so benches without
+        # telemetry can still observe throughput and coalescing)
+        self.watch_applied = 0  # non-bookmark events applied
+        self.watch_batches = 0  # mirror transactions those rode in
+        self.watch_coalesced = 0  # batches carrying >1 event
+        # read-path knobs: _list_decode_disabled forces the round-6
+        # per-object LIST path, _coalesce_disabled applies drained watch
+        # events one transaction each (bench before/after comparisons;
+        # not supported production knobs). _watch_timeout is the idle
+        # watch read timeout (tests shrink it to exercise idle expiry).
+        self._list_decode_disabled = False
+        self._coalesce_disabled = False
+        self._watch_timeout = WATCH_TIMEOUT_SECONDS
+        # last relist's decoded node columns: (pages, mirror version,
+        # merged columns) — consumable by the store's columnar refresh
+        # while the mirror still holds exactly that state
+        self._node_columns_cache = None
+        # name -> resourceVersion as of the last relist: feeds the
+        # decoder's rv-based instance reuse (an unchanged rv means an
+        # unchanged object — the contract informers are built on).
+        # Maintained by the node-relist path; any other node write
+        # (watch apply, optimistic patch) invalidates its entries.
+        self._node_rvs: dict[str, str] = {}
         # reflector state: last-seen resourceVersion per resource (set by
         # lists, advanced by watch deliveries incl. bookmarks); None =
         # must relist before watching (client-go's reflector contract,
@@ -734,6 +948,10 @@ class KubeClusterClient:
         with self._request("GET", path) as resp:
             return json.loads(resp.read())
 
+    def _get_bytes(self, path: str) -> bytes:
+        with self._request("GET", path) as resp:
+            return resp.read()
+
     def _submit_write(
         self,
         key: str,
@@ -804,6 +1022,80 @@ class KubeClusterClient:
             if not token:
                 return items, rv
 
+    @staticmethod
+    def _peek_continue(body: bytes):
+        """The ``continue`` token from a page's HEAD, if trivially
+        extractable (the list metadata precedes ``items`` on every real
+        apiserver). Best-effort: None just means the prefetch waits for
+        the decode; a hit is verified against the decoded page before
+        its prefetch is used."""
+        head = body[: body.find(b'"items"') if b'"items"' in body[:4096]
+                    else 4096]
+        import re
+
+        m = re.search(rb'"continue"\s*:\s*"([^"\\]+)"', head)
+        return m.group(1).decode("latin-1") if m else None
+
+    def _list_pages(self, path: str, kind: int, known_rvs=None):
+        """Paginated LIST decoded straight to columns: the body of each
+        page goes through the streaming decoder (the CPython-API object
+        builder, the ctypes columnar scanner, or the Python twin —
+        ``native.listdecode``) instead of a monolithic ``json.loads``,
+        so a 50k-node bootstrap never materializes the per-object dict
+        trees it is about to throw away. The NEXT page prefetches on a
+        helper thread while the current one decodes (its continue token
+        rides the page head), overlapping wire time with decode time.
+        Returns the decoded page list plus the list's resourceVersion."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ..native.listdecode import decode_list_page
+
+        pages = []
+        sep = "&" if "?" in path else "?"
+        rv = None
+        m = self._m_list_decode_seconds
+        kind_label = "nodes" if kind == 0 else "pods"
+
+        def page_url(tok):
+            url = f"{path}{sep}limit={self._list_page_limit}"
+            if tok:
+                url += f"&continue={tok}"
+            return url
+
+        pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="list-prefetch"
+        )
+        try:
+            body = self._get_bytes(page_url(None))
+            while True:
+                peeked = self._peek_continue(body)
+                fut = (
+                    pool.submit(self._get_bytes, page_url(peeked))
+                    if peeked else None
+                )
+                t0 = time.perf_counter()
+                page = decode_list_page(body, kind, known_rvs=known_rvs)
+                if m is not None:
+                    m.labels(kind=kind_label).observe(
+                        time.perf_counter() - t0
+                    )
+                pages.append(page)
+                if page.rv is not None:
+                    rv = page.rv
+                token = page.cont
+                if not token:
+                    if fut is not None:
+                        fut.cancel()
+                    return pages, rv
+                if fut is not None and peeked == token:
+                    body = fut.result()
+                else:
+                    if fut is not None:
+                        fut.cancel()
+                    body = self._get_bytes(page_url(token))
+        finally:
+            pool.shutdown(wait=False)
+
     def _relist_nodes(self) -> None:
         """Resync nodes into the mirror (informer relist): adds/updates
         everything listed and prunes what disappeared, so deltas missed
@@ -811,30 +1103,125 @@ class KubeClusterClient:
         schedulable is the failure this prevents). Only the NODE watch
         thread calls this while ITS stream is down, so no concurrent
         node delivery can race the prune; other resources are never
-        touched from here."""
+        touched from here.
+
+        Since round 7 the pages stream through the columnar LIST
+        decoder and land as ONE mirror transaction
+        (``ClusterState.replace_nodes``: one lock, one version bump);
+        the decoded annotation columns stay cached for the batch
+        scheduler's columnar store refresh
+        (``node_annotation_columns``)."""
         self.relists += 1
-        raw, rv = self._list_all("/api/v1/nodes")
-        nodes = [node_from_json(i) for i in raw]
-        for node in nodes:
-            self._mirror.add_node(node)
-        live = {n.name for n in nodes}
-        for name in [n.name for n in self._mirror.list_nodes()]:
-            if name not in live:
-                self._mirror.delete_node(name)
+        if self._list_decode_disabled:
+            # round-6 comparison path: monolithic json.loads +
+            # per-object mirror apply
+            raw, rv = self._list_all("/api/v1/nodes")
+            nodes = [node_from_json(i) for i in raw]
+            for node in nodes:
+                self._mirror.add_node(node)
+            live = {n.name for n in nodes}
+            for name in [n.name for n in self._mirror.list_nodes()]:
+                if name not in live:
+                    self._mirror.delete_node(name)
+            self._node_columns_cache = None
+            self._node_rvs = {}
+            self._rvs["nodes"] = rv
+            return
+        known = self._node_rvs
+        pages, rv = self._list_pages(
+            "/api/v1/nodes", 0, known_rvs=known or None
+        )
+        new_rvs: dict[str, str] = {}
+        nodes = []
+        mirror_get = self._mirror.get_node
+        for page in pages:
+            objs = page.materialize()
+            page_rvs = getattr(page, "rvs", None)
+            for i, obj in enumerate(objs):
+                if isinstance(obj, str):
+                    # rv-reuse marker: the server's rv matched the last
+                    # relist's — keep the existing mirror instance
+                    node = mirror_get(obj)
+                    if node is None:
+                        # the mirror lost it since the map was built
+                        # (concurrent delete): rebuild from the span
+                        for row, a, b in page._reused:
+                            if row == i:
+                                node = node_from_json(
+                                    json.loads(page._body[a:b])
+                                )
+                                break
+                        if node is None:  # pragma: no cover - paranoia
+                            continue
+                    else:
+                        new_rvs[obj] = known[obj]
+                    objs[i] = node
+                    obj = node
+                elif page_rvs is not None and page_rvs[i] is not None:
+                    new_rvs[obj.name] = page_rvs[i]
+                nodes.append(obj)
+        self._mirror.replace_nodes(nodes)
+        self._node_rvs = new_rvs
+        # keyed on node_version (NOT sched_version): pod/event churn
+        # must not invalidate node columns that didn't change
+        self._node_columns_cache = (pages, self._mirror.node_version, None)
         self._rvs["nodes"] = rv
+
+    def node_annotation_columns(self):
+        """The last relist's decoded annotation columns — ``(version,
+        names, keys, values, offsets)`` with row ``i`` owning
+        ``keys[offsets[i]:offsets[i+1]]`` — valid only while the mirror
+        still holds exactly that state (any watch delivery or write
+        invalidates it). ``BatchScheduler.refresh`` consumes this to
+        feed ``NodeLoadStore.ingest_annotation_columns`` directly,
+        skipping the Node-object round-trip after a bootstrap/relist;
+        returns None whenever the mirror has moved on (callers fall
+        back to ``list_nodes``)."""
+        cache = self._node_columns_cache
+        if cache is None:
+            return None
+        pages, version, merged = cache
+        if version != self._mirror.node_version:
+            self._node_columns_cache = None
+            return None
+        if merged is None:
+            import numpy as _np
+
+            names: list[str] = []
+            keys: list = []
+            values: list = []
+            offset_parts = [_np.zeros(1, dtype=_np.int64)]
+            total = 0
+            for page in pages:
+                pn, pk, pv, po = page.node_annotation_columns()
+                names.extend(pn)
+                keys.extend(pk)
+                values.extend(pv)
+                offset_parts.append(po[1:] + total)
+                total += int(po[-1]) if len(po) > 1 else 0
+            offsets = _np.concatenate(offset_parts)
+            merged = (names, keys, values, offsets)
+            self._node_columns_cache = (pages, version, merged)
+        return (version,) + merged
 
     def _relist_pods(self) -> None:
         """Pod twin of ``_relist_nodes`` (called only by the pod watch
         thread while its own stream is down)."""
         self.relists += 1
-        raw, rv = self._list_all("/api/v1/pods")
-        pods = [pod_from_json(i) for i in raw]
-        for pod in pods:
-            self._mirror.add_pod(pod)
-        live = {p.key() for p in pods}
-        for key in [p.key() for p in self._mirror.list_pods()]:
-            if key not in live:
-                self._mirror.delete_pod(key)
+        if self._list_decode_disabled:
+            raw, rv = self._list_all("/api/v1/pods")
+            pods = [pod_from_json(i) for i in raw]
+            for pod in pods:
+                self._mirror.add_pod(pod)
+            live = {p.key() for p in pods}
+            for key in [p.key() for p in self._mirror.list_pods()]:
+                if key not in live:
+                    self._mirror.delete_pod(key)
+            self._rvs["pods"] = rv
+            return
+        pages, rv = self._list_pages("/api/v1/pods", 1)
+        pods = [p for page in pages for p in page.materialize()]
+        self._mirror.replace_pods(pods)
         self._rvs["pods"] = rv
 
     def _relist_events(self) -> None:
@@ -883,20 +1270,20 @@ class KubeClusterClient:
         watches = [
             (
                 "/api/v1/nodes?watch=1",
-                self._apply_node,
+                self._apply_node_batch,
                 self._relist_nodes,
                 "nodes",
             ),
             (
                 "/api/v1/pods?watch=1",
-                self._apply_pod,
+                self._apply_pod_batch,
                 self._relist_pods,
                 "pods",
             ),
             (
                 "/api/v1/events?watch=1&fieldSelector="
                 "reason%3DScheduled%2Ctype%3DNormal",
-                self._apply_event,
+                self._apply_event_batch,
                 self._relist_events,
                 "events",
             ),
@@ -921,7 +1308,7 @@ class KubeClusterClient:
             watches.append(
                 (
                     f"{NRT_API_PATH}?watch=1",
-                    self._apply_nrt,
+                    self._apply_nrt_batch,
                     self._relist_nrt,
                     "nrts",
                 )
@@ -956,7 +1343,7 @@ class KubeClusterClient:
             self._nrt_available = True
             self._watch_loop(
                 f"{NRT_API_PATH}?watch=1",
-                self._apply_nrt,
+                self._apply_nrt_batch,
                 self._relist_nrt,
                 "nrts",
             )
@@ -1261,10 +1648,167 @@ class KubeClusterClient:
                 out[status] = out.get(status, 0) + n
         return out
 
+    @staticmethod
+    def _reconnect_immediately(delivered: bool, failures: int,
+                               lived: float, idle_expired: bool) -> bool:
+        """Zero-delay reconnect policy: a healthy LONG-LIVED stream
+        (delivered something, incl. bookmarks, and stayed up a while)
+        reconnects immediately — an rv-resumed reconnect is cheap and
+        waiting delays the next delta. A stream that expired IDLE
+        (read timeout, nothing to say) is long-lived by construction —
+        it held the socket the whole watch timeout — so it reconnects
+        immediately too (it used to eat one backoff sleep, delaying the
+        next real event by up to 1s on a quiet cluster). Short-lived
+        streams back off exponentially even when they delivered (a
+        server answering each watch with one bookmark then EOF must not
+        drive a zero-delay reconnect hot loop), as does anything that
+        failed."""
+        return failures == 0 and (
+            idle_expired or (delivered and lived >= 1.0)
+        )
+
+    def _drain_lines(self, stream: "_WatchStream", tail: bytes):
+        """Read everything the stream already has — one blocking
+        ``read_some``, then keep pulling while the stream holds
+        undrained raw bytes or a zero-timeout ``select`` says more are
+        on the wire — and split out the complete lines. The drain never
+        waits for data that has not arrived, so a quiet stream applies
+        immediately and a storm's whole buffered backlog lands in one
+        batch. A line torn across chunks stays in ``tail`` until its
+        terminator arrives. Returns (complete_lines, tail, eof)."""
+        import select
+
+        chunk = stream.read_some()
+        if not chunk:
+            return [], tail, True
+        # chunks accumulate in a LIST and join once: += on bytes is
+        # quadratic, and a sustained storm feeds thousands of chunks
+        # into one drain
+        parts = [tail, chunk]
+        size = len(tail) + len(chunk)
+        fd = stream.fileno()
+        while size < (1 << 20):  # bound one transaction
+            if not stream.has_buffered():
+                try:
+                    if not select.select([fd], [], [], 0)[0]:
+                        break
+                except (OSError, ValueError):
+                    break
+            chunk = stream.read_some()
+            if not chunk:
+                break  # EOF: deliver the drained lines, report it next call
+            parts.append(chunk)
+            size += len(chunk)
+        buf = b"".join(parts)
+        if b"\n" not in buf:
+            return [], buf, False
+        *lines, tail = buf.split(b"\n")
+        return lines, tail, False
+
+    def _open_watch_stream(self, path: str) -> "_WatchStream":
+        context = None
+        if self._scheme == "https":
+            context = self._context
+            if context is None:
+                context = ssl.create_default_context()
+        return _WatchStream(
+            self._host, self._port, path, self._watch_timeout,
+            token=self._token, context=context,
+        )
+
+    def _consume_watch_r06(self, url: str, apply_batch, rv_key: str):
+        """The round-6 per-line stream loop, kept verbatim behind the
+        ``_coalesce_disabled`` comparison knob: urllib response
+        iteration, one apply transaction and rv update per line.
+        Returns (delivered, failed, stopped)."""
+        delivered = False
+        failed = False
+        with self._request("GET", url, timeout=self._watch_timeout) as resp:
+            for line in resp:
+                if self._stop.is_set():
+                    return delivered, failed, True
+                line = line.strip()
+                if not line:
+                    continue
+                change = json.loads(line)
+                change_type = change.get("type", "")
+                obj = change.get("object", {})
+                if change_type == "ERROR":
+                    if obj.get("code") == 410:
+                        self._rvs[rv_key] = None
+                    else:
+                        self.watch_errors += 1
+                        failed = True
+                    break
+                obj_rv = obj.get("metadata", {}).get("resourceVersion")
+                if change_type != "BOOKMARK":
+                    apply_batch([(change_type, obj)])
+                    self.watch_applied += 1
+                    self.watch_batches += 1
+                if obj_rv is not None:
+                    self._rvs[rv_key] = obj_rv
+                delivered = True
+        return delivered, failed, False
+
+    def _consume_watch(self, url: str, apply_batch, rv_key: str):
+        """Coalesced stream consumption (round 7): each wakeup drains
+        every line the socket already buffered and applies them as one
+        mirror transaction — one lock, one version bump, one batched
+        subscriber notify — in delivery order, so a watch storm costs
+        the mirror O(wakeups) transactions instead of O(events). rv
+        bookkeeping advances after the batch lands, exactly as far as
+        the batch did; an ERROR event splits the batch (everything
+        before it applies first), preserving the per-line 410/backoff
+        semantics. Returns (delivered, failed, stopped)."""
+        delivered = False
+        failed = False
+        stream = self._open_watch_stream(url)
+        try:
+            tail = b""
+            while True:
+                if self._stop.is_set():
+                    return delivered, failed, True
+                lines, tail, eof = self._drain_lines(stream, tail)
+                if eof:
+                    if tail.strip():
+                        # connection cut mid-line: surface the same
+                        # JSONDecodeError the per-line iterator hit on
+                        # a torn final line
+                        json.loads(tail)
+                    return delivered, failed, False
+                batch, last_rv, error_obj, n_seen, model = (
+                    self._parse_watch_lines(lines, rv_key)
+                )
+                if n_seen:
+                    delivered = True
+                if batch:
+                    if model:
+                        self._apply_model_batch(rv_key, batch)
+                    else:
+                        apply_batch(batch)
+                    self.watch_batches += 1
+                    self.watch_applied += len(batch)
+                    if len(batch) > 1:
+                        self.watch_coalesced += 1
+                        if self._m_watch_coalesced is not None:
+                            self._m_watch_coalesced.labels(kind=rv_key).inc()
+                if last_rv is not None:
+                    self._rvs[rv_key] = last_rv
+                if error_obj is not None:
+                    if error_obj.get("code") == 410:
+                        # resume window expired: relist once
+                        self._rvs[rv_key] = None
+                    else:
+                        self.watch_errors += 1
+                        failed = True
+                    return delivered, failed, False
+        finally:
+            stream.close()
+
     def _watch_loop(
         self,
         path: str,
-        apply: Callable[[str, dict], None],
+        apply_batch: Callable[[list], None],
         relist: Callable[[], None] | None,
         rv_key: str,
     ) -> None:
@@ -1272,13 +1816,17 @@ class KubeClusterClient:
         inherits from its informers — ref: factory.go:16-33): list once,
         then watch from the list's resourceVersion with bookmarks;
         reconnects resume from the last delivered rv (no relist); only a
-        410 Gone (resume point expired server-side) forces one relist."""
+        410 Gone (resume point expired server-side) forces one relist.
+        Stream consumption is COALESCED since round 7 (_consume_watch);
+        the round-6 per-line path survives behind _coalesce_disabled
+        for benchmark comparison."""
         import time as _time
 
         failures = 0
         delivered = False  # anything (incl. bookmarks) on the last stream
         while not self._stop.is_set():
             delivered = False
+            idle_expired = False
             connected_at = _time.monotonic()
             try:
                 if relist is not None and self._rvs.get(rv_key) is None:
@@ -1290,43 +1838,27 @@ class KubeClusterClient:
                 url = path + "&allowWatchBookmarks=true"
                 if rv is not None:
                     url += f"&resourceVersion={rv}"
-                with self._request(
-                    "GET", url, timeout=WATCH_TIMEOUT_SECONDS
-                ) as resp:
-                    for line in resp:
-                        if self._stop.is_set():
-                            return
-                        line = line.strip()
-                        if not line:
-                            continue
-                        change = json.loads(line)
-                        change_type = change.get("type", "")
-                        obj = change.get("object", {})
-                        if change_type == "ERROR":
-                            if obj.get("code") == 410:
-                                # resume window expired: relist once
-                                self._rvs[rv_key] = None
-                            else:
-                                self.watch_errors += 1
-                                failures += 1
-                            break
-                        obj_rv = obj.get("metadata", {}).get("resourceVersion")
-                        if change_type != "BOOKMARK":
-                            apply(change_type, obj)
-                        if obj_rv is not None:
-                            self._rvs[rv_key] = obj_rv
-                        delivered = True
-                        # reset only on DELIVERED events, not on mere
-                        # connection establishment: a flapping apiserver
-                        # that accepts watches then fails the stream must
-                        # still escalate the backoff
-                        failures = 0
+                consume = (
+                    self._consume_watch_r06 if self._coalesce_disabled
+                    else self._consume_watch
+                )
+                delivered, failed, stopped = consume(url, apply_batch, rv_key)
+                if stopped:
+                    return
+                if delivered:
+                    # reset only on DELIVERED events, not on mere
+                    # connection establishment: a flapping apiserver
+                    # that accepts watches then fails the stream must
+                    # still escalate the backoff
+                    failures = 0
+                if failed:
+                    failures += 1
             except TimeoutError:
                 # normal idle-watch expiry on a quiet cluster (the read
                 # blocked the whole watch timeout with nothing to say) —
-                # NOT a failure; escalating here would delay delivery of
-                # the next real event by up to the backoff cap
-                pass
+                # NOT a failure, and the stream was healthy: reconnect
+                # immediately (see _reconnect_immediately)
+                idle_expired = True
             except urllib.error.HTTPError as e:
                 if e.code == 410:
                     self._rvs[rv_key] = None  # relist on reconnect
@@ -1336,25 +1868,121 @@ class KubeClusterClient:
             except (urllib.error.URLError, OSError, json.JSONDecodeError):
                 self.watch_errors += 1
                 failures += 1
-            # a healthy LONG-LIVED stream (delivered something, incl.
-            # bookmarks, and stayed up a while) reconnects immediately —
-            # an rv-resumed reconnect is cheap and waiting here delays
-            # the next delta. Short-lived streams back off exponentially
-            # even when they delivered (a server answering each watch
-            # with one bookmark then EOF must not drive a zero-delay
-            # reconnect hot loop), as does anything that failed.
             lived = _time.monotonic() - connected_at
-            if delivered and failures == 0 and lived >= 1.0:
+            if self._reconnect_immediately(
+                delivered, failures, lived, idle_expired
+            ):
                 continue
             if self._stop.wait(timeout=min(30.0, 1.0 * (2 ** min(failures, 5)))):
                 return
 
+    _WATCH_KINDS = {"nodes": 0, "pods": 1}
+
+    def _parse_watch_lines(self, lines: list, rv_key: str):
+        """Parse one drained batch of watch lines. Node/pod streams
+        parse in ONE CPython-API call when the decoder is available
+        (``decode_watch_lines``: final model objects, no per-line
+        json.loads); everything else — events, NRTs, fallback lines,
+        no-decoder hosts — takes the per-line JSON path with identical
+        semantics. Returns ``(batch, last_rv, error_obj, n_seen,
+        model)`` where ``model=True`` means batch entries carry built
+        Node/Pod objects (apply via _apply_model_batch) and False means
+        raw dicts (apply via the kind's batch applier)."""
+        kind = self._WATCH_KINDS.get(rv_key)
+        if kind is not None:
+            from ..native.listdecode import decode_watch_lines
+
+            joined = b"\n".join(lines)
+            res = decode_watch_lines(joined, kind)
+            if res is not None:
+                from_json = node_from_json if kind == 0 else pod_from_json
+                types, objects, rvs, fallbacks = res
+                fb_spans = {row: (a, b) for row, a, b in fallbacks}
+                batch = []
+                last_rv = None
+                error_obj = None
+                n_seen = 0
+                for i, change_type in enumerate(types):
+                    n_seen += 1
+                    if i in fb_spans:
+                        a, b = fb_spans[i]
+                        change = json.loads(joined[a:b])
+                        change_type = change.get("type", "")
+                        obj = change.get("object", {})
+                        if change_type == "ERROR":
+                            error_obj = obj
+                            break
+                        if change_type != "BOOKMARK":
+                            batch.append((change_type, from_json(obj)))
+                        obj_rv = obj.get("metadata", {}).get(
+                            "resourceVersion"
+                        )
+                        if obj_rv is not None:
+                            last_rv = obj_rv
+                        continue
+                    if objects[i] is not None:
+                        batch.append((change_type, objects[i]))
+                    if rvs[i] is not None:
+                        last_rv = rvs[i]
+                return batch, last_rv, error_obj, n_seen, True
+        batch = []
+        last_rv = None
+        error_obj = None
+        n_seen = 0
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            n_seen += 1
+            change = json.loads(line)
+            change_type = change.get("type", "")
+            obj = change.get("object", {})
+            if change_type == "ERROR":
+                error_obj = obj
+                break
+            if change_type != "BOOKMARK":
+                batch.append((change_type, obj))
+            obj_rv = obj.get("metadata", {}).get("resourceVersion")
+            if obj_rv is not None:
+                last_rv = obj_rv
+        return batch, last_rv, error_obj, n_seen, False
+
+    def _apply_model_batch(self, rv_key: str, batch: list) -> None:
+        """Apply a batch of (change_type, Node/Pod) pairs — the models
+        are already built (decode_watch_lines) — as one transaction."""
+        if rv_key == "nodes":
+            self._invalidate_node_rvs(n.name for _, n in batch)
+            self._mirror.apply_node_changes(batch)
+        else:
+            if self._m_watch_batch_pods is not None:
+                self._m_watch_batch_pods.observe(len(batch))
+            self._mirror.apply_pod_changes(batch)
+
+    def _invalidate_node_rvs(self, names) -> None:
+        """Drop rv-reuse entries for nodes touched outside the relist
+        path: the next relist rebuilds them from the wire (GIL-atomic
+        per-name pops; conservative — a dropped entry only costs one
+        rebuild)."""
+        rvs = self._node_rvs
+        if rvs:
+            for name in names:
+                rvs.pop(name, None)
+
     def _apply_node(self, change_type: str, obj: dict) -> None:
         node = node_from_json(obj)
+        self._invalidate_node_rvs((node.name,))
         if change_type == "DELETED":
             self._mirror.delete_node(node.name)
         else:
             self._mirror.add_node(node)
+
+    def _apply_node_batch(self, changes: list) -> None:
+        """Coalesced node watch apply: the whole drained batch decodes
+        first, then lands as ONE mirror transaction
+        (``ClusterState.apply_node_changes``)."""
+        decoded = [(t, node_from_json(o)) for t, o in changes]
+        self._invalidate_node_rvs(n.name for _, n in decoded)
+        self._mirror.apply_node_changes(decoded)
 
     def _apply_pod(self, change_type: str, obj: dict) -> None:
         pod = pod_from_json(obj)
@@ -1363,12 +1991,23 @@ class KubeClusterClient:
         else:
             self._mirror.add_pod(pod)
 
+    def _apply_pod_batch(self, changes: list) -> None:
+        if self._m_watch_batch_pods is not None:
+            self._m_watch_batch_pods.observe(len(changes))
+        self._mirror.apply_pod_changes(
+            [(t, pod_from_json(o)) for t, o in changes]
+        )
+
     def _apply_nrt(self, change_type: str, obj: dict) -> None:
         nrt = nrt_from_json(obj)
         if change_type == "DELETED":
             self.nrt_lister.delete(nrt.name)
         else:
             self.nrt_lister.upsert(nrt)
+
+    def _apply_nrt_batch(self, changes: list) -> None:
+        for change_type, obj in changes:
+            self._apply_nrt(change_type, obj)
 
     def _mark_event_stream_restart(self) -> None:
         """A new events stream (watch (re)connect or relist) may replay
@@ -1378,8 +2017,28 @@ class KubeClusterClient:
             self._event_expect_replay = True
 
     def _apply_event(self, change_type: str, obj: dict) -> None:
+        event = self._dedup_event(change_type, obj)
+        if event is not None:
+            self._mirror.emit_event(event)
+
+    def _apply_event_batch(self, changes: list) -> None:
+        """Coalesced event apply: dedup each drained event in order (the
+        rv watermark advances exactly as the per-event path would), then
+        deliver the survivors as ONE batched emit — one mirror lock hold
+        and one batch-subscriber call for the whole backlog."""
+        deliver = []
+        for change_type, obj in changes:
+            event = self._dedup_event(change_type, obj)
+            if event is not None:
+                deliver.append(event)
+        if deliver:
+            self._mirror.emit_events(deliver)
+
+    def _dedup_event(self, change_type: str, obj: dict):
+        """Decode + dedup one watch event; returns the Event to deliver
+        or None (duplicate/replayed/DELETED)."""
         if change_type == "DELETED":
-            return
+            return None
         event = event_from_json(obj)
         # replayed backlogs (a no-rv connect or post-410 restart) must
         # not double-count. Primary dedup: the apiserver resourceVersion
@@ -1409,7 +2068,7 @@ class KubeClusterClient:
             if rv_int is not None and self._event_rv_trusted:
                 if rv_int <= self._event_rv_watermark:
                     if self._event_expect_replay:
-                        return  # replayed prefix after a (re)connect
+                        return None  # replayed prefix after a (re)connect
                     # rv went BACKWARD on a live stream: the server's
                     # integer rvs are not monotonic — never trust them
                     # again; this event falls through to content dedup
@@ -1426,9 +2085,9 @@ class KubeClusterClient:
             if not deliver:
                 # content-key path: rv-less, non-integer, or untrusted
                 if key in self._seen_events:
-                    return
+                    return None
                 self._record_seen_locked(key)
-        self._mirror.emit_event(event)
+        return event
 
     def _record_seen_locked(self, key: tuple) -> None:
         if key in self._seen_events:
@@ -1514,6 +2173,7 @@ class KubeClusterClient:
         # write already succeeded, so report True even if the object has
         # not reached the mirror yet (watch lag) — a False here would
         # make callers retry an already-applied write.
+        self._invalidate_node_rvs((name,))
         self._mirror.patch_node_annotation(name, key, value)
         return True
 
@@ -1599,6 +2259,7 @@ class KubeClusterClient:
                     # drop — the pool wouldn't retry it either
                     self._count_native_failure(int(status))
             if ok_updates:
+                self._invalidate_node_rvs(ok_updates)
                 self._mirror.patch_node_annotations_bulk(ok_updates)
                 patched += len(ok_updates)
             items = retry_items  # slow path owns retries/backoff
@@ -1618,6 +2279,7 @@ class KubeClusterClient:
             ))
         for name, kv, fut in futs:
             if fut.result():
+                self._invalidate_node_rvs((name,))
                 self._mirror.patch_node_annotations_bulk({name: kv})
                 patched += 1
         return patched

@@ -171,6 +171,11 @@ class ClusterState:
         self._rv_next = 1  # next event resourceVersion
         self._sched_version = 0
         self._node_set_version = 0
+        # bumps on NODE mutations only (membership or annotations) —
+        # pod binds / event emission leave it alone. The kube client's
+        # decoded-columns cache keys on this: a pod storm must not
+        # invalidate node annotation columns that didn't change.
+        self._node_version = 0
         # columnar pod bursts (see add_pod_burst)
         self._bursts: list[_PodBurst] = []
         self._burst_index: dict[str, tuple[_PodBurst, int]] | None = None
@@ -249,6 +254,15 @@ class ClusterState:
         with self._lock:
             return self._node_set_version
 
+    @property
+    def node_version(self) -> int:
+        """Bumps on any NODE change (membership, addresses, labels, or
+        annotations) and on nothing else — the narrowest version a
+        node-annotation consumer (the decoded-columns cache) can key
+        on without being defeated by pod/event churn."""
+        with self._lock:
+            return self._node_version
+
     # -- nodes -------------------------------------------------------------
 
     def _drop_overlay_locked(self, name: str) -> None:
@@ -318,6 +332,7 @@ class ClusterState:
             self._drop_overlay_locked(node.name)
             self._nodes[node.name] = node
             self._sched_version += 1
+            self._node_version += 1
             # annotation-only updates (e.g. a kube mirror echoing the
             # annotator's own patches as MODIFIED events) must not defeat
             # (name, ip) pair caches keyed on node_set_version
@@ -333,6 +348,7 @@ class ClusterState:
             self._nodes.pop(name, None)
             self._drop_overlay_locked(name)
             self._sched_version += 1
+            self._node_version += 1
             self._node_set_version += 1
 
     def get_node(self, name: str) -> Node | None:
@@ -361,6 +377,125 @@ class ClusterState:
         with self._lock:
             return list(self._nodes)
 
+    # -- bulk transactions (relist / coalesced watch apply) ----------------
+    #
+    # The kube mirror's read path lands whole relists and drained watch
+    # batches here as ONE transaction each: one lock hold and a single
+    # sched_version bump per batch (the per-item primitives bump once per
+    # object — at a 50k-node relist that is 50k lock round-trips and 50k
+    # cache invalidations for what is semantically one state change).
+    # node_set_version/pod_version keep their per-item semantics: the
+    # first one tracks membership/address identity (bumped once per batch
+    # when any changed), the second journals per-node changes for the
+    # incremental NUMA path, which needs every entry.
+
+    def _apply_node_change_locked(self, change_type: str, node: Node) -> bool:
+        """One watch-shaped node change (caller holds the lock). Returns
+        True when the node SET (membership/addresses) changed."""
+        name = node.name
+        if change_type == "DELETED":
+            if name in self._nodes:
+                self._note_pod_change_locked(name)
+            self._nodes.pop(name, None)
+            self._drop_overlay_locked(name)
+            self._sched_version += 1
+            return True
+        prev = self._nodes.get(name)
+        self._drop_overlay_locked(name)
+        self._nodes[name] = node
+        self._sched_version += 1
+        if prev is None:
+            self._note_pod_change_locked(name)
+        return prev is None or prev.addresses != node.addresses
+
+    def apply_node_changes(self, changes) -> None:
+        """Coalesced watch apply: an ordered batch of ``(change_type,
+        Node)`` pairs (DELETED removes, anything else add/replaces) as
+        one transaction — one lock hold, one sched_version bump."""
+        with self._lock:
+            v0 = self._sched_version
+            set_changed = False
+            for change_type, node in changes:
+                if self._apply_node_change_locked(change_type, node):
+                    set_changed = True
+            if self._sched_version > v0:
+                self._sched_version = v0 + 1
+                self._node_version += 1
+            if set_changed:
+                self._node_set_version += 1
+
+    def apply_pod_changes(self, changes) -> None:
+        """Pod twin of ``apply_node_changes`` (same event order and
+        per-pod semantics as add_pod/delete_pod, one transaction)."""
+        with self._lock:
+            v0 = self._sched_version
+            for change_type, pod in changes:
+                if change_type == "DELETED":
+                    self._delete_pod_locked(pod.key())
+                else:
+                    self._add_pod_locked(pod)
+            if self._sched_version > v0:
+                self._sched_version = v0 + 1
+
+    def replace_nodes(self, nodes) -> None:
+        """Relist apply: every listed node is added/updated and nodes
+        absent from the list are pruned, as ONE transaction with a
+        single sched_version bump (a relist is semantically one
+        snapshot, however many rows it carries). Bulk-shaped: the new
+        node table is built directly (a duplicate listing keeps the
+        last entry, like sequential adds) instead of 50k per-name
+        mutations — the per-item loop was a fifth of a 50k relist."""
+        nodes = list(nodes)
+        with self._lock:
+            current = self._nodes
+            new = {node.name: node for node in nodes}
+            added = [name for name in new if name not in current]
+            deleted = []
+            if len(current) - (len(new) - len(added)):
+                deleted = [name for name in current if name not in new]
+            set_changed = bool(added or deleted)
+            if not set_changed:
+                # same membership: addresses are the remaining way the
+                # node SET can have changed (annotation churn must not
+                # defeat (name, ip) caches keyed on node_set_version)
+                get = current.get
+                for name, node in new.items():
+                    if get(name).addresses != node.addresses:
+                        set_changed = True
+                        break
+            for name in added:
+                self._note_pod_change_locked(name)
+            for name in deleted:
+                self._note_pod_change_locked(name)
+            # every listed name is replaced and the rest are pruned, so
+            # clearing the overlay IS the per-name tombstone sweep
+            self._anno_segments.clear()
+            self._nodes = new
+            self._sched_version += 1
+            self._node_version += 1
+            if set_changed:
+                self._node_set_version += 1
+
+    def replace_pods(self, pods) -> None:
+        """Pod twin of ``replace_nodes`` (burst rows the server no
+        longer lists are retired too, like delete_pod would)."""
+        pods = list(pods)
+        with self._lock:
+            v0 = self._sched_version
+            for pod in pods:
+                self._add_pod_locked(pod)
+            live = {p.key() for p in pods}
+            stale = [k for k in self._pods if k not in live]
+            if self._bursts:
+                stale += [
+                    p.key() for p in self._burst_pods_locked(None)
+                    if p.key() not in live
+                ]
+            for key in stale:
+                self._delete_pod_locked(key)
+            if self._sched_version > v0:
+                self._sched_version = v0 + 1
+
     def patch_node_annotation(self, name: str, key: str, value: str) -> bool:
         """The controller's write primitive (ref: node.go:123-146)."""
         with self._lock:
@@ -372,6 +507,7 @@ class ClusterState:
             self._drop_overlay_locked(name)
             self._nodes[name] = replace(node, annotations=anno)
             self._sched_version += 1
+            self._node_version += 1
             return True
 
     def patch_node_annotations_bulk(self, per_node: Mapping[str, Mapping[str, str]]) -> int:
@@ -402,6 +538,8 @@ class ClusterState:
                 nodes[name] = new_node
                 self._sched_version += 1
                 patched += 1
+            if patched:
+                self._node_version += 1
         return patched
 
     def patch_node_annotations_columns(
@@ -430,6 +568,7 @@ class ClusterState:
                     # cost by materializing everything once
                     self._fold_overlay_locked()
             self._sched_version += len(names)
+            self._node_version += 1
         return len(names)
 
     def patch_node_annotation_groups(self, groups) -> int:
@@ -524,23 +663,26 @@ class ClusterState:
 
     def delete_pod(self, key: str) -> None:
         with self._lock:
-            pod = self._pods.pop(key, None)
-            if pod is None and self._bursts:
-                hit = self._burst_lookup_locked(key)
-                if hit is not None:
-                    burst, row = hit
-                    pod = burst.materialize(row)
-                    self._burst_retire_row_locked(burst, row)
-                    if self._burst_index is not None:
-                        self._burst_index.pop(key, None)
-                    if pod.node_name:
-                        self._sched_version += 1
-                    return
-            if pod is not None:
-                self._index_remove(pod)
-            if pod is not None and pod.node_name:
-                self._sched_version += 1
-                self._note_pod_change_locked(pod.node_name)
+            self._delete_pod_locked(key)
+
+    def _delete_pod_locked(self, key: str) -> None:
+        pod = self._pods.pop(key, None)
+        if pod is None and self._bursts:
+            hit = self._burst_lookup_locked(key)
+            if hit is not None:
+                burst, row = hit
+                pod = burst.materialize(row)
+                self._burst_retire_row_locked(burst, row)
+                if self._burst_index is not None:
+                    self._burst_index.pop(key, None)
+                if pod.node_name:
+                    self._sched_version += 1
+                return
+        if pod is not None:
+            self._index_remove(pod)
+        if pod is not None and pod.node_name:
+            self._sched_version += 1
+            self._note_pod_change_locked(pod.node_name)
 
     def get_pod(self, key: str) -> Pod | None:
         with self._lock:
@@ -1021,6 +1163,25 @@ class ClusterState:
         single = [event]
         for handler in batch_handlers:
             handler(single)
+
+    def emit_events(self, events) -> None:
+        """Batched emit: stamp + record every event under ONE lock hold,
+        then deliver — per-event handlers in order, batch handlers once
+        with the whole list (a ``bind_pods``-shaped delivery). The kube
+        mirror's coalesced event watch lands a drained backlog here as
+        one transaction instead of |events| lock round-trips."""
+        events = list(events)
+        if not events:
+            return
+        with self._lock:
+            stamped = [self._record_event_locked(e) for e in events]
+            handlers = list(self._event_handlers)
+            batch_handlers = list(self._batch_handlers)
+        for event in stamped:
+            for handler in handlers:
+                handler(event)
+        for handler in batch_handlers:
+            handler(stamped)
 
     def get_event(self, key: str) -> Event | None:
         with self._lock:

@@ -712,6 +712,8 @@ class BatchScheduler:
             "risk_rescan_rows": 0,  # rows the hybrid f64 risk scan touched
             "overlap_hits": 0,  # pipelined cycles served without blocking
             # on an in-flight background refresh (overlap_refresh mode)
+            "columnar_ingest": 0,  # refreshes served straight from the
+            # kube mirror's decoded LIST columns (no Node objects)
         }
         if self._telemetry is not None:
             # fold refresh_stats into the registry: the dict stays the
@@ -740,10 +742,18 @@ class BatchScheduler:
                 "Pipelined cycles served without blocking on an "
                 "in-flight background refresh",
             )
+            counters["columnar_ingest"] = reg.counter(
+                "crane_refresh_columnar_ingest_total",
+                "Store refreshes served straight from decoded LIST "
+                "columns (no Node-object round-trip)",
+            )
             self.refresh_stats = _MirroredStats(stats_init, counters)
         else:
             self.refresh_stats = stats_init
         self._last_refresh_wall = 0.0  # decision-trace staleness anchor
+        # last decoded-columns version ingested (refresh()'s columnar
+        # fast path): matching version == nothing changed == skip
+        self._columns_consumed = None
         # device-resident snapshot cache: (store version, padded N) it was
         # built from; an unchanged store re-dispatches with zero uploads
         self._prepared = None
@@ -758,14 +768,36 @@ class BatchScheduler:
 
     def refresh(self) -> None:
         """Bulk re-ingest node annotations (the store is a cache). A
-        direct-mode shared store skips this — the annotator owns it."""
+        direct-mode shared store skips this — the annotator owns it.
+
+        When the cluster is a kube mirror fresh off a relist, its
+        decoded LIST columns feed the store directly
+        (``ingest_annotation_columns``) — no Node objects, no per-node
+        dict iteration; the columns carry a version so an unchanged
+        mirror costs nothing. Any mirror change since the relist
+        invalidates them and the object path below takes over."""
         if not self._refresh_from_cluster:
             return
         t0 = time.perf_counter()
         with maybe_span(self._telemetry, "ingest"):
-            nodes = self.cluster.list_nodes()
-            self.store.bulk_ingest((n.name, n.annotations) for n in nodes)
-            self.store.prune_absent(n.name for n in nodes)
+            cols_fn = getattr(self.cluster, "node_annotation_columns", None)
+            cols = cols_fn() if cols_fn is not None else None
+            if cols is not None:
+                version, names, keys, values, offsets = cols
+                if version != self._columns_consumed:
+                    self.store.ingest_annotation_columns(
+                        names, keys, values, offsets
+                    )
+                    self.store.prune_absent(names)
+                    self._columns_consumed = version
+                    self.refresh_stats["columnar_ingest"] += 1
+            else:
+                self._columns_consumed = None
+                nodes = self.cluster.list_nodes()
+                self.store.bulk_ingest(
+                    (n.name, n.annotations) for n in nodes
+                )
+                self.store.prune_absent(n.name for n in nodes)
         self.refresh_stats["ingest_ms"] += (time.perf_counter() - t0) * 1e3
         self._last_refresh_wall = self._clock()
 

@@ -442,8 +442,6 @@ class NodeLoadStore:
         reset pass) — the per-node ``add_node`` + four row writes were
         a third of the 50k-node cold refresh.
         """
-        from ..native.codec import bulk_parse_annotations
-
         index = self._index
         last = self._last_anno
         metric_get = self.tensors.metric_index.get
@@ -484,8 +482,17 @@ class NodeLoadStore:
                         rapp(raw)
                         iapp(i)
                         capp(col)
+        self._finish_ingest_locked(touched, raws, rows, cols, added)
+
+    def _finish_ingest_locked(self, touched, raws, rows, cols,
+                              added: bool) -> None:
+        """Shared tail of the bulk ingest paths: batched version/layout
+        bookkeeping, one fancy-indexed row reset, one batch parse call,
+        scattered metric/hot writes (callers hold the lock)."""
         if not touched:
             return
+        from ..native.codec import bulk_parse_annotations
+
         self._version += 1
         if added:
             self._layout_version += 1
@@ -506,6 +513,56 @@ class NodeLoadStore:
         hot_mask = ~metric_mask
         self.hot_value[rows_arr[hot_mask]] = values[hot_mask]
         self.hot_ts[rows_arr[hot_mask]] = ts[hot_mask]
+
+    @_locked
+    def ingest_annotation_columns(self, names, keys, values, offsets) -> None:
+        """Columnar twin of ``bulk_ingest``: per-node annotation maps
+        arrive as flat aligned key/value columns — row ``i`` owns
+        ``keys[offsets[i]:offsets[i+1]]``, the LIST decoder's output
+        shape (``DecodedPage.node_annotation_columns``) — so a
+        relist-sized refresh reaches the matrices without building one
+        per-node dict or Node object. Each row is authoritative for its
+        node, exactly like ``ingest_node_annotations``. There is no
+        identity skip (there are no map objects to compare): callers
+        gate on the cluster version instead, as
+        ``BatchScheduler.refresh`` does."""
+        index = self._index
+        metric_get = self.tensors.metric_index.get
+        raws: list = []
+        rows: list[int] = []
+        cols: list[int] = []  # -1 == hot value
+        rapp, iapp, capp = raws.append, rows.append, cols.append
+        touched: list[int] = []
+        tapp = touched.append
+        added = False
+        off = offsets.tolist() if hasattr(offsets, "tolist") else list(offsets)
+        last = self._last_anno
+        for j, name in enumerate(names):
+            i = index.get(name)
+            if i is None:
+                if self._n == self._cap:
+                    self._grow(self._cap * 2)
+                i = self._n
+                self._n += 1
+                self._names.append(name)
+                index[name] = i
+                added = True
+            else:
+                last.pop(name, None)
+            tapp(i)
+            for k in range(off[j], off[j + 1]):
+                key = keys[k]
+                if key == NODE_HOT_VALUE_KEY:
+                    rapp(values[k])
+                    iapp(i)
+                    capp(-1)
+                else:
+                    col = metric_get(key)
+                    if col is not None:
+                        rapp(values[k])
+                        iapp(i)
+                        capp(col)
+        self._finish_ingest_locked(touched, raws, rows, cols, added)
 
     # -- snapshot ----------------------------------------------------------
 

@@ -73,6 +73,17 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.crane_http_flush_pipelined.restype = i64
     except AttributeError:
         pass
+    try:
+        # streaming LIST decode (round 7)
+        lib.crane_list_decode.argtypes = [
+            ctypes.c_char_p, i64, i32,
+            ctypes.c_char_p, i64, p_i64, p_i64, i64,
+            p_i64, p_i64, ctypes.POINTER(ctypes.c_uint8), p_i64, i64,
+            p_i64,
+        ]
+        lib.crane_list_decode.restype = i64
+    except AttributeError:
+        pass
     return lib
 
 
@@ -129,3 +140,49 @@ def load_native():
 
 def native_available() -> bool:
     return load_native() is not None
+
+
+_PYLIST_PATH = os.path.join(_NATIVE_DIR, "libcrane_pylist.so")
+_pylist = None
+_pylist_attempted = False
+
+
+def load_pylist():
+    """The CPython-API LIST decoder (``libcrane_pylist.so``), or None
+    when unavailable. Loaded with ``ctypes.PyDLL`` — calls run WITH the
+    GIL held, which the decoder requires (it builds Python objects).
+    A separate artifact from libcrane_native.so: hosts without Python
+    headers still build the core library, and the read path degrades to
+    the ctypes columnar decoder / pure-Python twin."""
+    global _pylist, _pylist_attempted
+    with _lock:
+        if _pylist is not None:
+            return _pylist
+        if _pylist_attempted:
+            return None
+        _pylist_attempted = True
+        if not os.path.exists(_PYLIST_PATH):
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except (OSError, subprocess.SubprocessError):
+                return None
+            if not os.path.exists(_PYLIST_PATH):
+                return None  # no Python headers on this host
+        try:
+            lib = ctypes.PyDLL(_PYLIST_PATH)
+            pyo = ctypes.py_object
+            sig = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
+                   pyo, pyo, pyo, pyo]
+            lib.crane_pylist_decode.argtypes = sig + [pyo]  # + known_rvs
+            lib.crane_pylist_decode.restype = pyo
+            lib.crane_pylist_decode_watch.argtypes = sig
+            lib.crane_pylist_decode_watch.restype = pyo
+        except (OSError, AttributeError):
+            return None
+        _pylist = lib
+        return lib

@@ -865,3 +865,231 @@ int64_t crane_http_flush_pipelined(const char* ip, int32_t port,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Streaming LIST decode
+// ---------------------------------------------------------------------------
+//
+// The read-side twin of the flush engines above: a kube LIST page is a
+// JSON object whose "items" array carries thousands of node/pod objects,
+// and the client only ever reads a handful of fields from each
+// (node_from_json / pod_from_json in cluster/kube.py). json.loads
+// materializes the full tree — metadata.managedFields and all — as
+// Python dicts, which at 50k nodes is seconds of allocator work per
+// relist. This scanner walks the page bytes ONCE and copies just the
+// consumed fields (unescaped) into columnar string arrays; everything
+// else is skipped structurally without allocation.
+//
+// Exactness contract: an item whose consumed fields are all plain
+// strings (the only shape a real apiserver emits) decodes on the fast
+// path, byte-identical to json.loads' strings (full escape handling,
+// surrogate pairs included). Any item outside that shape — a non-string
+// annotation value, a lone surrogate escape, duplicate metadata keys,
+// containers on a pod — gets flag bit 0 set and emits NO strings; the
+// caller re-decodes that item's byte span (item_start/item_end) with the
+// ordinary per-object path, so the combined result is bit-identical to
+// node_from_json/pod_from_json on EVERY input. Malformed JSON or
+// exhausted output capacity returns -1 and the caller falls back
+// wholesale.
+
+#include "listscan.h"
+
+extern "C" {
+
+// Decode one LIST page. kind: 0 = nodes, 1 = pods. Outputs:
+//   str_buf/str_start/str_end — extracted strings (unescaped UTF-8
+//     bytes; spans index str_buf). Entry 0 is the list's
+//     metadata.resourceVersion, entry 1 its metadata.continue (empty
+//     spans when absent). Then, per fast-path item, in canonical order:
+//       nodes: name, anno k/v pairs, label k/v pairs,
+//              address type/address pairs
+//       pods:  name, namespace, nodeName, anno k/v pairs,
+//              ownerReference kind/name pairs
+//     (a pod namespace span of (-1,-1) means "absent": the caller
+//     substitutes the "default" literal). Fallback items emit nothing.
+//   item_start/item_end — each item's byte span in `buf` (fallback
+//     items re-decode from it).
+//   item_flags — bit 0: fallback (emit nothing; re-decode the span).
+//   pair_counts — per item: nodes 3 entries (anno, label, address pair
+//     counts), pods 2 entries (anno, ownerReference pair counts).
+//   n_str_out — total string entries emitted (incl. the 2 meta slots).
+// Returns the item count, or -1 on malformed JSON / exhausted output
+// capacity (caller decodes the page with the ordinary JSON parser).
+int64_t crane_list_decode(const char* buf, int64_t len, int32_t kind,
+                          char* str_buf, int64_t str_buf_cap,
+                          int64_t* str_start, int64_t* str_end,
+                          int64_t str_cap, int64_t* item_start,
+                          int64_t* item_end, uint8_t* item_flags,
+                          int64_t* pair_counts, int64_t item_cap,
+                          int64_t* n_str_out) {
+  using namespace listdec;
+  Ctx c;
+  c.base = buf;
+  c.p = buf;
+  c.e = buf + len;
+  c.sb = str_buf;
+  c.sb_pos = 0;
+  c.sb_cap = str_buf_cap;
+  c.s_start = str_start;
+  c.s_end = str_end;
+  c.s_cap = str_cap;
+  c.s_n = 0;
+  c.malformed = false;
+  if (c.s_cap < 2) return -1;
+  // slots 0/1: list resourceVersion + continue (filled when metadata
+  // is seen; the apiserver puts it first, but order is not assumed)
+  c.s_start[0] = c.s_end[0] = 0;
+  c.s_start[1] = c.s_end[1] = 0;
+  c.s_n = 2;
+
+  int64_t n_items = 0;
+  ItemOut item;
+  ws(c);
+  if (c.p >= c.e || *c.p != '{') return -1;
+  ++c.p;
+  ws(c);
+  bool done = c.p < c.e && *c.p == '}';
+  if (done) ++c.p;
+  while (!done) {
+    ws(c);
+    Span k;
+    bool clean = true;
+    if (!parse_string(c, &k, &clean)) return -1;
+    ws(c);
+    if (c.p >= c.e || *c.p != ':') return -1;
+    ++c.p;
+    if (key_eq(c, k, "metadata")) {
+      ws(c);
+      if (c.p >= c.e || *c.p != '{') {
+        if (!skip_value(c, 0)) return -1;
+      } else {
+        ++c.p;
+        ws(c);
+        if (c.p < c.e && *c.p == '}') {
+          ++c.p;
+        } else {
+          while (true) {
+            ws(c);
+            Span mk;
+            if (!parse_string(c, &mk, &clean)) return -1;
+            ws(c);
+            if (c.p >= c.e || *c.p != ':') return -1;
+            ++c.p;
+            ws(c);
+            const bool is_rv = key_eq(c, mk, "resourceVersion");
+            const bool is_cont = key_eq(c, mk, "continue");
+            if ((is_rv || is_cont) && c.p < c.e && *c.p == '"') {
+              Span v;
+              if (!parse_string(c, &v, &clean)) return -1;
+              const int slot = is_rv ? 0 : 1;
+              c.s_start[slot] = v.a;
+              c.s_end[slot] = v.b;
+            } else if ((is_rv || is_cont) && is_null_ahead(c)) {
+              c.p += 4;  // null continue/rv: same as absent
+            } else if (is_rv || is_cont) {
+              return -1;  // non-string list metadata: wholesale fallback
+            } else {
+              if (!skip_value(c, 0)) return -1;
+            }
+            ws(c);
+            if (c.p < c.e && *c.p == ',') {
+              ++c.p;
+              continue;
+            }
+            if (c.p < c.e && *c.p == '}') {
+              ++c.p;
+              break;
+            }
+            return -1;
+          }
+        }
+      }
+    } else if (key_eq(c, k, "items")) {
+      ws(c);
+      if (is_null_ahead(c)) {
+        c.p += 4;  // "items": null => no items (the .get(..., []) path)
+      } else {
+        if (c.p >= c.e || *c.p != '[') return -1;
+        ++c.p;
+        ws(c);
+        if (c.p < c.e && *c.p == ']') {
+          ++c.p;
+        } else {
+          while (true) {
+            ws(c);
+            if (n_items >= item_cap) return -1;
+            const int64_t span_a = c.p - c.base;
+            const int64_t sb_keep = c.sb_pos;
+            item.reset();
+            if (!parse_item(c, kind, &item)) return -1;
+            item_start[n_items] = span_a;
+            item_end[n_items] = c.p - c.base;
+            const int64_t pc_base =
+                n_items * (kind == 0 ? 3 : 2);
+            if (item.fb) {
+              c.sb_pos = sb_keep;  // reclaim this item's string bytes
+              item_flags[n_items] = 1;
+              pair_counts[pc_base] = 0;
+              pair_counts[pc_base + 1] = 0;
+              if (kind == 0) pair_counts[pc_base + 2] = 0;
+            } else {
+              item_flags[n_items] = 0;
+              if (!emit(c, item.name)) return -1;
+              if (kind == 1) {
+                if (!emit(c, item.ns)) return -1;
+                if (!emit(c, item.node_name)) return -1;
+              }
+              for (const Span& s : item.annos)
+                if (!emit(c, s)) return -1;
+              if (kind == 0) {
+                for (const Span& s : item.labels)
+                  if (!emit(c, s)) return -1;
+              }
+              for (const Span& s : item.addrs)
+                if (!emit(c, s)) return -1;
+              pair_counts[pc_base] =
+                  static_cast<int64_t>(item.annos.size()) / 2;
+              if (kind == 0) {
+                pair_counts[pc_base + 1] =
+                    static_cast<int64_t>(item.labels.size()) / 2;
+                pair_counts[pc_base + 2] =
+                    static_cast<int64_t>(item.addrs.size()) / 2;
+              } else {
+                pair_counts[pc_base + 1] =
+                    static_cast<int64_t>(item.addrs.size()) / 2;
+              }
+            }
+            ++n_items;
+            ws(c);
+            if (c.p < c.e && *c.p == ',') {
+              ++c.p;
+              continue;
+            }
+            if (c.p < c.e && *c.p == ']') {
+              ++c.p;
+              break;
+            }
+            return -1;
+          }
+        }
+      }
+    } else {
+      if (!skip_value(c, 0)) return -1;
+    }
+    ws(c);
+    if (c.p < c.e && *c.p == ',') {
+      ++c.p;
+      continue;
+    }
+    if (c.p < c.e && *c.p == '}') {
+      ++c.p;
+      break;
+    }
+    return -1;
+  }
+  if (c.malformed) return -1;
+  *n_str_out = c.s_n;
+  return n_items;
+}
+
+}  // extern "C"

@@ -54,9 +54,14 @@ class KubeStubState:
             maxlen=self.HISTORY_CAP
         )
         self._evicted_rv = 0
-        # pagination tokens -> (remaining items, snapshot rv)
-        self._continues: dict[str, tuple[list[dict], str]] = {}
+        # pagination tokens -> (remaining item-JSON strings, snapshot rv)
+        self._continues: dict[str, tuple[list[str], str]] = {}
         self._continue_seq = 0
+        # per-kind rendered LIST cache: (rv, [item json, ...]) — the
+        # real apiserver serves lists out of its watch cache without
+        # re-encoding per request; re-dumping 50k nodes per page made
+        # the STUB the measured cost in read-path benches
+        self._list_render_cache: dict[str, tuple[str, list[str]]] = {}
         # injected write faults, served FIFO: each entry is
         # (status, payload_dict, extra_headers) answered to the next
         # PATCH/POST (non-control) request INSTEAD of normal handling
@@ -65,6 +70,78 @@ class KubeStubState:
         # the POST-safety oracle — a pod with >1 processed bind was
         # double-POSTed, which the pipelined write path must never do
         self.bind_posts: dict[str, int] = {}
+        # -- read-side fault injection (round 7, mirroring the write
+        # faults above) --
+        # torn_watch_writes: every watch line is split MID-LINE across
+        # two chunked writes with a flush between — the client's drain
+        # must reassemble it from its tail buffer
+        self.torn_watch_writes = False
+        # idle bookmark cadence (default matches the old hardcoded 30s);
+        # shrink it to produce bookmark-only streams in test time
+        self.watch_bookmark_interval = 30.0
+        # kind -> events remaining before the NEXT watch stream of that
+        # kind injects an ERROR 410 mid-stream at that exact offset
+        # (one-shot; set via inject_watch_410_after)
+        self.watch_410_after: dict[str, int] = {}
+
+    def inject_watch_410_after(self, kind: str, n_events: int) -> None:
+        """The next watch stream on ``kind`` delivers exactly
+        ``n_events`` (non-bookmark) events, then an ERROR 410 frame and
+        EOF — the resume-window-expired failure landing mid-stream at a
+        chosen offset instead of at connect time."""
+        with self.lock:
+            self.watch_410_after[kind] = int(n_events)
+
+    def storm_nodes(self, count: int, key: str = "crane.io/storm") -> None:
+        """Watch-storm generator: ``count`` MODIFIED node events
+        (annotation bumps over the existing node set) through the normal
+        notify path — the read-side twin of a patch storm. Serialization
+        is template-rendered (one json.dumps per node, then two string
+        substitutions per event): the generator must outrun the CLIENT
+        under measurement, not be the thing measured."""
+        with self.lock:
+            names = list(self.nodes)
+        if not names:
+            return
+        templates: dict[str, str] = {}
+        V, R = "@@STORM_VALUE@@", "@@STORM_RV@@"
+        # chunked lock holds: per-event acquire/release throttled the
+        # generator below the client rates it exists to measure
+        for base in range(0, count, 256):
+            with self.lock:
+                for i in range(base, min(base + 256, count)):
+                    name = names[i % len(names)]
+                    node = self.nodes[name]
+                    anno = node["metadata"].setdefault("annotations", {})
+                    tpl = templates.get(name)
+                    if tpl is None:
+                        # render once with sentinels; only the storm
+                        # value and rv change between this node's events
+                        anno[key] = V
+                        node["metadata"]["resourceVersion"] = R
+                        tpl = templates[name] = json.dumps(node)
+                    anno[key] = str(i)
+                    self._stamp(node)
+                    data = tpl.replace(V, str(i)).replace(
+                        R, node["metadata"]["resourceVersion"]
+                    )
+                    self._notify("nodes", "MODIFIED", node, data=data)
+
+    def storm_events(self, count: int, namespace: str = "storm") -> None:
+        """Scheduled-event storm (the annotator's ingest feed)."""
+        for i in range(count):
+            self.emit_event({
+                "metadata": {
+                    "namespace": namespace,
+                    "name": f"storm-{i}.scheduled",
+                },
+                "type": "Normal",
+                "reason": "Scheduled",
+                "message": f"Successfully assigned {namespace}/storm-{i} "
+                           f"to node-{i:05d}",
+                "count": 1,
+                "lastTimestamp": "2026-07-30T00:00:00Z",
+            })
 
     def inject_write_faults(self, *faults):
         """Queue canned failure responses for upcoming write requests.
@@ -99,6 +176,16 @@ class KubeStubState:
     def resource_version(self) -> int:
         with self.lock:
             return self._rv
+
+    def rendered_list(self, kind: str, items) -> tuple[list[str], str]:
+        """Per-item JSON for a consistent LIST at the current rv,
+        cached until the next mutation (callers hold the lock)."""
+        rv = str(self._rv)
+        cached = self._list_render_cache.get(kind)
+        if cached is None or cached[0] != rv:
+            cached = (rv, [json.dumps(i) for i in items])
+            self._list_render_cache[kind] = cached
+        return cached[1], rv
 
     def add_node(self, name: str, ip: str, annotations: dict | None = None):
         with self.lock:
@@ -162,7 +249,8 @@ class KubeStubState:
             self.events.append(obj)
             self._notify("events", "ADDED", obj)
 
-    def _notify(self, kind: str, change_type: str, obj: dict):
+    def _notify(self, kind: str, change_type: str, obj: dict,
+                data: str | None = None):
         if len(self.history) == self.history.maxlen:
             self._evicted_rv = self.history[0][0]
         # serialize ONCE per mutation: history entries and watch
@@ -170,7 +258,10 @@ class KubeStubState:
         # used to pay a deep copy here plus one json.dumps per watcher
         # per change — the stub's hot-path cost, not the protocol's).
         # fmeta keeps the two fields fieldSelector filtering reads.
-        data = json.dumps(obj)
+        # ``data`` lets template-rendering callers (storm_nodes) skip
+        # the dumps entirely.
+        if data is None:
+            data = json.dumps(obj)
         fmeta = (obj.get("reason"), obj.get("type"))
         self.history.append((self._rv, kind, change_type, data, fmeta))
         for wkind, q in list(self.watchers):
@@ -326,8 +417,9 @@ def _make_handler(state: KubeStubState):
                     out[k] = v
             return out
 
-        def _list(self, items: list[dict], snapshot_rv: str):
-            """Paginated list (limit/continue). Every page — including
+        def _list(self, items_json: list[str], snapshot_rv: str):
+            """Paginated list (limit/continue) over PRE-RENDERED item
+            JSON (see ``rendered_list``). Every page — including
             continue pages — is stamped with the resourceVersion of the
             snapshot the FIRST page was taken at, like a real apiserver's
             consistent list: a watch resumed from it replays every change
@@ -345,18 +437,22 @@ def _make_handler(state: KubeStubState):
                         )
                     pending, rv = pending_entry
                 else:
-                    pending = list(items)
+                    pending = items_json
                 limit = int(q.get("limit") or 0)
-                payload = {"metadata": {"resourceVersion": rv}, "items": pending}
+                meta = {"resourceVersion": rv}
                 if limit and len(pending) > limit:
                     state._continue_seq += 1
                     token = f"c{state._continue_seq}"
                     state._continues[token] = (pending[limit:], rv)
-                    payload = {
-                        "metadata": {"resourceVersion": rv, "continue": token},
-                        "items": pending[:limit],
-                    }
-            return self._json(200, payload)
+                    meta["continue"] = token
+                    page = pending[:limit]
+                else:
+                    page = pending
+            body = (
+                '{"metadata": %s, "items": [%s]}'
+                % (json.dumps(meta), ",".join(page))
+            ).encode()
+            return self._send_raw(200, body)
 
         def _watch(self, kind: str, event_filter=None):
             q_params = self._query()
@@ -390,39 +486,100 @@ def _make_handler(state: KubeStubState):
                 # starts at the CURRENT state — the client is expected
                 # to list first
                 state.watchers.append((kind, q))
+                # mid-stream 410 injection: claimed by THIS stream
+                # (one-shot); None = no fault armed
+                fault_410 = state.watch_410_after.pop(kind, None)
+
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
 
-            def frame(change_type, fmeta, data):
+            ERROR_410_LINE = (
+                '{"type": "ERROR", "object": %s}\n' % json.dumps({
+                    "kind": "Status", "code": 410,
+                    "message": "too old resource version (injected)",
+                })
+            ).encode()
+
+            def line_of(change_type, fmeta, data):
                 if (
                     event_filter
                     and change_type not in ("ERROR", "BOOKMARK")
                     and not event_filter(fmeta)
                 ):
                     return b""
-                line = ('{"type": "%s", "object": %s}\n' % (change_type, data)).encode()
+                return (
+                    '{"type": "%s", "object": %s}\n' % (change_type, data)
+                ).encode()
+
+            def chunk(line):
                 return f"{len(line):x}\r\n".encode() + line + b"\r\n"
 
-            def send(change_type, fmeta, data):
-                buf = frame(change_type, fmeta, data)
-                if buf:
-                    self.wfile.write(buf)
+            def write_torn(line):
+                # the read fault: one JSON line split MID-LINE across
+                # two chunked writes with a flush between — a client
+                # draining per-wakeup sees a torn tail it must buffer
+                mid = max(1, len(line) // 2)
+                self.wfile.write(chunk(line[:mid]))
+                self.wfile.flush()
+                time.sleep(0.002)
+                self.wfile.write(chunk(line[mid:]))
+                self.wfile.flush()
+
+            # countdown list so nested helpers can mutate it; counts
+            # delivered (non-bookmark, post-filter) events
+            remaining_410 = [fault_410]
+
+            def write_events(changes) -> bool:
+                """Write a batch of (type, fmeta, data) event frames,
+                honoring torn-write mode and the mid-stream 410 offset.
+                Returns False when the stream must end (410 injected)."""
+                out = []
+                for change_type, fmeta, data in changes:
+                    line = line_of(change_type, fmeta, data)
+                    if not line:
+                        continue
+                    if (
+                        remaining_410[0] is not None
+                        and change_type != "BOOKMARK"
+                        and remaining_410[0] <= 0
+                    ):
+                        out.append(chunk(ERROR_410_LINE))
+                        if out:
+                            self.wfile.write(b"".join(out))
+                            self.wfile.flush()
+                        return False
+                    if state.torn_watch_writes:
+                        if out:
+                            self.wfile.write(b"".join(out))
+                            out = []
+                        write_torn(line)
+                    else:
+                        out.append(chunk(line))
+                    if (
+                        remaining_410[0] is not None
+                        and change_type != "BOOKMARK"
+                    ):
+                        remaining_410[0] -= 1
+                if out:
+                    self.wfile.write(b"".join(out))
                     self.wfile.flush()
+                return True
 
             try:
-                for change_type, fmeta, data in backlog:
-                    send(change_type, fmeta, data)
+                if not write_events(backlog):
+                    return
+                for change_type, _, _ in backlog:
                     if change_type == "ERROR":
                         return
                 closing = False
                 while not closing:
                     try:
-                        change = q.get(timeout=30.0)
+                        change = q.get(timeout=state.watch_bookmark_interval)
                     except queue.Empty:
                         if bookmarks:
-                            send(
+                            write_events([(
                                 "BOOKMARK",
                                 None,
                                 json.dumps({
@@ -431,14 +588,14 @@ def _make_handler(state: KubeStubState):
                                         "resourceVersion": str(state._rv)
                                     },
                                 }),
-                            )
+                            )])
                         break
                     if change is None:  # close_watches sentinel
                         break
                     # drain whatever else is queued into ONE write: a
                     # patch storm delivers thousands of MODIFIEDs and
                     # per-change write+flush is the stub's hot cost
-                    batch = [frame(*change)]
+                    batch = [change]
                     while len(batch) < 256:
                         try:
                             nxt = q.get_nowait()
@@ -447,11 +604,9 @@ def _make_handler(state: KubeStubState):
                         if nxt is None:
                             closing = True
                             break
-                        batch.append(frame(*nxt))
-                    buf = b"".join(batch)
-                    if buf:
-                        self.wfile.write(buf)
-                        self.wfile.flush()
+                        batch.append(nxt)
+                    if not write_events(batch):
+                        return
             except (BrokenPipeError, ConnectionResetError):
                 pass
             finally:
@@ -495,15 +650,17 @@ def _make_handler(state: KubeStubState):
                 if watching:
                     return self._watch("nodes")
                 with state.lock:
-                    items = list(state.nodes.values())
-                    rv = str(state._rv)
+                    items, rv = state.rendered_list(
+                        "nodes", state.nodes.values()
+                    )
                 return self._list(items, rv)
             if path == "/api/v1/pods":
                 if watching:
                     return self._watch("pods")
                 with state.lock:
-                    items = list(state.pods.values())
-                    rv = str(state._rv)
+                    items, rv = state.rendered_list(
+                        "pods", state.pods.values()
+                    )
                 return self._list(items, rv)
             if path == "/apis/topology.crane.io/v1alpha1/noderesourcetopologies":
                 if not state.serve_nrt:
@@ -511,8 +668,9 @@ def _make_handler(state: KubeStubState):
                 if watching:
                     return self._watch("nrts")
                 with state.lock:
-                    items = list(state.nrts.values())
-                    rv = str(state._rv)
+                    items, rv = state.rendered_list(
+                        "nrts", state.nrts.values()
+                    )
                 return self._list(items, rv)
             if "/leases/" in path:
                 with state.lock:
@@ -532,13 +690,15 @@ def _make_handler(state: KubeStubState):
                     )
                     return self._watch("events", flt)
                 with state.lock:
-                    items = [
-                        o for o in state.events
-                        if not filtered
-                        or (o.get("reason") == "Scheduled"
-                            and o.get("type") == "Normal")
-                    ]
-                    rv = str(state._rv)
+                    items, rv = state.rendered_list(
+                        f"events:{filtered}",
+                        [
+                            o for o in state.events
+                            if not filtered
+                            or (o.get("reason") == "Scheduled"
+                                and o.get("type") == "Normal")
+                        ],
+                    )
                 return self._list(items, rv)
             return self._json(404, {"message": f"not found: {path}"})
 
@@ -608,23 +768,37 @@ def _make_handler(state: KubeStubState):
                 if parts[1] == "seed":
                     n = int(body.get("nodes", 0))
                     prefix = body.get("prefix", "node-")
+                    # optional annotation seeding: a list of metric
+                    # names puts a wire-shaped "value,timestamp" string
+                    # per name on every node (read-path benches need
+                    # LIST bodies that look like a synced cluster's)
+                    metrics = body.get("metrics") or []
                     with state.lock:
                         for i in range(n):
                             ip = (
                                 f"10.{(i >> 16) & 255}."
                                 f"{(i >> 8) & 255}.{i & 255}"
                             )
+                            anno = {
+                                m: f"{(i % 97) / 97:.5f},"
+                                   "2026-07-30T00:00:00Z"
+                                for m in metrics
+                            }
                             # direct insert, no per-node notify: seeding
                             # happens before any client lists/watches
                             state.nodes[f"{prefix}{i:05d}"] = state._stamp({
                                 "metadata": {
                                     "name": f"{prefix}{i:05d}",
-                                    "annotations": {},
+                                    "annotations": anno,
                                 },
                                 "status": {"addresses": [
                                     {"type": "InternalIP", "address": ip}
                                 ]},
                             })
+                        # warm the rendered-LIST cache so a bench's
+                        # first bootstrap measures the CLIENT, not this
+                        # stub's one-time serialization
+                        state.rendered_list("nodes", state.nodes.values())
                     return self._json(200, {"seeded": n})
                 if parts[1] == "close_watches":
                     state.close_watches()
@@ -637,6 +811,20 @@ def _make_handler(state: KubeStubState):
                         body.get("name", ""), body.get("ip", "10.0.0.1")
                     )
                     return self._json(200, {"ok": True})
+                if parts[1] == "storm":
+                    # watch-storm generator: runs in its own thread so
+                    # the caller can time the CLIENT's apply throughput
+                    # while events stream
+                    kind = body.get("kind", "nodes")
+                    count = int(body.get("count", 0))
+                    gen = (
+                        state.storm_events if kind == "events"
+                        else state.storm_nodes
+                    )
+                    threading.Thread(
+                        target=gen, args=(count,), daemon=True
+                    ).start()
+                    return self._json(200, {"ok": True, "count": count})
             with state.lock:
                 if parts[-1] == "leases":
                     ns = parts[-2]
@@ -867,11 +1055,13 @@ class KubeStubSubprocess:
     def _control_all(self, path: str, body: dict | None = None) -> list[dict]:
         return [self._control(path, body, base=u) for u in self.control_urls]
 
-    def seed(self, nodes: int, prefix: str = "node-") -> dict:
+    def seed(self, nodes: int, prefix: str = "node-",
+             metrics: list | None = None) -> dict:
         # every shard holds the full node set (a patch routed to any
         # shard must find its node)
         return self._control_all(
-            "/__stub/seed", {"nodes": nodes, "prefix": prefix}
+            "/__stub/seed",
+            {"nodes": nodes, "prefix": prefix, "metrics": metrics or []},
         )[0]
 
     def stats(self) -> dict:
@@ -899,6 +1089,13 @@ class KubeStubSubprocess:
 
     def add_node(self, name: str, ip: str = "10.0.0.1") -> None:
         self._control_all("/__stub/add_node", {"name": name, "ip": ip})
+
+    def storm(self, kind: str, count: int) -> None:
+        """Kick a watch-storm (node MODIFIEDs or Scheduled events) on
+        the first shard; returns immediately — the storm streams while
+        the caller measures its client's apply throughput."""
+        self._control("/__stub/storm", {"kind": kind, "count": count},
+                      base=self.control_urls[0])
 
     def stop(self):
         for p in self._procs:

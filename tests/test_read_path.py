@@ -1,0 +1,767 @@
+"""Round-7 read path: columnar LIST decode parity (native + Python
+twins vs the per-object path), coalesced watch apply (rv watermark,
+duplicate suppression, transaction semantics), read-side fault matrix
+(torn lines, bookmark-only streams, mid-stream 410), the idle-timeout
+reconnect fix, and the store's columnar refresh fast path.
+"""
+
+import importlib.util
+import json
+import os
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from crane_scheduler_tpu.cluster.kube import (
+    KubeClusterClient,
+    node_from_json,
+    pod_from_json,
+)
+from crane_scheduler_tpu.cluster.state import ClusterState, Event, Node, Pod
+from crane_scheduler_tpu.native.lib import load_native
+from crane_scheduler_tpu.native.listdecode import (
+    NODE_KIND,
+    POD_KIND,
+    decode_list_page,
+)
+
+_STUB = os.path.join(os.path.dirname(__file__), "kube_stub.py")
+spec = importlib.util.spec_from_file_location("kube_stub_rp", _STUB)
+kube_stub = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(kube_stub)
+
+NATIVE = load_native() is not None and hasattr(
+    load_native(), "crane_list_decode"
+)
+BACKENDS = [False] + ([True] if NATIVE else [])
+
+
+def _wait_until(predicate, timeout=5.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def stub():
+    server = kube_stub.KubeStubServer().start()
+    yield server
+    server.stop()
+
+
+@pytest.fixture()
+def client(stub):
+    c = KubeClusterClient(stub.url)
+    yield c
+    c.stop()
+
+
+# -- decode parity: golden objects --------------------------------------
+
+GOLDEN_NODES = [
+    {"metadata": {"name": "n1",
+                  "annotations": {"a": "0.5,2026-01-01T00:00:00Z",
+                                  "b": "x"},
+                  "labels": {"zone": "z1"},
+                  "managedFields": [{"manager": "kubelet", "seq": 1}]},
+     "status": {"addresses": [{"type": "InternalIP",
+                               "address": "10.0.0.1",
+                               "extra": 5}],
+                "capacity": {"cpu": "4", "memory": "16Gi"}}},
+    {"metadata": {"name": "n2"}},  # bare
+    {"metadata": {"name": "esc\"\\\nnode", "annotations": {"k\t": "v\n"}}},
+    {"metadata": {"name": "uni-é漢\U0001F600"}},
+    {"metadata": {"name": "n-num", "annotations": {"num": 5}}},  # fallback
+    {"metadata": {"name": "n-null-anno", "annotations": None}},
+    {"metadata": {"name": "n-addr-missing"},
+     "status": {"addresses": [{"type": "Hostname"}]}},
+    {},  # fully empty item
+    {"metadata": {"name": "n-empty-maps", "annotations": {}, "labels": {}},
+     "status": {"addresses": []}},
+]
+
+GOLDEN_PODS = [
+    {"metadata": {"name": "p1", "namespace": "ns1",
+                  "annotations": {"k": "v"},
+                  "ownerReferences": [{"kind": "DaemonSet", "name": "ds",
+                                       "uid": "u-1"}]},
+     "spec": {"nodeName": "n1"}},
+    {"metadata": {"name": "p2"}, "spec": {}},  # default namespace
+    {"metadata": {"name": "p3"}, "spec": {"nodeName": None}},
+    {"metadata": {"name": "p4", "namespace": "ns"},
+     "spec": {"containers": [
+         {"name": "c1", "resources": {"requests": {"cpu": 0.5},
+                                      "limits": {"cpu": "1"}}}]}},
+    {"metadata": {"name": "p5"},
+     "spec": {"containers": []}},  # empty containers: fast path
+    {"metadata": {"name": "p6", "annotations": {"x": "yé"}},
+     "spec": {"nodeName": "n\"2"}},
+]
+
+
+def _body(items, rv="17", cont=None):
+    meta = {"resourceVersion": rv}
+    if cont is not None:
+        meta["continue"] = cont
+    return json.dumps(
+        {"kind": "List", "apiVersion": "v1", "metadata": meta,
+         "items": items}
+    ).encode()
+
+
+@pytest.mark.parametrize("native", BACKENDS)
+def test_node_decode_parity_golden(native):
+    body = _body(GOLDEN_NODES)
+    page = decode_list_page(body, NODE_KIND, native=native)
+    assert page is not None
+    assert page.rv == "17"
+    assert page.cont is None
+    ref = [node_from_json(i) for i in json.loads(body)["items"]]
+    assert page.materialize() == ref
+    # the non-string annotation value is the only fallback row here
+    assert page.fallback_rows == [4]
+
+
+@pytest.mark.parametrize("native", BACKENDS)
+def test_pod_decode_parity_golden(native):
+    body = _body(GOLDEN_PODS, rv="9")
+    page = decode_list_page(body, POD_KIND, native=native)
+    assert page is not None
+    ref = [pod_from_json(i) for i in json.loads(body)["items"]]
+    assert page.materialize() == ref
+    assert page.fallback_rows == [3]  # non-empty containers
+
+
+@pytest.mark.skipif(not NATIVE, reason="native library unavailable")
+def test_native_and_python_columns_bit_identical():
+    for kind, items in ((NODE_KIND, GOLDEN_NODES), (POD_KIND, GOLDEN_PODS)):
+        body = _body(items, cont="tok-1")
+        pn = decode_list_page(body, kind, native=True)
+        pt = decode_list_page(body, kind, native=False)
+        assert pn.strings == pt.strings
+        assert (pn.flags == pt.flags).all()
+        assert (pn.counts == pt.counts).all()
+        assert pn.rv == pt.rv and pn.cont == pt.cont
+
+
+@pytest.mark.skipif(not NATIVE, reason="native library unavailable")
+def test_surrogate_escapes_match_json_loads():
+    # paired surrogates decode on the fast path; lone surrogates fall
+    # back (json.loads keeps them as unencodable code points)
+    body = (b'{"metadata":{"resourceVersion":"1"},"items":['
+            b'{"metadata":{"name":"ok\\uD83D\\uDE00"}},'
+            b'{"metadata":{"name":"lone\\uD800x"}},'
+            b'{"metadata":{"name":"lo\\uDC00"}}]}')
+    pn = decode_list_page(body, NODE_KIND, native=True)
+    pt = decode_list_page(body, NODE_KIND, native=False)
+    ref = [node_from_json(i) for i in json.loads(body)["items"]]
+    assert pn.materialize() == ref
+    assert pt.materialize() == ref
+    assert pn.strings == pt.strings
+    assert pn.fallback_rows == pt.fallback_rows == [1, 2]
+
+
+def _fuzz_string(rng):
+    alphabet = (
+        "abc-._/\"\\\n\t\ré漢\U0001F600 ,:{}[]0123456789"
+    )
+    return "".join(
+        rng.choice(alphabet) for _ in range(rng.randrange(0, 24))
+    )
+
+
+def _fuzz_node(rng):
+    obj = {}
+    if rng.random() < 0.95:
+        meta = {"name": _fuzz_string(rng)}
+        if rng.random() < 0.8:
+            anno = {}
+            for _ in range(rng.randrange(0, 5)):
+                v = _fuzz_string(rng) if rng.random() < 0.9 else rng.choice(
+                    [5, 1.5, None, True, ["x"], {"y": "z"}]
+                )
+                anno[_fuzz_string(rng)] = v
+            meta["annotations"] = anno
+        if rng.random() < 0.3:
+            meta["labels"] = {_fuzz_string(rng): _fuzz_string(rng)}
+        if rng.random() < 0.2:
+            meta["managedFields"] = [{"m": [1, {"d": None}]}]
+        obj["metadata"] = meta
+    if rng.random() < 0.6:
+        addrs = []
+        for _ in range(rng.randrange(0, 3)):
+            a = {}
+            if rng.random() < 0.9:
+                a["type"] = _fuzz_string(rng)
+            if rng.random() < 0.9:
+                a["address"] = _fuzz_string(rng)
+            if rng.random() < 0.2:
+                a["extra"] = 7
+            addrs.append(a)
+        obj["status"] = {"addresses": addrs}
+    return obj
+
+
+def _fuzz_pod(rng):
+    obj = {}
+    meta = {"name": _fuzz_string(rng)}
+    if rng.random() < 0.5:
+        meta["namespace"] = _fuzz_string(rng)
+    if rng.random() < 0.5:
+        meta["annotations"] = {
+            _fuzz_string(rng): (
+                _fuzz_string(rng) if rng.random() < 0.9 else 3
+            )
+            for _ in range(rng.randrange(0, 4))
+        }
+    if rng.random() < 0.4:
+        meta["ownerReferences"] = [
+            {"kind": rng.choice(["DaemonSet", "ReplicaSet", ""]),
+             "name": _fuzz_string(rng)}
+            for _ in range(rng.randrange(0, 3))
+        ]
+    obj["metadata"] = meta
+    spec = {}
+    if rng.random() < 0.6:
+        spec["nodeName"] = rng.choice([_fuzz_string(rng), None])
+    if rng.random() < 0.3:
+        spec["containers"] = [
+            {"name": "c",
+             "resources": {"requests": {"cpu": rng.random()}}}
+        ] if rng.random() < 0.7 else []
+    obj["spec"] = spec
+    return obj
+
+
+@pytest.mark.parametrize("native", BACKENDS)
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_decode_parity_fuzzed(native, seed):
+    rng = random.Random(seed)
+    nodes = [_fuzz_node(rng) for _ in range(150)]
+    pods = [_fuzz_pod(rng) for _ in range(150)]
+    for kind, items, loader in (
+        (NODE_KIND, nodes, node_from_json),
+        (POD_KIND, pods, pod_from_json),
+    ):
+        body = _body(items)
+        page = decode_list_page(body, kind, native=native)
+        assert page is not None
+        assert page.materialize() == [
+            loader(i) for i in json.loads(body)["items"]
+        ]
+
+
+@pytest.mark.skipif(not NATIVE, reason="native library unavailable")
+@pytest.mark.parametrize("seed", [3, 4])
+def test_decode_columns_bit_identical_fuzzed(seed):
+    rng = random.Random(seed)
+    for kind, gen in ((NODE_KIND, _fuzz_node), (POD_KIND, _fuzz_pod)):
+        body = _body([gen(rng) for _ in range(120)])
+        pn = decode_list_page(body, kind, native=True)
+        pt = decode_list_page(body, kind, native=False)
+        assert pn.strings == pt.strings
+        assert (pn.flags == pt.flags).all()
+        assert (pn.counts == pt.counts).all()
+
+
+def test_malformed_body_falls_back_to_json_error():
+    with pytest.raises(json.JSONDecodeError):
+        decode_list_page(b'{"items": [{"metadata": }]}', NODE_KIND)
+
+
+# -- columnar store ingest parity ---------------------------------------
+
+def test_ingest_annotation_columns_matches_bulk_ingest():
+    from crane_scheduler_tpu.constants import NODE_HOT_VALUE_KEY
+    from crane_scheduler_tpu.loadstore import NodeLoadStore
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY, compile_policy
+
+    tensors = compile_policy(DEFAULT_POLICY)
+    metric = tensors.metric_names[0]
+    maps = []
+    for i in range(64):
+        anno = {metric: f"{i / 64:.5f},2026-01-01T00:00:0{i % 10}Z"}
+        if i % 3 == 0:
+            anno[NODE_HOT_VALUE_KEY] = f"{i},2026-01-01T00:00:00Z"
+        if i % 5 == 0:
+            anno["unrelated"] = "junk"
+        if i % 7 == 0:
+            anno = {}
+        maps.append((f"node-{i:03d}", anno))
+
+    a = NodeLoadStore(tensors)
+    a.bulk_ingest(maps)
+    b = NodeLoadStore(tensors)
+    names = [n for n, _ in maps]
+    keys, values = [], []
+    offsets = np.zeros(len(maps) + 1, dtype=np.int64)
+    for i, (_, anno) in enumerate(maps):
+        for k, v in anno.items():
+            keys.append(k)
+            values.append(v)
+        offsets[i + 1] = len(keys)
+    b.ingest_annotation_columns(names, keys, values, offsets)
+
+    assert a.node_names == b.node_names
+    n = len(a)
+    np.testing.assert_array_equal(a.values[:n], b.values[:n])
+    np.testing.assert_array_equal(a.ts[:n], b.ts[:n])
+    np.testing.assert_array_equal(a.hot_value[:n], b.hot_value[:n])
+    np.testing.assert_array_equal(a.hot_ts[:n], b.hot_ts[:n])
+
+
+# -- mirror transaction semantics ---------------------------------------
+
+def test_replace_nodes_single_version_bump_and_prune():
+    c = ClusterState()
+    c.add_node(Node(name="old", annotations={"x": "1"}))
+    v0 = c.sched_version
+    nsv0 = c.node_set_version
+    c.replace_nodes([
+        Node(name="a", annotations={"k": "1"}),
+        Node(name="b", annotations={"k": "2"}),
+        Node(name="c"),
+    ])
+    assert c.sched_version == v0 + 1  # one bump for the whole relist
+    assert c.node_set_version == nsv0 + 1
+    assert {n.name for n in c.list_nodes()} == {"a", "b", "c"}
+    assert c.get_node("a").annotations == {"k": "1"}
+    # identical relist: still exactly one bump, membership version steady
+    v1, nsv1 = c.sched_version, c.node_set_version
+    c.replace_nodes([
+        Node(name="a", annotations={"k": "1"}),
+        Node(name="b", annotations={"k": "2"}),
+        Node(name="c"),
+    ])
+    assert c.sched_version == v1 + 1
+    assert c.node_set_version == nsv1
+
+
+def test_replace_pods_prunes_and_keeps_order_semantics():
+    c = ClusterState()
+    c.add_pod(Pod(name="stale", node_name="n1"))
+    c.replace_pods([
+        Pod(name="p1", node_name="n1"),
+        Pod(name="p2"),
+    ])
+    assert {p.key() for p in c.list_pods()} == {"default/p1", "default/p2"}
+    assert c.count_pods("n1") == 1
+
+
+def test_apply_pod_changes_order_and_single_bump():
+    c = ClusterState()
+    v0 = c.sched_version
+    c.apply_pod_changes([
+        ("ADDED", Pod(name="p1", node_name="n1")),
+        ("MODIFIED", Pod(name="p1", node_name="n2")),
+        ("ADDED", Pod(name="p2", node_name="n1")),
+        ("DELETED", Pod(name="p2", node_name="n1")),
+    ])
+    assert c.sched_version == v0 + 1
+    assert c.get_pod("default/p1").node_name == "n2"
+    assert c.get_pod("default/p2") is None
+    assert c.count_pods("n1") == 0 and c.count_pods("n2") == 1
+
+
+def test_apply_node_changes_delete_then_add():
+    c = ClusterState()
+    c.add_node(Node(name="a"))
+    c.apply_node_changes([
+        ("DELETED", Node(name="a")),
+        ("ADDED", Node(name="a", annotations={"back": "1"})),
+        ("ADDED", Node(name="b")),
+    ])
+    assert c.get_node("a").annotations == {"back": "1"}
+    assert c.get_node("b") is not None
+
+
+def test_emit_events_batched_delivery_order():
+    c = ClusterState()
+    singles, batches = [], []
+    c.subscribe_events(singles.append)
+    c.subscribe_events_batch(batches.append)
+    events = [
+        Event(namespace="d", name=f"e{i}", type="Normal",
+              reason="Scheduled", message=f"m{i}")
+        for i in range(5)
+    ]
+    c.emit_events(events)
+    assert [e.name for e in singles] == [f"e{i}" for i in range(5)]
+    assert len(batches) == 1 and len(batches[0]) == 5
+    rvs = [e.resource_version for e in batches[0]]
+    assert rvs == sorted(rvs)  # stamped in order
+
+
+# -- coalesced event dedup: rv watermark --------------------------------
+
+def _event_obj(name, rv, message="assigned"):
+    return {
+        "metadata": {"namespace": "d", "name": name,
+                     "resourceVersion": str(rv)},
+        "type": "Normal", "reason": "Scheduled", "message": message,
+        "count": 1, "lastTimestamp": "2026-07-30T00:00:00Z",
+    }
+
+
+def test_coalesced_apply_preserves_rv_watermark(stub):
+    client = KubeClusterClient(stub.url)
+    try:
+        got = []
+        client.subscribe_events(got.append)
+        client._mark_event_stream_restart()
+        client._apply_event_batch([
+            ("ADDED", _event_obj("e1", 5)),
+            ("ADDED", _event_obj("e2", 6)),
+            ("ADDED", _event_obj("e3", 7)),
+        ])
+        assert [e.name for e in got] == ["e1", "e2", "e3"]
+        assert client._event_rv_watermark == 7
+        # a reconnect replay of the same prefix is suppressed wholesale
+        client._mark_event_stream_restart()
+        client._apply_event_batch([
+            ("ADDED", _event_obj("e1", 5)),
+            ("ADDED", _event_obj("e2", 6)),
+            ("ADDED", _event_obj("e3", 7)),
+            ("ADDED", _event_obj("e4", 8)),  # genuinely new
+        ])
+        assert [e.name for e in got] == ["e1", "e2", "e3", "e4"]
+        assert client._event_rv_watermark == 8
+    finally:
+        client.stop()
+
+
+def test_coalesced_apply_content_dedup_for_rvless_events(stub):
+    client = KubeClusterClient(stub.url)
+    try:
+        got = []
+        client.subscribe_events(got.append)
+        obj = {
+            "metadata": {"namespace": "d", "name": "x"},  # no rv
+            "type": "Normal", "reason": "Scheduled",
+            "message": "assigned", "count": 1,
+            "lastTimestamp": "2026-07-30T00:00:00Z",
+        }
+        client._apply_event_batch([("ADDED", obj), ("ADDED", dict(obj))])
+        assert len(got) == 1  # content-key dedup inside one batch
+        client._apply_event_batch([("ADDED", dict(obj))])
+        assert len(got) == 1  # and across batches
+    finally:
+        client.stop()
+
+
+# -- fault matrix over the wire stub ------------------------------------
+
+def test_torn_watch_lines_reassemble(stub, client):
+    stub.state.torn_watch_writes = True
+    stub.state.add_node("node-a", "10.0.0.1")
+    client.start()
+    for i in range(8):
+        stub.state.add_node(f"torn-{i}", f"10.0.1.{i}")
+    assert _wait_until(
+        lambda: all(
+            client.get_node(f"torn-{i}") is not None for i in range(8)
+        ),
+        timeout=10.0,
+    )
+    assert client.watch_errors == 0
+    # every event applied exactly once, annotations intact
+    assert {n.name for n in client.list_nodes()} == (
+        {"node-a"} | {f"torn-{i}" for i in range(8)}
+    )
+
+
+def test_bookmark_only_stream_reconnects_cleanly(stub, client):
+    stub.state.watch_bookmark_interval = 0.05
+    stub.state.add_node("node-a", "10.0.0.1")
+    client.start()
+    time.sleep(0.6)  # several bookmark-only stream generations
+    assert client.watch_errors == 0
+    # bookmarks advanced the resume point to the current server rv
+    assert _wait_until(
+        lambda: client._rvs.get("nodes") == str(stub.state.resource_version),
+        timeout=5.0,
+    )
+    # deliveries still work after bookmark-only generations
+    stub.state.add_node("node-late", "10.0.9.9")
+    assert _wait_until(lambda: client.get_node("node-late") is not None,
+                      timeout=10.0)
+
+
+def test_410_mid_stream_at_exact_offset_relists_once(stub, client):
+    for i in range(6):
+        stub.state.add_node(f"node-{i}", f"10.0.0.{i}")
+    client.start()
+    relists0 = client.relists
+    # arm the fault, then force a reconnect so the NEXT stream claims it
+    stub.state.inject_watch_410_after("nodes", 2)
+    stub.state.close_watches()
+    # give the reconnect a moment, then storm: 2 events deliver, then
+    # the ERROR 410 lands mid-stream and the client must relist
+    assert _wait_until(
+        lambda: len(stub.state.watchers) >= 3, timeout=10.0
+    )
+    stub.state.storm_nodes(6, key="storm")
+    assert _wait_until(
+        lambda: client.relists > relists0, timeout=15.0
+    )
+    # mirror converges on the post-storm state via the relist
+    assert _wait_until(
+        lambda: all(
+            (client.get_node(f"node-{i}") or Node(name="x")).annotations.get(
+                "storm"
+            ) == str(i)
+            for i in range(6)
+        ),
+        timeout=15.0,
+    )
+    assert client.relists == relists0 + 1  # exactly one relist
+
+
+def test_watch_storm_coalesces(stub, client):
+    for i in range(32):
+        stub.state.add_node(f"node-{i:03d}", f"10.0.0.{i}")
+    client.start()
+    applied0 = client.watch_applied
+    stub.state.storm_nodes(400)
+    assert _wait_until(
+        lambda: client.watch_applied >= applied0 + 400, timeout=20.0
+    )
+    # the storm must not have been applied one-transaction-per-event
+    assert client.watch_coalesced >= 1
+    assert client.watch_batches < client.watch_applied
+    # final state correct (last write per node wins)
+    last = {}
+    for i in range(400):
+        last[f"node-{i % 32:03d}"] = str(i)
+    for name, val in last.items():
+        assert client.get_node(name).annotations["crane.io/storm"] == val
+
+
+def test_relist_vs_watch_race_converges(stub, client):
+    for i in range(24):
+        stub.state.add_node(f"node-{i:03d}", f"10.0.0.{i}")
+    client.start()
+
+    storm = threading.Thread(
+        target=stub.state.storm_nodes, args=(300,), daemon=True
+    )
+    storm.start()
+    time.sleep(0.02)
+    # expire the resume window mid-storm: the reconnect 410s and relists
+    # while MODIFIEDs keep streaming
+    stub.state.compact_history()
+    stub.state.close_watches()
+    storm.join(timeout=30.0)
+    assert not storm.is_alive()
+
+    def converged():
+        for i in range(300 - 24, 300):
+            name = f"node-{i % 24:03d}"
+            node = client.get_node(name)
+            if node is None:
+                return False
+            want = stub.state.nodes[name]["metadata"]["annotations"].get(
+                "crane.io/storm"
+            )
+            if node.annotations.get("crane.io/storm") != want:
+                return False
+        return True
+
+    assert _wait_until(converged, timeout=20.0)
+
+
+# -- idle-timeout reconnect (satellite fix) ------------------------------
+
+def test_reconnect_policy_unit():
+    f = KubeClusterClient._reconnect_immediately
+    # idle expiry on a healthy stream: immediate, delivered or not
+    assert f(False, 0, 300.0, True)
+    assert f(True, 0, 300.0, True)
+    # long-lived delivered stream: immediate
+    assert f(True, 0, 2.0, False)
+    # short-lived streams and failures always back off
+    assert not f(True, 0, 0.5, False)
+    assert not f(False, 0, 0.5, False)
+    assert not f(True, 1, 300.0, True)
+    assert not f(False, 3, 300.0, False)
+
+
+def test_idle_expired_watch_reconnects_immediately(stub):
+    stub.state.add_node("node-a", "10.0.0.1")
+    stub.state.watch_bookmark_interval = 60.0  # never bookmark in-test
+    client = KubeClusterClient(stub.url)
+    client._watch_timeout = 0.25
+    try:
+        client.start()
+        time.sleep(1.3)
+
+        def watch_connects():
+            return sum(
+                1 for m, p in list(stub.state.requests)
+                if m == "GET" and p.startswith("/api/v1/nodes?watch=1")
+            )
+
+        # ~0.25s per idle generation with zero-backoff reconnects: >= 3
+        # connects in 1.3s (the pre-fix 1s backoff per generation
+        # managed at most 2)
+        assert watch_connects() >= 3
+        assert client.watch_errors == 0
+    finally:
+        client.stop()
+
+
+# -- rv-based instance reuse across relists ------------------------------
+
+def test_relist_rv_reuse_preserves_identity_and_detects_change(stub):
+    metric = "m0"
+    for i in range(12):
+        stub.state.add_node(
+            f"node-{i:02d}", f"10.0.0.{i}",
+            {metric: f"{i}.0,2026-01-01T00:00:00Z"},
+        )
+    client = KubeClusterClient(stub.url)
+    try:
+        client.start()
+        before = {n.name: n for n in client.list_nodes()}
+        client._relist_nodes()
+        client._relist_nodes()
+        after = {n.name: n for n in client.list_nodes()}
+        if client._node_rvs:  # rv reuse active (pylist decoder present)
+            # unchanged rv => the SAME instance survives the relists
+            assert all(after[k] is before[k] for k in before)
+        else:
+            assert after == before
+
+        # a server-side change rebuilds exactly that node
+        stub.state.nodes["node-03"]["metadata"]["annotations"][
+            metric
+        ] = "99.0,2026-01-01T00:00:00Z"
+        stub.state._stamp(stub.state.nodes["node-03"])
+        client._relist_nodes()
+        node = client.get_node("node-03")
+        assert node.annotations[metric] == "99.0,2026-01-01T00:00:00Z"
+        assert node is not before["node-03"]
+        assert client.get_node("node-07") is not None
+    finally:
+        client.stop()
+
+
+def test_relist_rv_reuse_respects_watch_and_patch_invalidation(stub):
+    for i in range(4):
+        stub.state.add_node(f"node-{i}", f"10.0.0.{i}", {"k": "v0"})
+    client = KubeClusterClient(stub.url)
+    try:
+        client.start()
+        client._relist_nodes()
+        # a patch through the client bumps the server AND invalidates
+        # the reuse entry: the next relist must carry the new value
+        assert client.patch_node_annotation("node-1", "k", "v1")
+        assert _wait_until(
+            lambda: client.get_node("node-1").annotations.get("k") == "v1"
+        )
+        client._relist_nodes()
+        assert client.get_node("node-1").annotations["k"] == "v1"
+        # watch-applied changes rebuild too
+        stub.state.add_node("node-2", "10.0.0.9", {"k": "v2"})
+        assert _wait_until(
+            lambda: client.get_node("node-2").annotations.get("k") == "v2"
+        )
+        client._relist_nodes()
+        assert client.get_node("node-2").annotations["k"] == "v2"
+        assert client.get_node("node-2").addresses[0].address == "10.0.0.9"
+    finally:
+        client.stop()
+
+
+# -- columnar refresh fast path -----------------------------------------
+
+def test_batch_scheduler_columnar_refresh(stub):
+    from crane_scheduler_tpu.framework.scheduler import BatchScheduler
+    from crane_scheduler_tpu.loadstore import NodeLoadStore
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY, compile_policy
+
+    tensors = compile_policy(DEFAULT_POLICY)
+    metric = tensors.metric_names[0]
+    for i in range(16):
+        stub.state.add_node(
+            f"node-{i:03d}", f"10.0.0.{i}",
+            {metric: f"{i / 16:.5f},2026-01-01T00:00:00Z"},
+        )
+    client = KubeClusterClient(stub.url)
+    try:
+        client.start()
+        batch = BatchScheduler(client, DEFAULT_POLICY, snapshot_bucket=32)
+        batch.refresh()
+        assert batch.refresh_stats["columnar_ingest"] == 1
+        assert len(batch.store) == 16
+
+        # twin store through the object path: contents identical
+        twin = NodeLoadStore(tensors)
+        twin.bulk_ingest(
+            (n.name, n.annotations) for n in client.list_nodes()
+        )
+        order = [twin.node_id(n) for n in batch.store.node_names]
+        np.testing.assert_array_equal(
+            batch.store.values[: len(batch.store)], twin.values[order]
+        )
+        np.testing.assert_array_equal(
+            batch.store.ts[: len(batch.store)], twin.ts[order]
+        )
+
+        # unchanged mirror: the version gate skips re-ingest entirely
+        v = batch.store.version
+        batch.refresh()
+        assert batch.refresh_stats["columnar_ingest"] == 1
+        assert batch.store.version == v
+
+        # any mirror change invalidates the columns; the object path
+        # takes over and the store still converges
+        stub.state.add_node(
+            "node-new", "10.0.9.9",
+            {metric: f"0.99900,2026-01-01T00:00:00Z"},
+        )
+        assert _wait_until(lambda: client.get_node("node-new") is not None)
+        batch.refresh()
+        assert batch.refresh_stats["columnar_ingest"] == 1
+        assert "node-new" in batch.store.node_names
+    finally:
+        client.stop()
+
+
+# -- read-path telemetry -------------------------------------------------
+
+def test_read_path_metrics_populate(stub):
+    from crane_scheduler_tpu.telemetry import Telemetry
+    from crane_scheduler_tpu.telemetry.expfmt import parse_exposition
+
+    tel = Telemetry()
+    for i in range(8):
+        stub.state.add_node(f"node-{i}", f"10.0.0.{i}")
+    client = KubeClusterClient(stub.url, telemetry=tel)
+    try:
+        client.start()
+        for i in range(20):
+            stub.state.add_pod("d", f"p{i}", spec={"nodeName": "node-0"})
+        assert _wait_until(
+            lambda: client.get_pod("d/p19") is not None, timeout=10.0
+        )
+        text = tel.registry.render()
+        families = parse_exposition(text)
+        assert "crane_kube_list_decode_seconds" in families
+        assert "crane_kube_watch_apply_batch_pods" in families
+        # decode ran at least twice (nodes + pods initial lists)
+        counts = [
+            value
+            for name, _labels, value in
+            families["crane_kube_list_decode_seconds"]["samples"]
+            if name.endswith("_count")
+        ]
+        assert sum(counts) >= 2
+    finally:
+        client.stop()

@@ -103,6 +103,63 @@ def main() -> int:
             "/debug/trace",
             any(e.get("ph") == "X" for e in trace.get("traceEvents", ())),
         )
+
+        # 5. kube read-path metrics: a telemetry-carrying client against
+        # an in-process stub apiserver must populate the round-7 decode
+        # and coalesced-apply families, and the registry must still pass
+        # the strict parser with them present
+        import importlib.util
+        import time as _time
+
+        from crane_scheduler_tpu.cluster.kube import KubeClusterClient
+        from crane_scheduler_tpu.telemetry import Telemetry
+
+        stub_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tests", "kube_stub.py",
+        )
+        stub_spec = importlib.util.spec_from_file_location(
+            "kube_stub_smoke", stub_path
+        )
+        kube_stub = importlib.util.module_from_spec(stub_spec)
+        stub_spec.loader.exec_module(kube_stub)
+        stub = kube_stub.KubeStubServer().start()
+        tel = Telemetry()
+        client = KubeClusterClient(stub.url, telemetry=tel)
+        try:
+            for i in range(4):
+                stub.state.add_node(f"n{i}", f"10.0.0.{i}", {"m": "0.5,x"})
+            client.start()
+            stub.state.add_pod("d", "p0", spec={"nodeName": "n0"})
+            deadline = _time.time() + 10
+            while client.get_pod("d/p0") is None and _time.time() < deadline:
+                _time.sleep(0.02)
+            text = tel.registry.render()
+            try:
+                families = parse_exposition(text)
+                check("kube registry strict parse", True,
+                      f"{len(families)} families")
+            except ExpositionError as e:
+                families = {}
+                check("kube registry strict parse", False, str(e))
+            for required in (
+                "crane_kube_list_decode_seconds",
+                "crane_kube_watch_apply_batch_pods",
+                "crane_kube_watch_coalesced_total",
+            ):
+                check(f"family {required}", required in families)
+            decode_count = sum(
+                s[2]
+                for s in families.get(
+                    "crane_kube_list_decode_seconds", {}
+                ).get("samples", ())
+                if s[0].endswith("_count")
+            )
+            check("list decode observed", decode_count >= 2,
+                  f"count={decode_count}")
+        finally:
+            client.stop()
+            stub.stop()
     finally:
         server.stop()
 
