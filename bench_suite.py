@@ -13,6 +13,11 @@ full-loop configs, end to end.
   9. read path: 50k-node mirror bootstrap/relist + cold store ingest +
      watch-storm apply, round-6 per-object decode vs columnar streaming
      decode + coalesced apply (mirror parity asserted across legs)
+ 10. serving path: closed-loop concurrent /v1/score clients against a
+     live sidecar at 5k AND 50k nodes, r07 serving (HTTP/1.0
+     connection-per-request + per-request refresh + per-node render
+     loop) vs the keep-alive coalesced/cached front end (verdict
+     parity and response byte-identity asserted in-run)
 
 Each config reports a JSON line to stdout with wall-clock timings.
 Configs 1-3 run the full loop (annotator sync through real annotation
@@ -1076,10 +1081,210 @@ def config9(dtype, rtt, n_nodes=50_000, storm_events=20_000):
               "~1k-node annotation sample across legs"})
 
 
+def config10(dtype, rtt, node_scales=(5_000, 50_000)):
+    """Round-8 tentpole gate: concurrent ``POST /v1/score`` throughput
+    against a LIVE sidecar, before vs after the serving-path rebuild.
+
+    Two legs per node scale, same simulated cluster:
+
+      r07_serving — the round-7 shipped serving path, reproduced
+                    in-run: ``ThreadingHTTPServer`` forced to HTTP/1.0
+                    (one TCP connection per request), the service in
+                    ``legacy_mode`` (forced full refresh per request,
+                    per-node bool()/int() render loop, the whole
+                    request under the one service lock);
+      coalesced   — the new default: selectors keep-alive front end +
+                    version-gated single-flight refresh + coalesced
+                    dispatch + version-keyed pre-rendered responses.
+
+    Closed loop: ``clients`` threads each run one request at a time
+    for ``duration_s`` (keep-alive when the server allows it,
+    reconnect when it closes — exactly what the leg's protocol
+    dictates). In-run gates: verdicts byte-for-byte identical across
+    legs at a fixed ``now`` (minus the staleness field), and on the
+    after leg a cold render, a cache hit, and a concurrent storm all
+    return the SAME bytes."""
+    import http.client
+    import threading
+
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+    from crane_scheduler_tpu.service import ScoringHTTPServer, ScoringService
+
+    clients, duration_s = 8, 2.0
+    results = {}
+
+    def run_clients(port, n, stop_at):
+        lats = []
+        lock = threading.Lock()
+        errors = []
+
+        def loop():
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+            mine = []
+            body = b"{}"
+            try:
+                while time.perf_counter() < stop_at:
+                    t0 = time.perf_counter()
+                    try:
+                        conn.request(
+                            "POST", "/v1/score", body=body,
+                            headers={"Content-Type": "application/json"},
+                        )
+                        resp = conn.getresponse()
+                        data = resp.read()
+                        if resp.status != 200 or not data:
+                            errors.append(f"status {resp.status}")
+                            return
+                        mine.append(time.perf_counter() - t0)
+                        if resp.will_close:
+                            conn.close()
+                            conn = http.client.HTTPConnection(
+                                "127.0.0.1", port, timeout=60
+                            )
+                    except (http.client.HTTPException, OSError) as e:
+                        # server-side close racing our write: reconnect
+                        conn.close()
+                        conn = http.client.HTTPConnection(
+                            "127.0.0.1", port, timeout=60
+                        )
+            finally:
+                conn.close()
+                with lock:
+                    lats.extend(mine)
+
+        threads = [threading.Thread(target=loop) for _ in range(n)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        assert not errors, errors[:3]
+        assert lats, "no requests completed"
+        arr = np.asarray(sorted(lats))
+        return {
+            "requests": len(lats),
+            "requests_per_sec": round(len(lats) / wall, 1),
+            "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 2),
+            "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 2),
+        }
+
+    for n_nodes in node_scales:
+        t0 = time.perf_counter()
+        sim = _sim(n_nodes, seed=8)
+        seed_ms = (time.perf_counter() - t0) * 1e3
+        fixed_now = sim.clock.now()
+        legs, parity = {}, {}
+        for mode in ("r07_serving", "coalesced"):
+            svc = ScoringService(sim.cluster, DEFAULT_POLICY, dtype=dtype)
+            svc.refresh()
+            if mode == "r07_serving":
+                svc.legacy_mode = True
+                server = ScoringHTTPServer(
+                    svc, port=0, frontend="threaded", protocol="HTTP/1.0"
+                )
+            else:
+                server = ScoringHTTPServer(svc, port=0)
+            server.start()
+            try:
+                # warm the jit cache outside the timed window
+                svc.score_response_bytes(now=fixed_now, refresh=False)
+                legs[mode] = run_clients(
+                    server.port, clients,
+                    time.perf_counter() + duration_s,
+                )
+                body = svc.score_response_bytes(now=fixed_now, refresh=True)
+                verdicts = json.loads(body)
+                verdicts.pop("stalenessSeconds")
+                parity[mode] = verdicts
+                if mode == "coalesced":
+                    # cold render == cache hit == concurrent storm bytes
+                    svc._resp_cache.clear()
+                    cold = svc.score_response_bytes(
+                        now=fixed_now, refresh=False
+                    )
+                    hit = svc.score_response_bytes(
+                        now=fixed_now, refresh=False
+                    )
+                    stormed = []
+                    barrier = threading.Barrier(6)
+
+                    def one():
+                        barrier.wait()
+                        stormed.append(svc.score_response_bytes(
+                            now=fixed_now, refresh=False
+                        ))
+
+                    ts = [threading.Thread(target=one) for _ in range(6)]
+                    for t in ts:
+                        t.start()
+                    for t in ts:
+                        t.join()
+                    assert len({bytes(b) for b in
+                                [cold, hit, *stormed]}) == 1, \
+                        "coalesced/cached responses not byte-identical"
+                    m = svc.metrics()
+                    legs[mode]["coalesced_scores"] = m["coalesced_scores"]
+                    legs[mode]["response_cache_hits"] = \
+                        m["response_cache_hits"]
+                    legs[mode]["refresh_skips"] = m["refresh_skips"]
+                    legs[mode]["refreshes"] = m["refreshes"]
+                    legs[mode]["connections_accepted"] = \
+                        server.connections_accepted
+                else:
+                    legs[mode]["refreshes"] = svc.metrics()["refreshes"]
+            finally:
+                server.stop()
+            log(f"config10[{n_nodes}n/{mode}]: "
+                f"{legs[mode]['requests_per_sec']:,.0f} req/s, "
+                f"p50 {legs[mode]['p50_ms']}ms, "
+                f"p99 {legs[mode]['p99_ms']}ms")
+        # the serving rebuild must not change a single verdict
+        assert parity["r07_serving"] == parity["coalesced"], \
+            "serving parity violation: verdicts diverged between legs"
+        before, after = legs["r07_serving"], legs["coalesced"]
+        results[n_nodes] = {
+            "seed_ms": round(seed_ms, 1),
+            "legs": legs,
+            "speedup_rps": round(
+                after["requests_per_sec"]
+                / max(before["requests_per_sec"], 1e-9), 2),
+            "p99_ratio": round(
+                after["p99_ms"] / max(before["p99_ms"], 1e-9), 3),
+            "verdict_parity": "ok",
+        }
+    big = results[max(node_scales)]
+    emit({"config": 10,
+          "desc": "serving path, live sidecar: "
+                  f"{clients} closed-loop /v1/score clients x "
+                  f"{duration_s:.0f}s per leg at "
+                  f"{'/'.join(str(n) for n in node_scales)} nodes, "
+                  "r07 serving (HTTP/1.0 conn-per-request + forced "
+                  "refresh + per-node render under one lock) vs "
+                  "keep-alive coalesced/cached front end (same sim, "
+                  "same run)",
+          "requests_per_sec": big["legs"]["coalesced"]["requests_per_sec"],
+          "requests_per_sec_r07": big["legs"]["r07_serving"]["requests_per_sec"],
+          "speedup_rps": big["speedup_rps"],
+          "p99_ms": big["legs"]["coalesced"]["p99_ms"],
+          "p99_ms_r07": big["legs"]["r07_serving"]["p99_ms"],
+          "scales": {str(k): v for k, v in results.items()},
+          "verdict_parity": "ok",
+          "note": "r07_serving reproduces the round-7 shipped path "
+                  "in-run (legacy_mode + ThreadingHTTPServer/HTTP1.0); "
+                  "gates: verdict parity across legs, byte-identical "
+                  "cold/cached/stormed responses on the after leg"})
+    big_speedup = big["speedup_rps"]
+    assert big_speedup >= 3.0, \
+        f"serving speedup gate: {big_speedup}x < 3x at 50k nodes"
+    assert big["p99_ratio"] <= 1.0, \
+        f"p99 regression: ratio {big['p99_ratio']}"
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--device", choices=["cpu", "default"], default="default")
-    parser.add_argument("--configs", default="1,2,3,4,5,6,7,7b,8,9")
+    parser.add_argument("--configs", default="1,2,3,4,5,6,7,7b,8,9,10")
     parser.add_argument("--f64", action="store_true")
     args = parser.parse_args(argv)
 
@@ -1115,6 +1320,8 @@ def main(argv=None) -> int:
         config8(dtype, rtt)
     if 9 in todo:
         config9(dtype, rtt)
+    if 10 in todo:
+        config10(dtype, rtt)
     return 0
 
 

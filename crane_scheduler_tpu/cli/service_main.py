@@ -37,6 +37,18 @@ def main(argv=None) -> int:
                              "over pooled keep-alive connections)")
     parser.add_argument("--f32", action="store_true")
     parser.add_argument("--run-seconds", type=float, default=0.0)
+    parser.add_argument("--frontend", choices=["async", "threaded"],
+                        default=None,
+                        help="HTTP front end: the selectors-based "
+                             "keep-alive server (async, default) or the "
+                             "stdlib ThreadingHTTPServer fallback")
+    parser.add_argument("--http-workers", type=int, default=8,
+                        help="request-handler threads behind the async "
+                             "front end")
+    parser.add_argument("--now-bucket", type=float, default=0.25,
+                        help="seconds to quantize implicit `now` to: the "
+                             "coalescing/response-cache key quantum for "
+                             "concurrent /v1/score requests (0 disables)")
     # multi-host (DCN): every process serves its node shard; see
     # parallel.distributed and doc/ — all three flags set => distributed
     parser.add_argument("--coordinator-address", default=None,
@@ -102,13 +114,17 @@ def main(argv=None) -> int:
         cluster = ClusterState()
 
     service = ScoringService(
-        cluster, policy, dtype=jnp.float32 if args.f32 else jnp.float64
+        cluster, policy, dtype=jnp.float32 if args.f32 else jnp.float64,
+        now_bucket_s=args.now_bucket,
     )
     service.refresh()
-    server = ScoringHTTPServer(service, port=args.port)
+    server = ScoringHTTPServer(
+        service, port=args.port, frontend=args.frontend,
+        workers=args.http_workers,
+    )
     server.start()
     print(
-        f"scoring service on :{server.port} "
+        f"scoring service on :{server.port} [{server.frontend}] "
         "(/v1/score /v1/assign /metrics /debug/decisions /debug/trace)",
         flush=True,
     )

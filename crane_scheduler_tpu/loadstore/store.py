@@ -62,6 +62,9 @@ class DeviceSnapshot:
     node_valid: np.ndarray  # [Npad] bool
     n_nodes: int
     node_names: tuple[str, ...]
+    # store version the snapshot was taken at (-1 = synthetic snapshot):
+    # response caches and device-resident copies key on this
+    version: int = -1
 
 
 class NodeLoadStore:
@@ -589,4 +592,5 @@ class NodeLoadStore:
             node_valid=node_valid,
             n_nodes=n,
             node_names=tuple(self._names),
+            version=self._version,
         )

@@ -1,0 +1,429 @@
+"""Selectors-based keep-alive HTTP/1.1 front end for the scoring service.
+
+One non-blocking IO thread owns every socket. It accepts, drains each
+readable socket's buffered backlog per wakeup, and frames requests out
+of a per-connection byte buffer exactly like the PR 4 watch-stream
+parser: bytes accumulate however the kernel tore them, and complete
+requests (request line + headers + Content-Length body) are carved off
+incrementally. Handling runs on a small worker pool — each connection
+has at most ONE handler job in flight, which consumes that connection's
+parsed backlog FIFO and hands one rendered byte-string back to the IO
+thread. So:
+
+- responses to pipelined requests stay in request order by construction;
+- a pipelined burst costs one job dispatch and one ``send``, not one
+  thread per request;
+- connections are keep-alive by default (HTTP/1.1 semantics; a
+  ``Connection: close`` request or an HTTP/1.0 request without
+  ``keep-alive`` closes after the response).
+
+The stdlib ``ThreadingHTTPServer`` front end (``frontend="threaded"``
+on ``ScoringHTTPServer``) stays as the comparison/fallback path.
+"""
+
+from __future__ import annotations
+
+import selectors
+import socket
+import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+
+_MAX_HEADER_BYTES = 64 * 1024
+_MAX_BODY_BYTES = 64 << 20
+_RECV_CHUNK = 1 << 18
+
+_REASONS = {
+    200: "OK",
+    400: "Bad Request",
+    404: "Not Found",
+    413: "Payload Too Large",
+    431: "Request Header Fields Too Large",
+    500: "Internal Server Error",
+    501: "Not Implemented",
+}
+
+
+def render_response(
+    status: int, content_type: str, body: bytes, close: bool = False
+) -> bytes:
+    head = (
+        f"HTTP/1.1 {status} {_REASONS.get(status, 'OK')}\r\n"
+        f"Content-Type: {content_type}\r\n"
+        f"Content-Length: {len(body)}\r\n"
+    )
+    if close:
+        head += "Connection: close\r\n"
+    return (head + "\r\n").encode("latin-1") + body
+
+
+class _Conn:
+    __slots__ = (
+        "sock", "fd", "inbuf", "outbuf", "scan_from", "head_end",
+        "body_len", "req_head", "pending", "job_active", "close_after",
+        "read_eof", "lock", "registered", "dead", "writes_queued",
+    )
+
+    def __init__(self, sock):
+        self.sock = sock
+        self.fd = sock.fileno()
+        self.inbuf = bytearray()
+        self.outbuf = bytearray()
+        self.scan_from = 0  # resume point for the \r\n\r\n search
+        self.head_end = None  # byte offset past the parsed header block
+        self.body_len = 0
+        self.req_head = None  # (method, target, headers, keep_alive)
+        self.pending: list = []  # parsed requests awaiting the worker
+        self.job_active = False
+        self.close_after = False  # close once outbuf drains and job ends
+        self.read_eof = False
+        self.lock = threading.Lock()
+        self.registered = 0  # current selector interest mask
+        self.dead = False
+        self.writes_queued = 0  # responses enqueued but not yet drained
+
+
+class AsyncHTTPServer:
+    """The non-blocking front end. ``handler`` is the transport-agnostic
+    router: ``(method, target, headers, body) -> (status, content_type,
+    body_bytes)``; it runs on the worker pool and may block (device
+    dispatch, single-flight waits)."""
+
+    def __init__(self, handler, host: str = "127.0.0.1", port: int = 0,
+                 workers: int = 8):
+        self._handler = handler
+        self._listener = socket.create_server((host, port), backlog=512)
+        self._listener.setblocking(False)
+        self._sel = selectors.DefaultSelector()
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self._pool = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="crane-http"
+        )
+        self._conns: dict[int, _Conn] = {}
+        self._writes: deque = deque()  # (conn, bytes, close) from workers
+        self._writes_lock = threading.Lock()
+        self._stopping = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.connections_accepted = 0
+
+    @property
+    def port(self) -> int:
+        return self._listener.getsockname()[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopping.set()
+        self._wakeup()
+        if self._thread:
+            self._thread.join(timeout=5.0)
+        self._pool.shutdown(wait=False)
+        try:
+            self._wake_w.close()
+        except OSError:
+            pass
+
+    def _wakeup(self) -> None:
+        try:
+            self._wake_w.send(b"x")
+        except OSError:
+            pass
+
+    # -- IO thread ---------------------------------------------------------
+
+    def _run(self) -> None:
+        sel = self._sel
+        sel.register(self._listener, selectors.EVENT_READ, "accept")
+        sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        try:
+            while not self._stopping.is_set():
+                for key, events in sel.select(timeout=1.0):
+                    if key.data == "accept":
+                        self._accept()
+                    elif key.data == "wake":
+                        self._drain_wake()
+                        self._drain_writes()
+                    else:
+                        conn = key.data
+                        if events & selectors.EVENT_READ and not conn.dead:
+                            self._on_readable(conn)
+                        if events & selectors.EVENT_WRITE and not conn.dead:
+                            self._flush(conn)
+        finally:
+            for conn in list(self._conns.values()):
+                self._close_conn(conn)
+            for sock in (self._listener, self._wake_r):
+                try:
+                    sel.unregister(sock)
+                except (KeyError, ValueError):
+                    pass
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            sel.close()
+
+    def _drain_wake(self) -> None:
+        try:
+            while self._wake_r.recv(4096):
+                pass
+        except (BlockingIOError, OSError):
+            pass
+
+    def _accept(self) -> None:
+        while True:
+            try:
+                sock, _ = self._listener.accept()
+            except (BlockingIOError, OSError):
+                return
+            sock.setblocking(False)
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            conn = _Conn(sock)
+            self._conns[conn.fd] = conn
+            self.connections_accepted += 1
+            self._sel.register(sock, selectors.EVENT_READ, conn)
+            conn.registered = selectors.EVENT_READ
+
+    def _on_readable(self, conn: _Conn) -> None:
+        try:
+            while True:
+                try:
+                    chunk = conn.sock.recv(_RECV_CHUNK)
+                except BlockingIOError:
+                    break
+                if not chunk:
+                    conn.read_eof = True
+                    break
+                conn.inbuf += chunk
+        except OSError:
+            self._close_conn(conn)
+            return
+        self._parse_requests(conn)
+        if conn.dead:
+            return
+        if conn.read_eof:
+            conn.close_after = True
+        self._update_interest(conn)
+        self._maybe_close(conn)
+
+    def _parse_requests(self, conn: _Conn) -> None:
+        """Carve every complete request out of the connection buffer —
+        the whole pipelined backlog lands as one worker batch."""
+        batch: list = []
+        while True:
+            if conn.req_head is None:
+                idx = conn.inbuf.find(b"\r\n\r\n", conn.scan_from)
+                if idx < 0:
+                    if len(conn.inbuf) > _MAX_HEADER_BYTES:
+                        self._reject(conn, 431)
+                        return
+                    conn.scan_from = max(0, len(conn.inbuf) - 3)
+                    break
+                if not self._parse_head(conn, bytes(conn.inbuf[:idx])):
+                    return  # rejected
+                conn.head_end = idx + 4
+            total = conn.head_end + conn.body_len
+            if len(conn.inbuf) < total:
+                break
+            body = bytes(conn.inbuf[conn.head_end:total])
+            del conn.inbuf[:total]
+            conn.scan_from = 0
+            method, target, headers, keep = conn.req_head
+            conn.req_head = None
+            conn.head_end = None
+            conn.body_len = 0
+            batch.append((method, target, headers, body, keep))
+            if not keep:
+                # the client promised no more requests on this socket
+                conn.inbuf.clear()
+                conn.read_eof = True
+                break
+        if batch:
+            with conn.lock:
+                conn.pending.extend(batch)
+                if not conn.job_active:
+                    conn.job_active = True
+                    try:
+                        self._pool.submit(self._conn_job, conn)
+                    except RuntimeError:  # pool shut down mid-stop
+                        conn.job_active = False
+
+    def _parse_head(self, conn: _Conn, head: bytes) -> bool:
+        try:
+            lines = head.split(b"\r\n")
+            method_b, target_b, version_b = lines[0].split(b" ", 2)
+            method = method_b.decode("latin-1")
+            target = target_b.decode("latin-1")
+            version = version_b.decode("latin-1").strip()
+            headers: dict[str, str] = {}
+            for line in lines[1:]:
+                if not line:
+                    continue
+                name, sep, value = line.partition(b":")
+                if not sep:
+                    raise ValueError("malformed header line")
+                headers[name.decode("latin-1").strip().lower()] = (
+                    value.decode("latin-1").strip()
+                )
+        except (ValueError, UnicodeDecodeError):
+            self._reject(conn, 400)
+            return False
+        if headers.get("transfer-encoding"):
+            self._reject(conn, 501)
+            return False
+        try:
+            body_len = int(headers.get("content-length") or 0)
+        except ValueError:
+            self._reject(conn, 400)
+            return False
+        if body_len < 0:
+            self._reject(conn, 400)
+            return False
+        if body_len > _MAX_BODY_BYTES:
+            self._reject(conn, 413)
+            return False
+        conn_hdr = headers.get("connection", "").lower()
+        if version == "HTTP/1.0":
+            keep = "keep-alive" in conn_hdr
+        else:
+            keep = "close" not in conn_hdr
+        conn.req_head = (method, target, headers, keep)
+        conn.body_len = body_len
+        return True
+
+    def _reject(self, conn: _Conn, status: int) -> None:
+        """Protocol-level error: answer and drop the connection (IO
+        thread context — write directly, no worker round-trip)."""
+        body = b'{"error": "bad request"}'
+        conn.outbuf += render_response(
+            status, "application/json", body, close=True
+        )
+        conn.inbuf.clear()
+        conn.read_eof = True
+        conn.close_after = True
+        self._flush(conn)
+
+    # -- worker side -------------------------------------------------------
+
+    def _conn_job(self, conn: _Conn) -> None:
+        handler = self._handler
+        while True:
+            with conn.lock:
+                batch = conn.pending
+                if not batch:
+                    conn.job_active = False
+                    if conn.close_after:
+                        # the IO thread may have seen job_active=True and
+                        # skipped the close — nudge it to re-check
+                        self._enqueue_write(conn, b"", False)
+                    return
+                conn.pending = []
+            out = bytearray()
+            close = False
+            for method, target, headers, body, keep in batch:
+                try:
+                    status, ctype, payload = handler(
+                        method, target, headers, body
+                    )
+                except Exception:
+                    status, ctype, payload = (
+                        500, "application/json", b'{"error": "internal error"}'
+                    )
+                if not keep:
+                    close = True
+                out += render_response(status, ctype, payload, close=not keep)
+            self._enqueue_write(conn, bytes(out), close)
+            if close:
+                with conn.lock:
+                    conn.job_active = False
+                return
+
+    def _enqueue_write(self, conn: _Conn, data: bytes, close: bool) -> None:
+        with self._writes_lock:
+            conn.writes_queued += 1
+            self._writes.append((conn, data, close))
+        self._wakeup()
+
+    def _drain_writes(self) -> None:
+        while True:
+            with self._writes_lock:
+                if not self._writes:
+                    return
+                conn, data, close = self._writes.popleft()
+                conn.writes_queued -= 1
+            if conn.dead:
+                continue
+            conn.outbuf += data
+            if close:
+                conn.close_after = True
+            self._flush(conn)
+
+    # -- write path (IO thread) --------------------------------------------
+
+    def _flush(self, conn: _Conn) -> None:
+        try:
+            while conn.outbuf:
+                sent = conn.sock.send(conn.outbuf)
+                del conn.outbuf[:sent]
+        except BlockingIOError:
+            pass
+        except OSError:
+            self._close_conn(conn)
+            return
+        self._update_interest(conn)
+        self._maybe_close(conn)
+
+    def _update_interest(self, conn: _Conn) -> None:
+        if conn.dead:
+            return
+        events = 0
+        if not conn.read_eof:
+            events |= selectors.EVENT_READ
+        if conn.outbuf:
+            events |= selectors.EVENT_WRITE
+        if events == conn.registered:
+            return
+        try:
+            if conn.registered == 0:
+                if events:
+                    self._sel.register(conn.sock, events, conn)
+            elif events == 0:
+                self._sel.unregister(conn.sock)
+            else:
+                self._sel.modify(conn.sock, events, conn)
+        except (KeyError, ValueError, OSError):
+            pass
+        conn.registered = events
+
+    def _maybe_close(self, conn: _Conn) -> None:
+        if conn.dead or not conn.close_after or conn.outbuf:
+            return
+        with conn.lock:
+            busy = conn.job_active or bool(conn.pending)
+        # a finished job may have handed its response to _writes but not
+        # yet been drained into outbuf — closing now would drop it
+        if not busy and not conn.writes_queued:
+            self._close_conn(conn)
+
+    def _close_conn(self, conn: _Conn) -> None:
+        if conn.dead:
+            return
+        conn.dead = True
+        if conn.registered:
+            try:
+                self._sel.unregister(conn.sock)
+            except (KeyError, ValueError, OSError):
+                pass
+            conn.registered = 0
+        self._conns.pop(conn.fd, None)
+        try:
+            conn.sock.close()
+        except OSError:
+            pass

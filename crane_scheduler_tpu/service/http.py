@@ -7,6 +7,18 @@
   per-node verdicts; ``GET /metrics`` exports the counters the reference
   never had; ``GET /healthz`` for probes.
 
+Two front ends share one transport-agnostic ``ServiceRouter`` (so both
+produce byte-identical payloads):
+
+- ``frontend="async"`` (default) — the selectors-based keep-alive
+  HTTP/1.1 server (``service.frontend``): one IO thread drains each
+  socket's pipelined backlog per wakeup, a small worker pool handles
+  requests, and concurrent ``/v1/score`` requests coalesce in the
+  service layer (doc/serving.md);
+- ``frontend="threaded"`` — the stdlib ``ThreadingHTTPServer``
+  comparison/fallback path (keep-alive too: ``protocol_version`` is
+  HTTP/1.1 and Content-Length is always sent).
+
 ``/metrics`` content-negotiates: a scraper Accept header mentioning
 ``text/plain`` or ``openmetrics`` gets the Prometheus text exposition
 (rendered by the telemetry registry); anything else gets the legacy
@@ -15,98 +27,126 @@ JSON counters, so pre-telemetry clients keep working unchanged.
 (``?n=`` caps the newest entries) and ``GET /debug/trace`` the
 Chrome trace-event JSON of the recorded spans.
 
-Stdlib-only (http.server with a thread pool via ThreadingHTTPServer).
+Stdlib-only.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .scoring import ScoringService
 
+_JSON = "application/json"
+_ENDPOINTS = (
+    "/healthz", "/metrics", "/debug/decisions", "/debug/trace",
+    "/v1/score", "/v1/assign", "/v1/refresh",
+)
 
-class _Handler(BaseHTTPRequestHandler):
-    service: ScoringService = None  # set by server factory
 
-    def _send(self, code: int, payload) -> None:
-        body = json.dumps(payload).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+class ServiceRouter:
+    """Transport-independent request handling shared by both front ends:
+    ``(method, target, headers, body) -> (status, content_type, bytes)``.
+    ``headers`` keys are lower-cased."""
 
-    def _send_text(self, code: int, text: str, content_type: str) -> None:
-        body = text.encode()
-        self.send_response(code)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+    def __init__(self, service: ScoringService):
+        self.service = service
+        reg = service.telemetry.registry
+        self._m_request_seconds = reg.histogram(
+            "crane_service_request_seconds",
+            "Service request handling latency",
+            labelnames=("endpoint",),
+        )
+        self._m_inflight = reg.gauge(
+            "crane_service_inflight", "Requests currently being handled"
+        )
 
-    def _wants_exposition(self) -> bool:
+    def handle(self, method, target, headers, body):
+        path, _, _ = target.partition("?")
+        endpoint = path if path in _ENDPOINTS else "other"
+        self._m_inflight.inc()
+        start = time.perf_counter()
+        try:
+            try:
+                return self._route(method, target, headers, body)
+            except Exception:
+                return 500, _JSON, json.dumps(
+                    {"error": "internal error"}
+                ).encode()
+        finally:
+            self._m_inflight.dec()
+            self._m_request_seconds.labels(endpoint=endpoint).observe(
+                time.perf_counter() - start
+            )
+
+    @staticmethod
+    def _json(code: int, payload) -> tuple[int, str, bytes]:
+        return code, _JSON, json.dumps(payload).encode()
+
+    @staticmethod
+    def _wants_exposition(headers) -> bool:
         """Prometheus/OpenMetrics scrapers name text formats in Accept;
         legacy JSON clients (no Accept, */*, application/json) don't."""
-        accept = (self.headers.get("Accept") or "").lower()
+        accept = (headers.get("accept") or "").lower()
         return "text/plain" in accept or "openmetrics" in accept
 
-    def do_GET(self):
-        path, _, query = self.path.partition("?")
+    def _route(self, method, target, headers, body):
+        if method == "GET":
+            return self._route_get(target, headers)
+        if method == "POST":
+            return self._route_post(target, body)
+        return self._json(404, {"error": "not found"})
+
+    def _route_get(self, target, headers):
+        service = self.service
+        path, _, query = target.partition("?")
         if path == "/healthz":
-            self._send(200, {"status": "ok"})
-        elif path == "/metrics":
-            if self._wants_exposition():
-                self._send_text(
+            return self._json(200, {"status": "ok"})
+        if path == "/metrics":
+            if self._wants_exposition(headers):
+                return (
                     200,
-                    self.service.render_prometheus(),
                     "text/plain; version=0.0.4; charset=utf-8",
+                    service.render_prometheus().encode(),
                 )
-            else:
-                self._send(200, self.service.metrics())
-        elif path == "/debug/decisions":
-            limit = None
+            return self._json(200, service.metrics())
+        if path == "/debug/decisions":
             from urllib.parse import parse_qs
 
             try:
                 n = parse_qs(query).get("n", [None])[0]
                 limit = int(n) if n is not None else None
             except ValueError:
-                self._send(400, {"error": "n must be an integer"})
-                return
-            buf = self.service.telemetry.decisions
-            self._send(
+                return self._json(400, {"error": "n must be an integer"})
+            buf = service.telemetry.decisions
+            return self._json(
                 200,
                 {"stats": buf.stats(), "decisions": buf.snapshot(limit=limit)},
             )
-        elif path == "/debug/trace":
-            self._send(200, self.service.telemetry.export_chrome_trace())
-        else:
-            self._send(404, {"error": "not found"})
+        if path == "/debug/trace":
+            return self._json(200, service.telemetry.export_chrome_trace())
+        return self._json(404, {"error": "not found"})
 
-    def do_POST(self):
-        length = int(self.headers.get("Content-Length") or 0)
-        raw = self.rfile.read(length) if length else b"{}"
+    def _route_post(self, target, body):
+        service = self.service
+        path, _, _ = target.partition("?")
         try:
-            req = json.loads(raw or b"{}")
+            req = json.loads(body or b"{}")
         except ValueError:
-            self._send(400, {"error": "invalid JSON"})
-            return
-        if self.path == "/v1/score":
-            if req.get("refresh", True):
-                self.service.refresh()
-            verdicts = self.service.score_batch(now=req.get("now"))
-            self._send(
-                200,
-                {
-                    "backend": verdicts.backend,
-                    "stalenessSeconds": verdicts.staleness_seconds,
-                    "schedulable": verdicts.schedulable,
-                    "scores": verdicts.scores,
-                },
+            return self._json(400, {"error": "invalid JSON"})
+        if path == "/v1/score":
+            now = req.get("now")
+            if now is not None and not isinstance(now, (int, float)):
+                return self._json(400, {"error": "now must be a number"})
+            # pre-rendered, coalesced, version-keyed (doc/serving.md)
+            rendered = service.score_response_bytes(
+                now=now, refresh=req.get("refresh", True)
             )
-        elif self.path == "/v1/assign":
+            return 200, _JSON, rendered
+        if path == "/v1/assign":
             try:
                 num_pods = int(req.get("numPods", 0))
                 capacity = req.get("capacity")
@@ -116,51 +156,127 @@ class _Handler(BaseHTTPRequestHandler):
                 if now is not None:
                     now = float(now)
             except (TypeError, ValueError, AttributeError):
-                self._send(400, {
+                return self._json(400, {
                     "error": "numPods must be an integer, capacity a "
                              "{node: int} map, now a number",
                 })
-                return
             if req.get("refresh", True):
-                self.service.refresh()
-            assignment = self.service.assign_batch(
+                service.refresh_coalesced()
+            assignment = service.assign_batch(
                 num_pods, capacity=capacity, now=now,
             )
-            self._send(
-                200,
-                {
-                    "backend": assignment.backend,
-                    "stalenessSeconds": assignment.staleness_seconds,
-                    "counts": assignment.counts,
-                    "unassigned": assignment.unassigned,
-                    "waterline": assignment.waterline,
-                },
+            return self._json(200, {
+                "backend": assignment.backend,
+                "stalenessSeconds": assignment.staleness_seconds,
+                "counts": assignment.counts,
+                "unassigned": assignment.unassigned,
+                "waterline": assignment.waterline,
+            })
+        if path == "/v1/refresh":
+            # forced (not version-gated), but concurrent forces merge
+            service._refresh_flight.run(
+                ("force", service._cluster_version()), service.refresh
             )
-        elif self.path == "/v1/refresh":
-            self.service.refresh()
-            self._send(200, {"status": "ok", "nodes": len(self.service.store)})
-        else:
-            self._send(404, {"error": "not found"})
+            return self._json(
+                200, {"status": "ok", "nodes": len(service.store)}
+            )
+        return self._json(404, {"error": "not found"})
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # keep-alive on the fallback threaded server too: HTTP/1.1 framing
+    # (Content-Length is always sent), not one TCP connection per request
+    protocol_version = "HTTP/1.1"
+    router: ServiceRouter = None  # set by server factory
+
+    def _dispatch(self, method: str) -> None:
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        headers = {k.lower(): v for k, v in self.headers.items()}
+        status, ctype, payload = self.router.handle(
+            method, self.path, headers, body
+        )
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
 
     def log_message(self, *args):
         pass
 
 
 class ScoringHTTPServer:
-    def __init__(self, service: ScoringService, host: str = "127.0.0.1", port: int = 0):
-        handler = type("BoundHandler", (_Handler,), {"service": service})
-        self.httpd = ThreadingHTTPServer((host, port), handler)
+    """The sidecar server. ``frontend`` selects the transport: "async"
+    (default; selectors-based keep-alive front end) or "threaded" (the
+    stdlib fallback). ``CRANE_SERVICE_FRONTEND`` overrides the default.
+    ``protocol`` only applies to the threaded front end (bench config 10
+    uses "HTTP/1.0" to reproduce the r07 connection-per-request leg)."""
+
+    def __init__(
+        self,
+        service: ScoringService,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        frontend: str | None = None,
+        workers: int = 8,
+        protocol: str = "HTTP/1.1",
+    ):
+        if frontend is None:
+            frontend = os.environ.get("CRANE_SERVICE_FRONTEND", "async")
+        if frontend not in ("async", "threaded"):
+            raise ValueError(f"unknown frontend {frontend!r}")
+        self.frontend = frontend
+        self.router = ServiceRouter(service)
+        self.httpd = None  # the threaded front end's stdlib server
+        self._async = None
         self._thread: threading.Thread | None = None
+        if frontend == "threaded":
+            handler = type(
+                "BoundHandler",
+                (_Handler,),
+                {"router": self.router, "protocol_version": protocol},
+            )
+            self.httpd = ThreadingHTTPServer((host, port), handler)
+        else:
+            from .frontend import AsyncHTTPServer
+
+            self._async = AsyncHTTPServer(
+                self.router.handle, host=host, port=port, workers=workers
+            )
 
     @property
     def port(self) -> int:
+        if self._async is not None:
+            return self._async.port
         return self.httpd.server_port
 
+    @property
+    def connections_accepted(self) -> int:
+        """Sockets accepted so far (async front end; -1 on threaded)."""
+        if self._async is not None:
+            return self._async.connections_accepted
+        return -1
+
     def start(self) -> None:
-        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        if self._async is not None:
+            self._async.start()
+            return
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
         self._thread.start()
 
     def stop(self) -> None:
+        if self._async is not None:
+            self._async.stop()
+            return
         self.httpd.shutdown()
         if self._thread:
             self._thread.join(timeout=2.0)
@@ -171,6 +287,9 @@ class HealthServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 8090):
         class Handler(BaseHTTPRequestHandler):
+            # keep probe connections alive across requests
+            protocol_version = "HTTP/1.1"
+
             def do_GET(self):
                 if self.path == "/healthz":
                     body = b"ok"

@@ -12,10 +12,31 @@ reference's most load-bearing invariant — **fail-open** (SURVEY §5):
   within the policy windows without blocking scheduling;
 - counters expose scorer latency/staleness/fallbacks — the observability
   the reference lacks (it exports no metrics endpoint at all).
+
+Serving discipline (doc/serving.md): the scorer's output is a pure
+function of (store version, policy, ``now``), so concurrent requests
+that agree on that key legitimately share one device dispatch and one
+rendered response byte-string:
+
+- **single-flight refresh** — the default per-request ``refresh`` is
+  version-gated on the cluster's ``node_version`` and deduped, so a
+  request storm costs one ``bulk_ingest`` per cluster change, not one
+  per request;
+- **coalesced dispatch** — concurrent ``/v1/score`` requests with the
+  same (store version, last refresh, ``now`` bucket) collapse onto one
+  in-flight ``score_batch`` whose result every waiter shares;
+- **version-keyed response cache** — the response body is rendered to
+  bytes once per key (vectorized ``tolist()`` render, no per-node
+  Python loop) and served as a memcpy until a store write changes the
+  version;
+- **lock split** — scoring reads a store snapshot (the store's own
+  lock); the service lock only serializes store mutation (refresh), so
+  a slow refresh never blocks an in-flight score.
 """
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from dataclasses import dataclass, field
@@ -29,15 +50,131 @@ from ..scorer.batched import BatchedScorer
 from ..telemetry import Telemetry
 
 
+class LatencyRing:
+    """Fixed-size latency ring: O(1) record, no list growth/`del`-slice
+    churn under the hot lock (callers provide their own locking)."""
+
+    __slots__ = ("_buf", "_idx", "_count")
+
+    def __init__(self, capacity: int = 2048):
+        import numpy as np
+
+        self._buf = np.zeros(max(int(capacity), 1), dtype=np.float64)
+        self._idx = 0
+        self._count = 0
+
+    def record(self, value: float) -> None:
+        buf = self._buf
+        buf[self._idx] = value
+        self._idx = (self._idx + 1) % len(buf)
+        if self._count < len(buf):
+            self._count += 1
+
+    def __len__(self) -> int:
+        return self._count
+
+    def percentiles(self, *qs: float) -> tuple[float, ...]:
+        """Percentiles over the retained window (0.0 when empty)."""
+        import numpy as np
+
+        if not self._count:
+            return tuple(0.0 for _ in qs)
+        window = self._buf[: self._count]
+        return tuple(float(v) for v in np.percentile(window, qs))
+
+
+class _Flight:
+    __slots__ = ("event", "result", "exc")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.result = None
+        self.exc = None
+
+
+class _SingleFlight:
+    """Duplicate-call suppression: concurrent calls with the same key
+    share the leader's result (errors propagate to every waiter)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._flights: dict = {}
+
+    def run(self, key, fn):
+        """Returns ``(result, leader)``; ``leader`` is False for calls
+        that waited on another caller's in-flight computation."""
+        with self._lock:
+            flight = self._flights.get(key)
+            if flight is None:
+                flight = _Flight()
+                self._flights[key] = flight
+                leader = True
+            else:
+                leader = False
+        if not leader:
+            flight.event.wait()
+            if flight.exc is not None:
+                raise flight.exc
+            return flight.result, False
+        try:
+            flight.result = fn()
+        except BaseException as e:
+            flight.exc = e
+            raise
+        finally:
+            with self._lock:
+                self._flights.pop(key, None)
+            flight.event.set()
+        return flight.result, True
+
+
+class _ResponseCache:
+    """Tiny thread-safe LRU for rendered response bodies. Keys embed the
+    store version, so stale entries can never hit — the cap only bounds
+    memory across ``now`` buckets."""
+
+    def __init__(self, capacity: int = 16):
+        self._capacity = capacity
+        self._lock = threading.Lock()
+        self._entries: dict = {}
+
+    def get(self, key):
+        with self._lock:
+            body = self._entries.get(key)
+            if body is not None:
+                # move-to-back = most recently used
+                del self._entries[key]
+                self._entries[key] = body
+            return body
+
+    def put(self, key, body: bytes) -> None:
+        with self._lock:
+            self._entries.pop(key, None)
+            self._entries[key] = body
+            while len(self._entries) > self._capacity:
+                self._entries.pop(next(iter(self._entries)))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
 @dataclass
 class ServiceStats:
     refreshes: int = 0
+    refresh_skips: int = 0  # version-gated / single-flight-deduped
     score_calls: int = 0
+    coalesced_scores: int = 0  # requests served by another's dispatch
+    response_cache_hits: int = 0
     fallbacks: int = 0
     last_refresh_at: float = 0.0
     last_score_seconds: float = 0.0
     score_seconds_total: float = 0.0
-    latencies: list = field(default_factory=list)  # rolling window
+    latencies: LatencyRing = field(default_factory=LatencyRing)
 
 
 @dataclass
@@ -46,6 +183,7 @@ class BatchVerdicts:
     scores: dict  # node -> int
     backend: str  # "tpu" | "oracle-fallback"
     staleness_seconds: float
+    store_version: int = -1  # store version of the scored snapshot
 
 
 @dataclass
@@ -67,6 +205,7 @@ class ScoringService:
         snapshot_bucket: int = 2048,
         backend: str = "xla",
         telemetry: Telemetry | None = None,
+        now_bucket_s: float = 0.25,
     ):
         import jax.numpy as jnp
 
@@ -86,7 +225,24 @@ class ScoringService:
         self.stats = ServiceStats()
         self._bucket = snapshot_bucket
         self._clock = clock
+        # lock split: `_lock` serializes STORE MUTATION (refresh) only;
+        # counters ride `_stats_lock`; scoring reads a store snapshot
+        # and holds neither across the device dispatch
         self._lock = threading.RLock()
+        self._stats_lock = threading.Lock()
+        # requests with no explicit `now` score at the floor of this
+        # bucket: the coalescing/caching key quantum (0 = no bucketing)
+        self.now_bucket_s = now_bucket_s
+        self._score_flight = _SingleFlight()
+        self._refresh_flight = _SingleFlight()
+        self._resp_cache = _ResponseCache()
+        # cluster node_version the store last ingested (None = never):
+        # the single-flight refresh's version gate
+        self._refreshed_cluster_version = None
+        # bench comparison switch: the r07 serving path, verbatim —
+        # forced full refresh per request, per-node bool()/int() render
+        # loop, everything under the one service lock
+        self.legacy_mode = False
         # the service IS the /metrics surface, so it always carries a
         # registry (unlike hot-path modules, which gate on None); the
         # legacy JSON counters in ``stats`` stay authoritative for the
@@ -117,44 +273,99 @@ class ScoringService:
         self._m_assign_calls = reg.counter(
             "crane_scoring_assign_calls_total", "assign_batch calls"
         )
+        self._m_coalesced = reg.counter(
+            "crane_service_coalesced_total",
+            "Requests that shared another request's in-flight work "
+            "(kind=score: device dispatch; kind=refresh: bulk ingest, "
+            "including version-gated skips)",
+            labelnames=("kind",),
+        )
+        self._m_resp_cache_hits = reg.counter(
+            "crane_service_response_cache_hits_total",
+            "Score responses served as pre-rendered bytes",
+        )
+
+    # -- refresh -----------------------------------------------------------
+
+    def _cluster_version(self):
+        """The narrowest cluster counter a node-annotation consumer can
+        key on (PR 4's ``node_version``; ``sched_version`` fallback)."""
+        v = getattr(self.cluster, "node_version", None)
+        if v is None:
+            v = getattr(self.cluster, "sched_version", None)
+        return v
 
     def refresh(self) -> None:
-        """Bulk re-read of node annotations into the columnar store."""
+        """Bulk re-read of node annotations into the columnar store
+        (forced: always runs; the HTTP path goes through
+        ``refresh_coalesced``)."""
+        cv = self._cluster_version()
         with self._lock, self.telemetry.spans.span("refresh"):
             nodes = self.cluster.list_nodes()
             self.store.bulk_ingest((n.name, n.annotations) for n in nodes)
             self.store.prune_absent(n.name for n in nodes)
-            self.stats.refreshes += 1
-            self.stats.last_refresh_at = self._clock()
+            with self._stats_lock:
+                self.stats.refreshes += 1
+                self.stats.last_refresh_at = self._clock()
             self._m_refreshes.inc()
             self._m_nodes.set(len(self.store))
+            self._refreshed_cluster_version = cv
+
+    def refresh_coalesced(self) -> bool:
+        """The request-path refresh: version-gated and single-flight.
+
+        A storm of default-``refresh`` requests costs ONE ``bulk_ingest``
+        per cluster ``node_version`` change — callers that arrive while
+        one is in flight wait for it; callers whose observed cluster
+        version already matches the last ingest skip entirely. Returns
+        True when this call actually ran the ingest."""
+        cv = self._cluster_version()
+        if (
+            cv is not None
+            and cv == self._refreshed_cluster_version
+            and self.stats.last_refresh_at
+        ):
+            with self._stats_lock:
+                self.stats.refresh_skips += 1
+            self._m_coalesced.labels(kind="refresh").inc()
+            return False
+        _, leader = self._refresh_flight.run(("refresh", cv), self.refresh)
+        if not leader:
+            with self._stats_lock:
+                self.stats.refresh_skips += 1
+            self._m_coalesced.labels(kind="refresh").inc()
+        return leader
+
+    # -- scoring -----------------------------------------------------------
 
     def score_batch(self, now: float | None = None) -> BatchVerdicts:
         """Score every node; never raises (fail-open to the oracle)."""
         if now is None:
             now = self._clock()
         start = time.perf_counter()
-        with self._lock:
+        self._m_score_calls.inc()
+        with self._stats_lock:
             self.stats.score_calls += 1
-            self._m_score_calls.inc()
             staleness = (
-                now - self.stats.last_refresh_at if self.stats.last_refresh_at else -1.0
+                now - self.stats.last_refresh_at
+                if self.stats.last_refresh_at
+                else -1.0
             )
-            self._m_staleness.set(staleness)
-            try:
-                with self.telemetry.spans.span("score_batch"):
-                    verdicts = self._score_tpu(now)
-            except Exception:
+        self._m_staleness.set(staleness)
+        try:
+            with self.telemetry.spans.span("score_batch"):
+                verdicts = self._score_tpu(now)
+        except Exception:
+            self._m_fallbacks.inc()
+            with self._stats_lock:
                 self.stats.fallbacks += 1
-                self._m_fallbacks.inc()
-                verdicts = self._score_oracle(now)
-            elapsed = time.perf_counter() - start
+            verdicts = self._score_oracle(now)
+        elapsed = time.perf_counter() - start
+        self._m_score_seconds.observe(elapsed)
+        with self._stats_lock:
             self.stats.last_score_seconds = elapsed
             self.stats.score_seconds_total += elapsed
-            self._m_score_seconds.observe(elapsed)
-            self.stats.latencies.append(elapsed)
-            if len(self.stats.latencies) > 1024:
-                del self.stats.latencies[:512]
+            self.stats.latencies.record(elapsed)
         verdicts.staleness_seconds = staleness
         return verdicts
 
@@ -165,14 +376,18 @@ class ScoringService:
         res = self.scorer(
             snap.values, snap.ts, snap.hot_value, snap.hot_ts, snap.node_valid, now
         )
-        schedulable = np.asarray(res.schedulable)
-        scores = np.asarray(res.scores)
         n = snap.n_nodes
+        # vectorized render: one tolist() per array yields plain Python
+        # bools/ints (the per-node bool()/int() loop this replaces was
+        # ~half the request cost at 50k nodes)
+        schedulable = np.asarray(res.schedulable)[:n].astype(bool).tolist()
+        scores = np.asarray(res.scores)[:n].astype(np.int64).tolist()
         return BatchVerdicts(
-            schedulable={snap.node_names[i]: bool(schedulable[i]) for i in range(n)},
-            scores={snap.node_names[i]: int(scores[i]) for i in range(n)},
+            schedulable=dict(zip(snap.node_names, schedulable)),
+            scores=dict(zip(snap.node_names, scores)),
             backend="tpu",
             staleness_seconds=0.0,
+            store_version=snap.version,
         )
 
     def _score_oracle(self, now: float) -> BatchVerdicts:
@@ -191,6 +406,133 @@ class ScoringService:
             staleness_seconds=0.0,
         )
 
+    # -- rendered responses ------------------------------------------------
+
+    def _resolve_now(self, now: float | None) -> float:
+        """An explicit ``now`` is used verbatim; otherwise the wall
+        clock floors to ``now_bucket_s`` so concurrent requests agree
+        on the coalescing key."""
+        if now is not None:
+            return float(now)
+        t = self._clock()
+        b = self.now_bucket_s
+        return int(t / b) * b if b > 0 else t
+
+    def score_response_bytes(
+        self, now: float | None = None, refresh: bool = True
+    ) -> bytes:
+        """The rendered ``/v1/score`` response body: coalesced,
+        version-keyed, served as a memcpy on repeat.
+
+        Cache/coalescing key: (store version, last refresh time, ``now``)
+        — the exact inputs the rendered bytes are a pure function of
+        (policy is fixed per service). Any store write bumps the version,
+        so stale bytes can never be served across a write; any refresh
+        moves ``last_refresh_at``, so the reported staleness re-renders.
+        Fallback renders are shared with concurrent waiters but never
+        cached (a recovered device must win the next request)."""
+        if self.legacy_mode:
+            return self._score_response_legacy(now, refresh)
+        if refresh:
+            self.refresh_coalesced()
+        now_val = self._resolve_now(now)
+        key = (self.store.version, self.stats.last_refresh_at, now_val)
+        body = self._resp_cache.get(key)
+        if body is not None:
+            with self._stats_lock:
+                self.stats.response_cache_hits += 1
+            self._m_resp_cache_hits.inc()
+            return body
+
+        def compute() -> bytes:
+            verdicts = self.score_batch(now=now_val)
+            rendered = json.dumps(
+                {
+                    "backend": verdicts.backend,
+                    "stalenessSeconds": verdicts.staleness_seconds,
+                    "schedulable": verdicts.schedulable,
+                    "scores": verdicts.scores,
+                }
+            ).encode()
+            if verdicts.backend == "tpu":
+                self._resp_cache.put(
+                    (
+                        verdicts.store_version,
+                        self.stats.last_refresh_at,
+                        now_val,
+                    ),
+                    rendered,
+                )
+            return rendered
+
+        body, leader = self._score_flight.run(key, compute)
+        if not leader:
+            with self._stats_lock:
+                self.stats.coalesced_scores += 1
+            self._m_coalesced.labels(kind="score").inc()
+        return body
+
+    def _score_response_legacy(self, now, refresh: bool) -> bytes:
+        """The r07 serving path, reproduced for bench config 10's before
+        leg: forced full refresh, per-node bool()/int() render loop, and
+        the whole request under the one service lock."""
+        import numpy as np
+
+        if refresh:
+            self.refresh()
+        if now is None:
+            now = self._clock()
+        start = time.perf_counter()
+        with self._lock:
+            self._m_score_calls.inc()
+            with self._stats_lock:
+                self.stats.score_calls += 1
+                staleness = (
+                    now - self.stats.last_refresh_at
+                    if self.stats.last_refresh_at
+                    else -1.0
+                )
+            try:
+                snap = self.store.snapshot(bucket=self._bucket)
+                res = self.scorer(
+                    snap.values, snap.ts, snap.hot_value, snap.hot_ts,
+                    snap.node_valid, now,
+                )
+                schedulable = np.asarray(res.schedulable)
+                scores = np.asarray(res.scores)
+                n = snap.n_nodes
+                verdicts = BatchVerdicts(
+                    schedulable={
+                        snap.node_names[i]: bool(schedulable[i]) for i in range(n)
+                    },
+                    scores={
+                        snap.node_names[i]: int(scores[i]) for i in range(n)
+                    },
+                    backend="tpu",
+                    staleness_seconds=staleness,
+                )
+            except Exception:
+                self._m_fallbacks.inc()
+                with self._stats_lock:
+                    self.stats.fallbacks += 1
+                verdicts = self._score_oracle(now)
+                verdicts.staleness_seconds = staleness
+            with self._stats_lock:
+                elapsed = time.perf_counter() - start
+                self.stats.last_score_seconds = elapsed
+                self.stats.score_seconds_total += elapsed
+                self.stats.latencies.record(elapsed)
+            return json.dumps(
+                {
+                    "backend": verdicts.backend,
+                    "stalenessSeconds": verdicts.staleness_seconds,
+                    "schedulable": verdicts.schedulable,
+                    "scores": verdicts.scores,
+                }
+            ).encode()
+
+    # -- assignment --------------------------------------------------------
+
     def assign_batch(
         self, num_pods: int, capacity: dict | None = None,
         now: float | None = None,
@@ -200,7 +542,8 @@ class ScoringService:
         the north star's "scores/top-k placements out" surface). Never
         raises: if the device path fails, the numpy host twin solves the
         same placement from the oracle scores (both are parity-tested
-        against each other)."""
+        against each other). Rides the shared store snapshot — a
+        concurrent refresh never blocks it."""
         import numpy as np
 
         from ..scorer.topk import gang_assign_host
@@ -209,33 +552,33 @@ class ScoringService:
             now = self._clock()
         verdicts = self.score_batch(now=now)
         names = list(verdicts.scores)
-        scores = np.asarray([verdicts.scores[n] for n in names], np.int64)
-        schedulable = np.asarray([verdicts.schedulable[n] for n in names], bool)
+        scores = np.asarray(list(verdicts.scores.values()), np.int64)
+        schedulable = np.asarray(list(verdicts.schedulable.values()), bool)
         cap = None
         if capacity is not None:
             cap = np.asarray(
                 [int(capacity.get(n, 1 << 30)) for n in names], np.int64
             )
-        with self._lock:
-            self._m_assign_calls.inc()
-            try:
-                with self.telemetry.spans.span("assign_batch"):
-                    result = self._gang(scores, schedulable, num_pods, cap)
-                counts = np.asarray(result.counts)
-                unassigned = int(result.unassigned)
-                waterline = int(result.waterline)
-                backend = verdicts.backend
-            except Exception:
+        self._m_assign_calls.inc()
+        try:
+            with self.telemetry.spans.span("assign_batch"):
+                result = self._gang(scores, schedulable, num_pods, cap)
+            counts = np.asarray(result.counts)
+            unassigned = int(result.unassigned)
+            waterline = int(result.waterline)
+            backend = verdicts.backend
+        except Exception:
+            self._m_fallbacks.inc()
+            with self._stats_lock:
                 self.stats.fallbacks += 1
-                self._m_fallbacks.inc()
-                host = gang_assign_host(
-                    scores, schedulable, num_pods, self.tensors.hv_count,
-                    capacity=cap,
-                )
-                counts = np.asarray(host.counts)
-                unassigned = int(host.unassigned)
-                waterline = int(host.waterline)
-                backend = "host-fallback"
+            host = gang_assign_host(
+                scores, schedulable, num_pods, self.tensors.hv_count,
+                capacity=cap,
+            )
+            counts = np.asarray(host.counts)
+            unassigned = int(host.unassigned)
+            waterline = int(host.waterline)
+            backend = "host-fallback"
         assignment = BatchAssignment(
             counts={names[i]: int(c) for i, c in enumerate(counts) if c},
             unassigned=unassigned,
@@ -273,22 +616,25 @@ class ScoringService:
             self._gang_solver = gang
         return gang
 
+    # -- metrics -----------------------------------------------------------
+
     def metrics(self) -> dict:
         """Exported counters, legacy JSON shape (the ``/metrics``
         back-compat payload; scrapers get ``render_prometheus``)."""
-        import numpy as np
-
-        with self._lock:
-            lat = sorted(self.stats.latencies)
-            p99 = lat[int(0.99 * (len(lat) - 1))] if lat else 0.0
+        with self._stats_lock:
+            p50, p99 = self.stats.latencies.percentiles(50, 99)
             return {
                 "refreshes": self.stats.refreshes,
+                "refresh_skips": self.stats.refresh_skips,
                 "score_calls": self.stats.score_calls,
+                "coalesced_scores": self.stats.coalesced_scores,
+                "response_cache_hits": self.stats.response_cache_hits,
                 "fallbacks": self.stats.fallbacks,
                 "last_refresh_at": self.stats.last_refresh_at,
                 "last_score_seconds": self.stats.last_score_seconds,
                 "score_seconds_total": self.stats.score_seconds_total,
-                "score_p99_seconds": float(p99),
+                "score_p50_seconds": p50,
+                "score_p99_seconds": p99,
                 "nodes": len(self.store),
             }
 

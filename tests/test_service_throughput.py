@@ -1,0 +1,406 @@
+"""Serving-path tests: coalesced dispatch, the version-keyed response
+cache, single-flight refresh, and the keep-alive front end (doc/serving.md).
+
+The contract under test: concurrent requests that agree on (store
+version, last refresh, ``now``) share ONE device dispatch and ONE
+rendered byte-string; any store write invalidates the cached bytes; a
+fail-open fallback is shared with concurrent waiters but never cached;
+and the async front end frames pipelined/torn requests correctly while
+reusing connections.
+"""
+
+import http.client
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from crane_scheduler_tpu.policy import DEFAULT_POLICY
+from crane_scheduler_tpu.sim import SimConfig, Simulator
+
+
+def make_sim(n_nodes=4, seed=0):
+    sim = Simulator(SimConfig(n_nodes=n_nodes, seed=seed))
+    sim.sync_metrics()
+    return sim
+
+
+def make_service(sim, **kwargs):
+    from crane_scheduler_tpu.service import ScoringService
+
+    svc = ScoringService(sim.cluster, DEFAULT_POLICY, **kwargs)
+    svc.refresh()
+    return svc
+
+
+def storm(fn, n=8):
+    """Run ``fn`` from ``n`` threads released together; return results."""
+    barrier = threading.Barrier(n)
+    results = [None] * n
+    errors = []
+
+    def worker(i):
+        barrier.wait()
+        try:
+            results[i] = fn()
+        except Exception as e:  # pragma: no cover - surfaced by assert
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+    return results
+
+
+# --- LatencyRing ------------------------------------------------------------
+
+
+def test_latency_ring_caps_and_percentiles():
+    from crane_scheduler_tpu.service.scoring import LatencyRing
+
+    ring = LatencyRing(capacity=8)
+    assert len(ring) == 0
+    assert ring.percentiles(50, 99) == (0.0, 0.0)
+    for v in range(1, 5):
+        ring.record(float(v))
+    assert len(ring) == 4
+    p50, p100 = ring.percentiles(50, 100)
+    assert p50 == pytest.approx(2.5)
+    assert p100 == pytest.approx(4.0)
+    # overflow keeps only the newest `capacity` samples
+    for v in range(100, 120):
+        ring.record(float(v))
+    assert len(ring) == 8
+    lo, hi = ring.percentiles(0, 100)
+    assert lo >= 112.0 and hi == pytest.approx(119.0)
+
+
+# --- coalescing + response cache --------------------------------------------
+
+
+def test_coalesced_responses_byte_identical():
+    sim = make_sim(6, seed=11)
+    svc = make_service(sim)
+    now = sim.clock.now()
+
+    before = svc.metrics()
+    bodies = storm(
+        lambda: svc.score_response_bytes(now=now, refresh=False), n=12
+    )
+    assert all(isinstance(b, bytes) for b in bodies)
+    assert len({bytes(b) for b in bodies}) == 1  # byte-identical
+    after = svc.metrics()
+    # one dispatch total: every other request either waited on the
+    # in-flight computation or hit the rendered-bytes cache
+    assert after["score_calls"] - before["score_calls"] == 1
+    shared = (
+        (after["coalesced_scores"] - before["coalesced_scores"])
+        + (after["response_cache_hits"] - before["response_cache_hits"])
+    )
+    assert shared == 11
+
+    # repeat is a pure cache hit: same bytes, no new dispatch
+    again = svc.score_response_bytes(now=now, refresh=False)
+    final = svc.metrics()
+    assert again == bodies[0]
+    assert final["score_calls"] == after["score_calls"]
+    assert final["response_cache_hits"] > after["response_cache_hits"]
+
+    payload = json.loads(bodies[0])
+    assert payload["backend"] == "tpu"
+    assert len(payload["scores"]) == 6
+
+
+def test_response_cache_invalidates_on_store_write():
+    sim = make_sim(4, seed=12)
+    svc = make_service(sim)
+    now = sim.clock.now()
+
+    first = svc.score_response_bytes(now=now, refresh=False)
+    hit = svc.score_response_bytes(now=now, refresh=False)
+    assert hit == first
+    calls_before = svc.metrics()["score_calls"]
+
+    # any store write bumps the version => the cached bytes can't hit
+    node = sim.cluster.list_nodes()[0].name
+    svc.store.set_hot_value(node, 5.0, now)
+    fresh = svc.score_response_bytes(now=now, refresh=False)
+    assert svc.metrics()["score_calls"] == calls_before + 1
+    # the write changed the winning data, so the render changed too
+    assert json.loads(fresh)["scores"][node] != json.loads(first)["scores"][node]
+
+
+def test_now_bucketing_keys_implicit_now():
+    sim = make_sim(3, seed=13)
+    # a huge bucket makes every implicit-now request agree on the key
+    svc = make_service(sim, now_bucket_s=3600.0)
+    calls0 = svc.metrics()["score_calls"]
+    storm(lambda: svc.score_response_bytes(refresh=False), n=6)
+    assert svc.metrics()["score_calls"] - calls0 == 1
+    # explicit `now` is used verbatim, not bucketed
+    assert svc._resolve_now(123.456) == 123.456
+
+
+def test_single_flight_refresh_storm():
+    sim = make_sim(4, seed=14)
+    svc = make_service(sim)
+    base = svc.metrics()
+
+    # unchanged cluster: a storm of default-refresh requests ingests NOTHING
+    ran = storm(svc.refresh_coalesced, n=10)
+    m = svc.metrics()
+    assert not any(ran)
+    assert m["refreshes"] == base["refreshes"]
+    assert m["refresh_skips"] - base["refresh_skips"] == 10
+
+    # a cluster write re-arms the gate: exactly one ingest runs
+    node = sim.cluster.list_nodes()[0].name
+    sim.cluster.patch_node_annotation(node, "node_hot_value", "3,%d" % int(sim.clock.now()))
+    assert svc.refresh_coalesced() is True
+    assert svc.metrics()["refreshes"] == base["refreshes"] + 1
+    assert svc.refresh_coalesced() is False  # gate closed again
+
+    # storm across a version bump: the ingest count stays ~1, not N
+    sim.cluster.patch_node_annotation(node, "node_hot_value", "4,%d" % int(sim.clock.now()))
+    before = svc.metrics()["refreshes"]
+    storm(svc.refresh_coalesced, n=10)
+    assert svc.metrics()["refreshes"] - before <= 2
+
+
+def test_fail_open_concurrent_and_fallback_never_cached():
+    from crane_scheduler_tpu.scorer import oracle
+
+    sim = make_sim(4, seed=15)
+    svc = make_service(sim)
+    now = sim.clock.now()
+    good_scorer = svc.scorer
+
+    def boom(*a, **k):
+        raise RuntimeError("TPU unavailable")
+
+    svc.scorer = type("Broken", (), {"__call__": boom})()
+    bodies = storm(
+        lambda: svc.score_response_bytes(now=now, refresh=False), n=8
+    )
+    payloads = [json.loads(b) for b in bodies]
+    assert all(p["backend"] == "oracle-fallback" for p in payloads)
+    # fallback verdicts still match the scalar oracle exactly
+    for node in sim.cluster.list_nodes():
+        want = oracle.score_node(dict(node.annotations), DEFAULT_POLICY.spec, now)
+        assert payloads[0]["scores"][node.name] == want
+
+    # the fallback render was shared but NOT cached: once the device
+    # recovers, the very next request with the same key wins it back
+    svc.scorer = good_scorer
+    recovered = json.loads(svc.score_response_bytes(now=now, refresh=False))
+    assert recovered["backend"] == "tpu"
+
+
+# --- async front end: framing, pipelining, keep-alive -----------------------
+
+
+@pytest.fixture
+def server():
+    from crane_scheduler_tpu.service import ScoringHTTPServer
+
+    sim = make_sim(3, seed=16)
+    svc = make_service(sim)
+    srv = ScoringHTTPServer(svc, port=0)
+    srv.start()
+    try:
+        yield sim, svc, srv
+    finally:
+        srv.stop()
+
+
+def _recv_http_responses(sock, count, timeout=15.0):
+    """Read ``count`` Content-Length-framed responses off a raw socket."""
+    sock.settimeout(timeout)
+    buf = bytearray()
+    out = []
+    while len(out) < count:
+        head_end = buf.find(b"\r\n\r\n")
+        if head_end < 0:
+            chunk = sock.recv(65536)
+            assert chunk, "server closed mid-response"
+            buf += chunk
+            continue
+        head = bytes(buf[:head_end]).decode("latin-1")
+        length = 0
+        for line in head.split("\r\n")[1:]:
+            name, _, value = line.partition(":")
+            if name.strip().lower() == "content-length":
+                length = int(value.strip())
+        total = head_end + 4 + length
+        while len(buf) < total:
+            chunk = sock.recv(65536)
+            assert chunk, "server closed mid-body"
+            buf += chunk
+        out.append((head, bytes(buf[head_end + 4:total])))
+        del buf[:total]
+    return out
+
+
+def _post(target, payload):
+    body = json.dumps(payload).encode()
+    return (
+        f"POST {target} HTTP/1.1\r\nHost: t\r\nContent-Type: application/json\r\n"
+        f"Content-Length: {len(body)}\r\n\r\n"
+    ).encode() + body
+
+
+def test_pipelined_requests_answered_in_order(server):
+    sim, svc, srv = server
+    t0 = sim.clock.now()
+    # three requests in ONE write; distinct `now` values make the
+    # response bodies distinguishable so ordering is observable
+    blob = b"".join(
+        _post("/v1/score", {"now": t0 + i, "refresh": False}) for i in range(3)
+    )
+    with socket.create_connection(("127.0.0.1", srv.port)) as sock:
+        sock.sendall(blob)
+        responses = _recv_http_responses(sock, 3)
+    stalenesses = [json.loads(body)["stalenessSeconds"] for _, body in responses]
+    assert stalenesses == sorted(stalenesses)
+    assert stalenesses[1] - stalenesses[0] == pytest.approx(1.0)
+    assert stalenesses[2] - stalenesses[1] == pytest.approx(1.0)
+
+
+def test_torn_request_framing(server):
+    sim, svc, srv = server
+    raw = _post("/v1/score", {"now": sim.clock.now(), "refresh": False})
+    with socket.create_connection(("127.0.0.1", srv.port)) as sock:
+        # dribble the request byte-torn across many sends
+        for i in range(0, len(raw), 7):
+            sock.sendall(raw[i:i + 7])
+            time.sleep(0.001)
+        (head, body), = _recv_http_responses(sock, 1)
+    assert " 200 " in head.split("\r\n")[0]
+    assert json.loads(body)["backend"] == "tpu"
+
+
+def test_keep_alive_connection_reuse(server):
+    sim, svc, srv = server
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+    try:
+        for _ in range(5):
+            conn.request(
+                "POST", "/v1/score",
+                body=json.dumps({"now": sim.clock.now(), "refresh": False}),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert json.loads(resp.read())["backend"] == "tpu"
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        resp.read()
+    finally:
+        conn.close()
+    # every request above rode ONE accepted socket
+    assert srv.connections_accepted == 1
+
+
+def test_malformed_and_unsupported_requests_rejected(server):
+    sim, svc, srv = server
+    with socket.create_connection(("127.0.0.1", srv.port)) as sock:
+        sock.sendall(b"NONSENSE\r\n\r\n")
+        (head, _), = _recv_http_responses(sock, 1)
+    assert " 400 " in head.split("\r\n")[0]
+    with socket.create_connection(("127.0.0.1", srv.port)) as sock:
+        sock.sendall(
+            b"POST /v1/score HTTP/1.1\r\nHost: t\r\n"
+            b"Transfer-Encoding: chunked\r\n\r\n"
+        )
+        (head, _), = _recv_http_responses(sock, 1)
+    assert " 501 " in head.split("\r\n")[0]
+
+
+def test_threaded_frontend_keep_alive_parity():
+    from crane_scheduler_tpu.service import ScoringHTTPServer
+
+    sim = make_sim(3, seed=17)
+    svc = make_service(sim)
+    srv = ScoringHTTPServer(svc, port=0, frontend="threaded")
+    srv.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        bodies = []
+        for _ in range(2):
+            conn.request(
+                "POST", "/v1/score",
+                body=json.dumps({"now": sim.clock.now(), "refresh": False}),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            assert resp.status == 200
+            bodies.append(resp.read())
+        conn.close()
+        assert json.loads(bodies[0])["backend"] == "tpu"
+        # both requests reused the connection (HTTP/1.1 keep-alive on
+        # the stdlib fallback too) and produced identical bytes — the
+        # shared router guarantees front-end parity
+        assert bodies[0] == bodies[1]
+    finally:
+        srv.stop()
+
+
+def test_http_concurrent_storm_over_keepalive_conns(server):
+    sim, svc, srv = server
+    now = sim.clock.now()
+
+    def one_client():
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=15)
+        try:
+            out = []
+            for _ in range(4):
+                conn.request(
+                    "POST", "/v1/score",
+                    body=json.dumps({"now": now}),
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                assert resp.status == 200
+                out.append(resp.read())
+            return out
+        finally:
+            conn.close()
+
+    results = storm(one_client, n=6)
+    flat = [b for batch in results for b in batch]
+    assert len({bytes(b) for b in flat}) == 1  # all 24 byte-identical
+    m = svc.metrics()
+    assert m["response_cache_hits"] + m["coalesced_scores"] >= 20
+    assert srv.connections_accepted == 6
+
+
+def test_service_telemetry_families_exposed(server):
+    sim, svc, srv = server
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+    try:
+        conn.request(
+            "POST", "/v1/score",
+            body=json.dumps({"now": sim.clock.now()}),
+            headers={"Content-Type": "application/json"},
+        )
+        conn.getresponse().read()
+        conn.request("GET", "/metrics", headers={"Accept": "text/plain"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        text = resp.read().decode()
+    finally:
+        conn.close()
+    for family in (
+        'crane_service_request_seconds_bucket{endpoint="/v1/score"',
+        "crane_service_request_seconds_count",
+        "crane_service_inflight",
+        "crane_service_coalesced_total",
+        "crane_service_response_cache_hits_total",
+    ):
+        assert family in text, family

@@ -52,6 +52,22 @@ def main() -> int:
             failures += 1
 
     try:
+        # 0. drive the serving path over live HTTP so the service
+        # families have samples: two identical scores (the second must
+        # hit the rendered-response cache) through the async front end
+        for _ in range(2):
+            req = urllib.request.Request(
+                f"{base}/v1/score",
+                data=json.dumps(
+                    {"now": sim.clock.now(), "refresh": False}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                scored = json.load(r)
+        check("live /v1/score", scored.get("backend") == "tpu")
+
         # 1. strict exposition scrape
         req = urllib.request.Request(
             f"{base}/metrics",
@@ -75,8 +91,27 @@ def main() -> int:
             "crane_scoring_score_seconds",
             "crane_scoring_staleness_seconds",
             "crane_scoring_nodes",
+            "crane_service_request_seconds",
+            "crane_service_inflight",
+            "crane_service_coalesced_total",
+            "crane_service_response_cache_hits_total",
         ):
             check(f"family {required}", required in families)
+        cache_hits = sum(
+            s[2]
+            for s in families.get(
+                "crane_service_response_cache_hits_total", {}
+            ).get("samples", ())
+        )
+        check("response cache hit observed", cache_hits >= 1,
+              f"hits={cache_hits}")
+        score_endpoint_seen = any(
+            dict(s[1]).get("endpoint") == "/v1/score"
+            for s in families.get(
+                "crane_service_request_seconds", {}
+            ).get("samples", ())
+        )
+        check("request_seconds endpoint label", score_endpoint_seen)
 
         # 2. JSON back-compat (no Accept header = legacy client)
         with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
