@@ -8,8 +8,8 @@ GIT_VERSION ?= $(shell git describe --tags --always --dirty 2>/dev/null || echo 
 IMAGE_ANNOTATOR := $(REGISTRY)/crane-annotator-tpu:$(GIT_VERSION)
 IMAGE_SCHEDULER := $(REGISTRY)/crane-scheduler-tpu:$(GIT_VERSION)
 
-.PHONY: all native test test-fast bench sim e2e metrics-smoke clean \
-	images image-annotator image-scheduler push-images
+.PHONY: all native test test-fast bench sim e2e metrics-smoke \
+	desched-smoke clean images image-annotator image-scheduler push-images
 
 all: native test
 
@@ -35,6 +35,11 @@ e2e:
 # exposition parser (fails CI before a real scraper chokes)
 metrics-smoke:
 	$(PYTHON) tools/metrics_smoke.py
+
+# one dry-run descheduler cycle against the kube stub, then strict-parse
+# the controller /metrics for the crane_desched_* families
+desched-smoke:
+	$(PYTHON) tools/metrics_smoke.py --desched
 
 # -- images (one parameterized Dockerfile per binary, like the
 # reference's ARG PKGNAME build; ref: Makefile images target) ----------

@@ -1,0 +1,192 @@
+"""crane-descheduler: the load-aware rebalancer entrypoint.
+
+The correcting half of the placement loop (doc/descheduler.md): reads
+the same ``value,timestamp`` node annotations the Dynamic plugin
+schedules against, detects sustained hotspots, and evicts budgeted
+victims that provably fit elsewhere. Flags mirror the annotator
+controller: ``--master`` for a live kube-apiserver (evictions go
+through the pipelined write path's eviction-subresource POSTs),
+``--nodes-file``/``--demo-nodes`` for local runs, leader election so
+only one replica evicts, health + metrics port, and ``--dry-run`` to
+plan without evicting.
+
+Usage:
+  python -m crane_scheduler_tpu.cli.descheduler_main \
+      --policy-config-path policy.yaml \
+      [--master https://apiserver:6443 | --demo-nodes 8] \
+      [--dry-run] [--leader-elect --lock-file /tmp/crane-desched.lock]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import threading
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="crane-descheduler")
+    parser.add_argument("--policy-config-path", default=None)
+    parser.add_argument("--health-port", type=int, default=8091)
+    parser.add_argument("--master", default=None,
+                        help="kube-apiserver URL (uses the informer-style "
+                             "KubeClusterClient; evictions POST the "
+                             "eviction subresource)")
+    parser.add_argument("--token-file", default=None,
+                        help="bearer token file for --master (defaults to "
+                             "the in-cluster service-account token if present)")
+    parser.add_argument("--nodes-file", default=None)
+    parser.add_argument("--demo-nodes", type=int, default=0)
+    parser.add_argument("--sync-period-seconds", type=float, default=60.0)
+    parser.add_argument("--consecutive-syncs", type=int, default=3,
+                        help="over-threshold syncs before a node is "
+                             "actionable (one spike never evicts)")
+    parser.add_argument("--max-evictions-per-node", type=int, default=1)
+    parser.add_argument("--max-evictions-per-cycle", type=int, default=4)
+    parser.add_argument("--node-cooldown-seconds", type=float, default=300.0)
+    parser.add_argument("--cpu-threshold", type=float, default=0.70,
+                        help="cpu_usage_avg_5m hotspot watermark")
+    parser.add_argument("--cpu-target", type=float, default=0.50,
+                        help="cpu_usage_avg_5m safe-landing watermark")
+    parser.add_argument("--dry-run", action="store_true",
+                        help="plan and count, never evict")
+    parser.add_argument("--leader-elect", action="store_true")
+    parser.add_argument("--lock-file", default="/tmp/crane-descheduler.lock")
+    parser.add_argument("--run-seconds", type=float, default=0.0,
+                        help="exit after N seconds (0 = run forever)")
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    args = parser.parse_args(argv)
+
+    from ..utils.logging import set_verbosity
+
+    if args.verbose:
+        set_verbosity(args.verbose)
+
+    from .. import telemetry as telemetry_mod
+    from ..cluster import ClusterState, Node, NodeAddress
+    from ..descheduler import (
+        DeschedulerConfig,
+        LoadAwareDescheduler,
+        WatermarkPolicy,
+    )
+    from ..policy import DEFAULT_POLICY, load_policy_from_file
+    from ..service.http import HealthServer
+    from ..service.leader import LeaderElector
+
+    policy = (
+        load_policy_from_file(args.policy_config_path)
+        if args.policy_config_path
+        else DEFAULT_POLICY
+    )
+    telemetry = telemetry_mod.enable()
+
+    if args.master:
+        from ..cluster.kube import KubeClusterClient
+
+        cluster = KubeClusterClient.from_flags(args.master, args.token_file)
+        cluster.start()
+        print(f"kube mirror: {len(cluster.list_nodes())} nodes from "
+              f"{args.master}", flush=True)
+    else:
+        cluster = ClusterState()
+        if args.nodes_file:
+            with open(args.nodes_file) as f:
+                for doc in json.load(f):
+                    cluster.add_node(
+                        Node(
+                            name=doc["name"],
+                            addresses=(NodeAddress("InternalIP",
+                                                   doc.get("ip", doc["name"])),),
+                        )
+                    )
+        elif args.demo_nodes:
+            for i in range(args.demo_nodes):
+                cluster.add_node(
+                    Node(name=f"node-{i}",
+                         addresses=(NodeAddress("InternalIP", f"10.0.0.{i}"),))
+                )
+
+    config = DeschedulerConfig(
+        watermarks=(
+            WatermarkPolicy("cpu_usage_avg_5m",
+                            target=args.cpu_target,
+                            threshold=args.cpu_threshold),
+            WatermarkPolicy("mem_usage_avg_5m",
+                            target=args.cpu_target,
+                            threshold=args.cpu_threshold),
+        ),
+        consecutive_syncs=args.consecutive_syncs,
+        max_evictions_per_node=args.max_evictions_per_node,
+        max_evictions_per_cycle=args.max_evictions_per_cycle,
+        node_cooldown_seconds=args.node_cooldown_seconds,
+        sync_period_seconds=args.sync_period_seconds,
+        dry_run=args.dry_run,
+    )
+    descheduler = LoadAwareDescheduler(
+        cluster, policy, config, telemetry=telemetry
+    )
+
+    health = HealthServer(port=args.health_port, telemetry=telemetry)
+    health.start()
+    print(f"healthz+metrics on :{health.port}"
+          f"{' (dry-run)' if args.dry_run else ''}", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+
+    def run_descheduler(stop_event):
+        descheduler.start()
+        stop_event.wait()
+        descheduler.stop()
+
+    def lost_lease():
+        # same contract as the annotator: exit so the pod restarts and
+        # re-enters the election — never evict without the lease
+        print("lost leader lease; exiting for restart", flush=True)
+        os._exit(1)
+
+    if args.leader_elect:
+        if args.master:
+            import socket
+
+            from ..service.kube_leader import KubeLeaderElector
+
+            elector = KubeLeaderElector(
+                cluster,
+                lease_name="crane-scheduler-tpu-descheduler",
+                identity=(f"crane-descheduler-{socket.gethostname()}-"
+                          f"{os.getpid()}"),
+                on_started_leading=run_descheduler,
+                on_stopped_leading=lost_lease,
+            )
+            print("leader election on lease crane-scheduler-tpu-descheduler",
+                  flush=True)
+        else:
+            elector = LeaderElector(
+                args.lock_file,
+                identity=f"crane-descheduler-{os.getpid()}",
+                on_started_leading=run_descheduler,
+                on_stopped_leading=lost_lease,
+            )
+            print(f"leader election on {args.lock_file}", flush=True)
+        thread = threading.Thread(target=elector.run, daemon=True)
+        thread.start()
+    else:
+        threading.Thread(
+            target=run_descheduler, args=(stop,), daemon=True
+        ).start()
+
+    stop.wait(timeout=args.run_seconds or None)
+    stop.set()
+    health.stop()
+    if args.master:
+        cluster.stop()
+    print(json.dumps(descheduler.stats()), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

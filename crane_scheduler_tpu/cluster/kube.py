@@ -78,16 +78,15 @@ def node_from_json(obj: dict) -> Node:
             NodeAddress(a.get("type", ""), a.get("address", ""))
             for a in status.get("addresses") or []
         ),
+        allocatable=dict(status.get("allocatable") or {}),
     )
 
 
-def pod_from_json(obj: dict) -> Pod:
-    meta = obj.get("metadata", {})
-    spec = obj.get("spec", {})
-    containers = []
-    for c in spec.get("containers") or []:
+def _containers_from_json(items) -> tuple[Container, ...]:
+    out = []
+    for c in items or []:
         res = c.get("resources") or {}
-        containers.append(
+        out.append(
             Container(
                 name=c.get("name", ""),
                 resources=ResourceRequirements(
@@ -96,6 +95,12 @@ def pod_from_json(obj: dict) -> Pod:
                 ),
             )
         )
+    return tuple(out)
+
+
+def pod_from_json(obj: dict) -> Pod:
+    meta = obj.get("metadata", {})
+    spec = obj.get("spec", {})
     return Pod(
         name=meta.get("name", ""),
         namespace=meta.get("namespace", "default"),
@@ -104,8 +109,10 @@ def pod_from_json(obj: dict) -> Pod:
             OwnerReference(kind=r.get("kind", ""), name=r.get("name", ""))
             for r in meta.get("ownerReferences") or []
         ),
-        containers=tuple(containers),
+        containers=_containers_from_json(spec.get("containers")),
         node_name=spec.get("nodeName", "") or "",
+        init_containers=_containers_from_json(spec.get("initContainers")),
+        overhead=dict(spec.get("overhead") or {}),
     )
 
 
@@ -2107,6 +2114,10 @@ class KubeClusterClient:
         return self._mirror.node_set_version
 
     @property
+    def node_version(self) -> int:
+        return self._mirror.node_version
+
+    @property
     def pod_version(self) -> int:
         return self._mirror.pod_version
 
@@ -2327,6 +2338,34 @@ class KubeClusterClient:
         self._mirror.patch_pod_annotation(key, anno_key, value)
         return True
 
+    def evict_pod(self, key: str, now: float | None = None) -> bool:
+        """POST the eviction subresource (the descheduler's write).
+
+        Evictions are NOT idempotent — a duplicate POST on a real
+        apiserver races pod termination (409/404) and double-counts
+        disruption budgets — so the request rides the pooled writer's
+        POST discipline: a response-phase transport loss is
+        indeterminate and is never blindly re-POSTed (only 429, which
+        the apiserver documents as not-processed, re-drives). Same
+        contract as the binding subresource (see _IDEMPOTENT_METHODS)."""
+        namespace, name = key.split("/", 1)
+        body = {
+            "apiVersion": "policy/v1",
+            "kind": "Eviction",
+            "metadata": {"name": name, "namespace": namespace},
+        }
+        if not self._write(
+            key,
+            "POST",
+            f"/api/v1/namespaces/{namespace}/pods/{name}/eviction",
+            body,
+        ):
+            return False
+        # optimistic mirror apply; the watch's authoritative DELETED
+        # event confirms (re-deleting an absent pod is a no-op)
+        self._mirror.delete_pod(key)
+        return True
+
     def add_pod(self, pod: Pod) -> None:
         """Create the pod via the API (primarily for tests/tools; real
         pods arrive through the watch). The body carries the FULL pod —
@@ -2356,6 +2395,17 @@ class KubeClusterClient:
                     }
                     for c in pod.containers
                 ],
+                "initContainers": [
+                    {
+                        "name": c.name,
+                        "resources": {
+                            "requests": dict(c.resources.requests),
+                            "limits": dict(c.resources.limits),
+                        },
+                    }
+                    for c in pod.init_containers
+                ],
+                "overhead": dict(pod.overhead),
             },
         }
         if not self._write(

@@ -21,9 +21,18 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field, replace
-from typing import Callable, Mapping
+from types import MappingProxyType
+from typing import Any, Callable, Mapping
 
 import numpy as np
+
+# Shared empty-mapping default for the optional resource fields below.
+# A PLAIN default (not default_factory) makes it a class attribute, so
+# instances built through the raw ``object.__new__`` fast paths (the
+# native LIST decoder, burst materialization, bind_pods) that predate a
+# field still resolve it — absent from the instance ``__dict__``, the
+# lookup falls back here and reads as "not reported".
+_EMPTY_MAP: Mapping[str, Any] = MappingProxyType({})
 
 
 @dataclass(frozen=True)
@@ -38,6 +47,10 @@ class Node:
     annotations: Mapping[str, str] = field(default_factory=dict)
     labels: Mapping[str, str] = field(default_factory=dict)
     addresses: tuple[NodeAddress, ...] = ()
+    # ``status.allocatable`` quantities (cpu/memory/pods/...), verbatim
+    # wire strings. Empty = the node never reported allocatable — the
+    # fit layer treats that as unknown (fail-open), NOT as zero.
+    allocatable: Mapping[str, Any] = _EMPTY_MAP
 
     def internal_ip(self) -> str:
         """ref: node.go:179-187 — InternalIP, falling back to the name."""
@@ -73,6 +86,10 @@ class Pod:
     owner_references: tuple[OwnerReference, ...] = ()
     containers: tuple[Container, ...] = ()
     node_name: str = ""
+    # ``spec.initContainers`` / ``spec.overhead`` — inputs to the kube
+    # effective-request rule max(init, sum(containers)) + overhead
+    init_containers: tuple[Container, ...] = ()
+    overhead: Mapping[str, Any] = _EMPTY_MAP
 
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"
@@ -640,6 +657,8 @@ class ClusterState:
             and prev.node_name == pod.node_name
             and prev.annotations == pod.annotations
             and prev.containers == pod.containers
+            and prev.init_containers == pod.init_containers
+            and prev.overhead == pod.overhead
         )
         if pod.node_name and not same:
             self._note_pod_change_locked(pod.node_name)
@@ -664,6 +683,36 @@ class ClusterState:
     def delete_pod(self, key: str) -> None:
         with self._lock:
             self._delete_pod_locked(key)
+
+    def evict_pod(self, key: str, now: float | None = None) -> bool:
+        """Eviction-subresource semantics for the in-memory apiserver:
+        remove the pod and emit the ``Evicted`` event (the signal the
+        closed placement loop observes). Returns False when the pod
+        does not exist — the 404 the real subresource answers."""
+        if now is None:
+            now = time.time()
+        with self._lock:
+            pod = self._pods.get(key)
+            if pod is None and self._bursts:
+                hit = self._burst_lookup_locked(key)
+                if hit is not None:
+                    pod = hit[0].materialize(hit[1])
+            if pod is None:
+                return False
+            node_name = pod.node_name
+            self._delete_pod_locked(key)
+        self.emit_event(
+            Event(
+                namespace=pod.namespace,
+                name=f"{pod.name}.evicted",
+                type="Normal",
+                reason="Evicted",
+                message=f"Evicted pod {key} from {node_name}",
+                count=1,
+                last_timestamp=now,
+            )
+        )
+        return True
 
     def _delete_pod_locked(self, key: str) -> None:
         pod = self._pods.pop(key, None)

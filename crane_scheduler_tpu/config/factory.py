@@ -43,6 +43,13 @@ def build_scheduler_from_config(
     weights = {pw.name: pw.weight for pw in profile.score_enabled}
     enabled = set(profile.filter_enabled) | set(weights)
 
+    # allocatable-fit predicate: always on, like the stock scheduler's
+    # default-enabled NodeResourcesFit. Fails open on nodes that never
+    # reported status.allocatable, so config-less sims are unchanged.
+    from ..fit import FitTracker, ResourceFitPlugin
+
+    sched.register(ResourceFitPlugin(FitTracker(cluster)))
+
     if "Dynamic" in enabled:
         args = profile.plugin_config.get("Dynamic", DynamicArgs())
         if policy is None:

@@ -1,0 +1,21 @@
+"""Vectorized resource-fit layer.
+
+Columnar allocatable/requested accounting maintained incrementally off
+the ``ClusterState`` mirror, exposed two ways:
+
+- ``ResourceFitPlugin`` — a framework Filter predicate with stock
+  NodeResourcesFit semantics (closes the over-commit gap in drip mode);
+- ``FitTracker.free_copy_counts`` — per-node capacity rows feeding the
+  gang solver in place of its ``1 << 30`` default.
+"""
+
+from .tracker import UNBOUNDED, FitTracker, pod_fit_request
+from .plugin import PLUGIN_NAME, ResourceFitPlugin
+
+__all__ = [
+    "UNBOUNDED",
+    "FitTracker",
+    "pod_fit_request",
+    "ResourceFitPlugin",
+    "PLUGIN_NAME",
+]

@@ -644,6 +644,7 @@ class BatchScheduler:
         refresh_from_cluster: bool = True,
         hybrid: bool | None = None,
         telemetry: Telemetry | None = None,
+        fit_tracker=None,
     ):
         """``store``/``refresh_from_cluster``: pass the annotator's
         direct-mode store (NodeAnnotator.attach_store) with
@@ -765,6 +766,16 @@ class BatchScheduler:
         self._prepared_snap = None  # host snapshot behind self._prepared
         self._prepared_names: tuple[str, ...] = ()
         self._prepared_n = 0
+        # allocatable-capacity floor for the gang solver: free-fit copy
+        # counts replace the old unbounded (1 << 30) default. Nodes that
+        # never reported status.allocatable stay unbounded, so clusters
+        # without kubelet capacity data (the sim, parity fixtures) solve
+        # bit-identically to before.
+        if fit_tracker is None:
+            from ..fit import FitTracker
+
+            fit_tracker = FitTracker(cluster, telemetry=self._telemetry)
+        self._fit = fit_tracker
 
     def refresh(self) -> None:
         """Bulk re-ingest node annotations (the store is a cache). A
@@ -1571,6 +1582,25 @@ class BatchScheduler:
         )
         return offsets, capacity
 
+    def _fit_capacity(self, template, names, n):
+        """Free-allocatable copy counts for ``template`` aligned with the
+        prepared rows — the fit layer's capacity floor for the gang
+        solver. Returns None when no tracked node reports allocatable
+        (everything unbounded), so callers can skip the min entirely and
+        existing capacity-free paths stay byte-identical."""
+        import numpy as np
+
+        from ..fit import UNBOUNDED, pod_fit_request
+
+        tracker = self._fit
+        if tracker is None:
+            return None
+        tracker.refresh()
+        rows = tracker.free_copy_counts(list(names[:n]), pod_fit_request(template))
+        if not (rows < UNBOUNDED).any():
+            return None
+        return rows
+
     def schedule_gang(
         self,
         template,
@@ -1601,13 +1631,23 @@ class BatchScheduler:
         names = self._prepared_names
 
         step = self._combined_step(dynamic_weight, topology_weight)
+        fit_rows = self._fit_capacity(template, names, n)
         if topology is not None:
             offsets, capacity = self._numa_vectors(
                 template, topology, topology_weight, names, n
             )
+            if fit_rows is not None:
+                np.minimum(capacity, fit_rows, out=capacity)
             npad = prepared.capacity.shape[0]
             offsets = np.pad(offsets, (0, npad - n))
             capacity = np.pad(capacity, (0, npad - n))
+            gang_prepared = step.with_vectors(prepared, capacity, offsets)
+        elif fit_rows is not None:
+            # no NRT CRs, but allocatable is reported: the fit rows alone
+            # cap the solver (this is the old `1 << 30` default's fix)
+            npad = prepared.capacity.shape[0]
+            capacity = np.pad(fit_rows, (0, npad - n))
+            offsets = np.zeros((npad,), dtype=np.int32)
             gang_prepared = step.with_vectors(prepared, capacity, offsets)
         else:
             gang_prepared = prepared
@@ -1883,6 +1923,9 @@ class BatchScheduler:
             offsets, capacity = self._numa_vectors(
                 template, topology, topology_weight, names, n
             )
+            fit_rows = self._fit_capacity(template, names, n)
+            if fit_rows is not None:
+                np.minimum(capacity, fit_rows, out=capacity)
             for node_name in banned:
                 capacity[idx[node_name]] = 0
             retry = gang_assign_host(
@@ -2023,6 +2066,9 @@ class BatchScheduler:
             else:
                 offsets = np.zeros((n,), np.int32)
                 capacity = np.full((n,), 1 << 30, np.int64)
+            fit_rows = self._fit_capacity(template, names, n)
+            if fit_rows is not None:
+                np.minimum(capacity, fit_rows, out=capacity)
             solved = gang_assign_host(
                 scores,
                 cls_sched,

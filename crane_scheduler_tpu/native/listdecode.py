@@ -288,6 +288,10 @@ def _classify_node(obj):
         isinstance(labels, dict) and _all_str(labels)
     ):
         return None
+    if status.get("allocatable"):
+        # allocatable quantities are number-typed resource maps the
+        # columnar string layout cannot hold: per-object path
+        return None
     addrs = status.get("addresses")
     pairs: list[str] = []
     if addrs is not None:
@@ -350,7 +354,11 @@ def _classify_pod(obj):
         node_name = ""
     if not isinstance(node_name, str) or _has_lone_surrogate(node_name):
         return None
-    if spec.get("containers"):
+    if (
+        spec.get("containers")
+        or spec.get("initContainers")
+        or spec.get("overhead")
+    ):
         return None  # nested resource maps: always the per-object path
     strings = [name, ns, node_name]
     anno = anno or {}

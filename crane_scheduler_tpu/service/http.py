@@ -283,24 +283,38 @@ class ScoringHTTPServer:
 
 
 class HealthServer:
-    """Bare /healthz, matching the controller's probe surface."""
+    """Bare /healthz, matching the controller's probe surface.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 8090):
+    ``telemetry``: optionally also serve the registry's Prometheus text
+    exposition on ``/metrics`` — the scrape surface for controllers
+    (annotator, descheduler) that have no scoring sidecar."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8090,
+                 telemetry=None):
         class Handler(BaseHTTPRequestHandler):
             # keep probe connections alive across requests
             protocol_version = "HTTP/1.1"
 
             def do_GET(self):
                 if self.path == "/healthz":
-                    body = b"ok"
-                    self.send_response(200)
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._reply(200, b"ok", "text/plain")
+                elif self.path == "/metrics" and telemetry is not None:
+                    self._reply(
+                        200,
+                        telemetry.registry.render().encode(),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
                 else:
                     self.send_response(404)
                     self.send_header("Content-Length", "0")
                     self.end_headers()
+
+            def _reply(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             def log_message(self, *args):
                 pass

@@ -216,7 +216,13 @@ class Simulator:
         return pod
 
     def build_scheduler(self) -> Scheduler:
+        from ..fit import FitTracker, ResourceFitPlugin
+
         sched = Scheduler(self.cluster, clock=self.clock)
+        # fit predicate first (cheap reject), then load-aware Dynamic —
+        # sim nodes carry no allocatable unless a scenario sets it, so
+        # the fit Filter fails open and existing runs are unchanged
+        sched.register(ResourceFitPlugin(FitTracker(self.cluster)), weight=1)
         sched.register(DynamicPlugin(self.policy, clock=self.clock), weight=3)
         return sched
 

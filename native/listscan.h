@@ -483,7 +483,7 @@ bool parse_item(Ctx& c, int kind, ItemOut* out) {
   bool seen_meta = false, seen_sub = false;
   bool seen_name = false, seen_ns = false, seen_nodename = false;
   bool seen_annos = false, seen_labels = false, seen_arr = false,
-       seen_containers = false;
+       seen_containers = false, seen_initc = false, seen_resmap = false;
   ws(c);
   if (c.p < c.e && *c.p == '}') {
     ++c.p;
@@ -619,9 +619,12 @@ bool parse_item(Ctx& c, int kind, ItemOut* out) {
                 out->fb = true;  // truthy non-string survives the `or ""`
                 if (!skip_value(c, 0)) return false;
               }
-            } else if (kind == 1 && key_eq(c, sk, "containers")) {
-              if (seen_containers) out->fb = true;
-              seen_containers = true;
+            } else if (kind == 1 && (key_eq(c, sk, "containers") ||
+                                     key_eq(c, sk, "initContainers"))) {
+              bool* seen =
+                  key_eq(c, sk, "containers") ? &seen_containers : &seen_initc;
+              if (*seen) out->fb = true;
+              *seen = true;
               ws(c);
               if (is_null_ahead(c)) {
                 c.p += 4;
@@ -634,6 +637,30 @@ bool parse_item(Ctx& c, int kind, ItemOut* out) {
                 } else {
                   // non-empty containers carry nested resource maps with
                   // number-typed values: always the per-object path
+                  out->fb = true;
+                  c.p = open;
+                  if (!skip_value(c, 0)) return false;
+                }
+              } else {
+                out->fb = true;
+                if (!skip_value(c, 0)) return false;
+              }
+            } else if ((kind == 0 && key_eq(c, sk, "allocatable")) ||
+                       (kind == 1 && key_eq(c, sk, "overhead"))) {
+              // resource maps (number-or-string quantities) the columnar
+              // string layout cannot hold: non-empty => per-object path
+              if (seen_resmap) out->fb = true;
+              seen_resmap = true;
+              ws(c);
+              if (is_null_ahead(c)) {
+                c.p += 4;
+              } else if (c.p < c.e && *c.p == '{') {
+                const char* open = c.p;
+                ++c.p;
+                ws(c);
+                if (c.p < c.e && *c.p == '}') {
+                  ++c.p;  // empty map: still the fast shape
+                } else {
                   out->fb = true;
                   c.p = open;
                   if (!skip_value(c, 0)) return false;

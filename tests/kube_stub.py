@@ -70,6 +70,13 @@ class KubeStubState:
         # the POST-safety oracle — a pod with >1 processed bind was
         # double-POSTed, which the pipelined write path must never do
         self.bind_posts: dict[str, int] = {}
+        # processed eviction-subresource POSTs per pod key (same
+        # non-idempotent-POST oracle contract as bind_posts) plus a log
+        # of every eviction actually performed — the closed-loop bench
+        # asserts zero daemonset/system-pod evictions and zero
+        # duplicate eviction POSTs from these
+        self.evict_posts: dict[str, int] = {}
+        self.evictions: list[dict] = []
         # -- read-side fault injection (round 7, mirroring the write
         # faults above) --
         # torn_watch_writes: every watch line is split MID-LINE across
@@ -165,6 +172,10 @@ class KubeStubState:
         with self.lock:
             return sum(1 for v in self.bind_posts.values() if v > 1)
 
+    def duplicate_evictions(self) -> int:
+        with self.lock:
+            return sum(1 for v in self.evict_posts.values() if v > 1)
+
     # -- mutations (each stamps a resourceVersion + history entry) ---------
 
     def _stamp(self, obj: dict) -> dict:
@@ -187,11 +198,17 @@ class KubeStubState:
             self._list_render_cache[kind] = cached
         return cached[1], rv
 
-    def add_node(self, name: str, ip: str, annotations: dict | None = None):
+    def add_node(self, name: str, ip: str, annotations: dict | None = None,
+                 allocatable: dict | None = None):
         with self.lock:
+            status: dict = {
+                "addresses": [{"type": "InternalIP", "address": ip}]
+            }
+            if allocatable is not None:
+                status["allocatable"] = dict(allocatable)
             self.nodes[name] = self._stamp({
                 "metadata": {"name": name, "annotations": dict(annotations or {})},
-                "status": {"addresses": [{"type": "InternalIP", "address": ip}]},
+                "status": status,
             })
             self._notify("nodes", "ADDED", self.nodes[name])
 
@@ -217,15 +234,19 @@ class KubeStubState:
             self._notify("nrts", "ADDED", self.nrts[name])
 
     def add_pod(self, namespace: str, name: str, spec: dict | None = None,
-                annotations: dict | None = None):
+                annotations: dict | None = None,
+                owner_references: list | None = None):
         with self.lock:
             key = f"{namespace}/{name}"
+            meta: dict = {
+                "name": name,
+                "namespace": namespace,
+                "annotations": dict(annotations or {}),
+            }
+            if owner_references:
+                meta["ownerReferences"] = list(owner_references)
             self.pods[key] = self._stamp({
-                "metadata": {
-                    "name": name,
-                    "namespace": namespace,
-                    "annotations": dict(annotations or {}),
-                },
+                "metadata": meta,
                 "spec": dict(spec or {}),
             })
             self._notify("pods", "ADDED", self.pods[key])
@@ -636,6 +657,10 @@ def _make_handler(state: KubeStubState):
                         "duplicate_binds": sum(
                             1 for v in state.bind_posts.values() if v > 1
                         ),
+                        "evict_posts": sum(state.evict_posts.values()),
+                        "duplicate_evictions": sum(
+                            1 for v in state.evict_posts.values() if v > 1
+                        ),
                         "watchers": len(state.watchers),
                         "watcher_backlog": sum(
                             q.qsize() for _, q in state.watchers
@@ -867,6 +892,42 @@ def _make_handler(state: KubeStubState):
                             "lastTimestamp": "2026-07-30T00:00:00Z",
                         })
                         code, payload = 201, {"status": "Success"}
+                elif self.path.endswith("/eviction"):
+                    namespace, name = parts[-4], parts[-2]
+                    key = f"{namespace}/{name}"
+                    pod = state.pods.get(key)
+                    # every PROCESSED eviction counts (non-idempotent
+                    # POST oracle, same contract as bind_posts)
+                    state.evict_posts[key] = state.evict_posts.get(key, 0) + 1
+                    if pod is None:
+                        code, payload = 404, {"message": "pod not found"}
+                    else:
+                        meta = pod.get("metadata", {})
+                        node_name = pod.get("spec", {}).get("nodeName", "")
+                        state.evictions.append({
+                            "key": key,
+                            "node": node_name,
+                            "namespace": namespace,
+                            "daemonset": any(
+                                r.get("kind") == "DaemonSet"
+                                for r in meta.get("ownerReferences") or []
+                            ),
+                        })
+                        del state.pods[key]
+                        state._stamp(pod)
+                        state._notify("pods", "DELETED", pod)
+                        state.emit_event({
+                            "metadata": {
+                                "namespace": namespace,
+                                "name": f"{name}.evicted",
+                            },
+                            "type": "Normal",
+                            "reason": "Evicted",
+                            "message": f"Evicted pod {key} from {node_name}",
+                            "count": 1,
+                            "lastTimestamp": "2026-07-30T00:00:00Z",
+                        })
+                        code, payload = 201, {"status": "Success"}
                 elif parts[-1] == "pods":
                     namespace = parts[-2]
                     meta = body.get("metadata", {})
@@ -875,6 +936,7 @@ def _make_handler(state: KubeStubState):
                         meta.get("name", ""),
                         spec=body.get("spec"),
                         annotations=meta.get("annotations"),
+                        owner_references=meta.get("ownerReferences"),
                     )
                     code, payload = 201, body
             self._json(code, payload)
@@ -1072,13 +1134,16 @@ class KubeStubSubprocess:
         if len(per) == 1:
             return per[0]
         agg: dict = {"requests": {}, "connections": 0, "shard_requests": [],
-                     "bind_posts": 0, "duplicate_binds": 0}
+                     "bind_posts": 0, "duplicate_binds": 0,
+                     "evict_posts": 0, "duplicate_evictions": 0}
         for s in per:
             for k, v in s.get("requests", {}).items():
                 agg["requests"][k] = agg["requests"].get(k, 0) + v
             agg["connections"] += s.get("connections", 0)
             agg["bind_posts"] += s.get("bind_posts", 0)
             agg["duplicate_binds"] += s.get("duplicate_binds", 0)
+            agg["evict_posts"] += s.get("evict_posts", 0)
+            agg["duplicate_evictions"] += s.get("duplicate_evictions", 0)
             agg["shard_requests"].append(
                 sum(s.get("requests", {}).values())
             )

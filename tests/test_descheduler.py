@@ -1,0 +1,388 @@
+"""Load-aware descheduler: sustained-hotspot persistence, victim safety
+gates, the fit guard, dry-run, telemetry, and the stub round trip (the
+eviction-subresource POST through the kube write path, with the stub's
+non-idempotent-POST oracle asserting no duplicates and no daemonset or
+system-namespace victims)."""
+
+import time
+
+from crane_scheduler_tpu.cluster import (
+    ClusterState,
+    Container,
+    Node,
+    OwnerReference,
+    Pod,
+    ResourceRequirements,
+)
+from crane_scheduler_tpu.descheduler import (
+    DeschedulerConfig,
+    LoadAwareDescheduler,
+    WatermarkPolicy,
+)
+from crane_scheduler_tpu.descheduler.config import EVICT_ANNOTATION
+from crane_scheduler_tpu.policy import DEFAULT_POLICY
+from crane_scheduler_tpu.telemetry import Telemetry
+from crane_scheduler_tpu.utils import format_local_time
+
+NOW = 1753776000.0
+
+WATERMARKS = (WatermarkPolicy("cpu_usage_avg_5m", target=0.50, threshold=0.70),)
+
+
+def anno(value, age_seconds=0.0, now=NOW):
+    return f"{value:.5f},{format_local_time(now - age_seconds)}"
+
+
+def usage_annotations(cpu, now=NOW):
+    return {"cpu_usage_avg_5m": anno(cpu, now=now)}
+
+
+def make_pod(name, cpu="100m", node_name="", namespace="default", **kwargs):
+    return Pod(
+        name=name,
+        namespace=namespace,
+        containers=(
+            Container("c", ResourceRequirements(requests={"cpu": cpu})),
+        ),
+        node_name=node_name,
+        **kwargs,
+    )
+
+
+def make_cluster(hot=("hot",), cool=("cool",), hot_cpu=0.9, cool_cpu=0.2,
+                 now=NOW):
+    cluster = ClusterState()
+    for names, cpu in ((hot, hot_cpu), (cool, cool_cpu)):
+        for name in names:
+            cluster.add_node(Node(
+                name=name, annotations=usage_annotations(cpu, now),
+            ))
+    return cluster
+
+
+def make_descheduler(cluster, telemetry=None, **overrides):
+    overrides.setdefault("watermarks", WATERMARKS)
+    overrides.setdefault("consecutive_syncs", 2)
+    return LoadAwareDescheduler(
+        cluster, DEFAULT_POLICY, DeschedulerConfig(**overrides),
+        clock=lambda: NOW, telemetry=telemetry,
+    )
+
+
+# --- hotspot detection ------------------------------------------------------
+
+
+def test_one_spike_never_evicts():
+    cluster = make_cluster()
+    cluster.add_pod(make_pod("w", node_name="hot"))
+    d = make_descheduler(cluster, consecutive_syncs=3)
+    for i in range(2):
+        report = d.sync_once(NOW + i)
+        assert report.hot["hot"][0] == i + 1
+        assert not report.actionable and not report.evicted
+    report = d.sync_once(NOW + 2)
+    assert report.actionable == ["hot"]
+    assert [e.pod_key for e in report.evicted] == ["default/w"]
+    assert report.evicted[0].reason == "cpu_usage_avg_5m"
+
+
+def test_streak_resets_when_node_cools():
+    cluster = make_cluster()
+    cluster.add_pod(make_pod("w", node_name="hot"))
+    d = make_descheduler(cluster, consecutive_syncs=2)
+    d.sync_once(NOW)
+    # node cools between syncs: streak must restart from zero
+    cluster.patch_node_annotation("hot", "cpu_usage_avg_5m", anno(0.30))
+    report = d.sync_once(NOW + 1)
+    assert not report.hot and not report.evicted
+    cluster.patch_node_annotation("hot", "cpu_usage_avg_5m", anno(0.90))
+    report = d.sync_once(NOW + 2)
+    assert report.hot["hot"][0] == 1
+    assert not report.evicted
+
+
+def test_stale_annotation_fails_open():
+    # staleness horizon for cpu_usage_avg_5m: period 180 + 300 = 480s
+    cluster = ClusterState()
+    cluster.add_node(Node(
+        name="hot",
+        annotations={"cpu_usage_avg_5m": anno(0.95, age_seconds=481)},
+    ))
+    cluster.add_node(Node(name="cool",
+                          annotations=usage_annotations(0.2)))
+    cluster.add_pod(make_pod("w", node_name="hot"))
+    d = make_descheduler(cluster, consecutive_syncs=1)
+    report = d.sync_once(NOW)
+    assert not report.hot and not report.evicted
+
+
+def test_malformed_annotation_fails_open():
+    cluster = ClusterState()
+    cluster.add_node(Node(
+        name="hot", annotations={"cpu_usage_avg_5m": "garbage"}
+    ))
+    cluster.add_pod(make_pod("w", node_name="hot"))
+    d = make_descheduler(cluster, consecutive_syncs=1)
+    report = d.sync_once(NOW)
+    assert not report.hot and not report.evicted
+
+
+# --- victim gates -----------------------------------------------------------
+
+
+def test_daemonset_and_system_pods_never_evicted():
+    cluster = make_cluster()
+    cluster.add_pod(make_pod(
+        "ds", node_name="hot",
+        owner_references=(OwnerReference(kind="DaemonSet", name="d"),),
+    ))
+    cluster.add_pod(make_pod("sys", node_name="hot",
+                             namespace="kube-system"))
+    cluster.add_pod(make_pod(
+        "optout", node_name="hot",
+        annotations={EVICT_ANNOTATION: "false"},
+    ))
+    cluster.add_pod(make_pod("victim", node_name="hot"))
+    d = make_descheduler(cluster, consecutive_syncs=1,
+                         max_evictions_per_node=4)
+    report = d.sync_once(NOW)
+    assert [e.pod_key for e in report.evicted] == ["default/victim"]
+    assert report.skipped["daemonset"] == 1
+    assert report.skipped["protected_namespace"] == 1
+    assert report.skipped["opt_out"] == 1
+    # the protected pods are still in the cluster
+    assert cluster.get_pod("default/ds") is not None
+    assert cluster.get_pod("kube-system/sys") is not None
+    assert cluster.get_pod("default/optout") is not None
+
+
+def test_largest_cpu_victim_goes_first():
+    cluster = make_cluster()
+    cluster.add_pod(make_pod("small", cpu="100m", node_name="hot"))
+    cluster.add_pod(make_pod("big", cpu="2", node_name="hot"))
+    d = make_descheduler(cluster, consecutive_syncs=1)
+    report = d.sync_once(NOW)
+    assert [e.pod_key for e in report.evicted] == ["default/big"]
+
+
+def test_per_node_and_per_cycle_budgets():
+    cluster = make_cluster(hot=("hot-a", "hot-b"), cool=("cool",))
+    for i in range(3):
+        cluster.add_pod(make_pod(f"a{i}", node_name="hot-a"))
+        cluster.add_pod(make_pod(f"b{i}", node_name="hot-b"))
+    d = make_descheduler(cluster, consecutive_syncs=1,
+                         max_evictions_per_node=2,
+                         max_evictions_per_cycle=3)
+    report = d.sync_once(NOW)
+    assert len(report.evicted) == 3
+    per_node = {}
+    for ev in report.evicted:
+        per_node[ev.node] = per_node.get(ev.node, 0) + 1
+    assert max(per_node.values()) <= 2
+
+
+def test_node_cooldown_between_evictions():
+    cluster = make_cluster()
+    cluster.add_pod(make_pod("w1", node_name="hot"))
+    cluster.add_pod(make_pod("w2", node_name="hot"))
+    d = LoadAwareDescheduler(
+        cluster, DEFAULT_POLICY,
+        DeschedulerConfig(watermarks=WATERMARKS, consecutive_syncs=1,
+                          node_cooldown_seconds=300.0),
+        clock=lambda: NOW,
+    )
+    assert len(d.sync_once(NOW).evicted) == 1
+    # keep the annotation fresh while time advances past the cooldown
+    cluster.patch_node_annotation("hot", "cpu_usage_avg_5m",
+                                  anno(0.9, now=NOW + 200))
+    report = d.sync_once(NOW + 200)
+    assert not report.evicted and report.skipped["cooldown"] == 1
+    cluster.patch_node_annotation("hot", "cpu_usage_avg_5m",
+                                  anno(0.9, now=NOW + 301))
+    assert len(d.sync_once(NOW + 301).evicted) == 1
+
+
+def test_fit_guard_blocks_eviction_without_landing_capacity():
+    # the only landing node reports allocatable too small for the victim
+    cluster = ClusterState()
+    cluster.add_node(Node(name="hot", annotations=usage_annotations(0.9)))
+    cluster.add_node(Node(
+        name="cool", annotations=usage_annotations(0.2),
+        allocatable={"cpu": "1", "pods": "10"},
+    ))
+    cluster.add_pod(make_pod("giant", cpu="2", node_name="hot"))
+    d = make_descheduler(cluster, consecutive_syncs=1)
+    report = d.sync_once(NOW)
+    assert not report.evicted
+    assert report.skipped["no_fit"] == 1
+    # grow the landing node: now the same victim moves
+    cluster.add_node(Node(
+        name="cool", annotations=usage_annotations(0.2),
+        allocatable={"cpu": "4", "pods": "10"},
+    ))
+    report = d.sync_once(NOW)
+    assert [e.pod_key for e in report.evicted] == ["default/giant"]
+
+
+def test_hot_and_above_target_nodes_are_not_landing_spots():
+    # cool node sits between target (0.5) and threshold (0.7): not hot,
+    # but not a landing spot either -> nothing can move
+    cluster = make_cluster(cool_cpu=0.6)
+    cluster.add_pod(make_pod("w", node_name="hot"))
+    d = make_descheduler(cluster, consecutive_syncs=1)
+    report = d.sync_once(NOW)
+    assert report.actionable == ["hot"]
+    assert not report.evicted and report.skipped["no_fit"] == 1
+
+
+# --- dry-run ----------------------------------------------------------------
+
+
+def test_dry_run_plans_but_never_evicts():
+    cluster = make_cluster()
+    cluster.add_pod(make_pod("w", node_name="hot"))
+    d = make_descheduler(cluster, consecutive_syncs=1, dry_run=True)
+    report = d.sync_once(NOW)
+    assert report.dry_run
+    assert [e.pod_key for e in report.planned] == ["default/w"]
+    assert not report.evicted
+    assert cluster.get_pod("default/w") is not None
+    assert d.stats()["evictions"] == 0
+
+
+# --- telemetry --------------------------------------------------------------
+
+
+def test_telemetry_families_present():
+    tel = Telemetry()
+    cluster = make_cluster()
+    cluster.add_pod(make_pod("w", node_name="hot"))
+    d = make_descheduler(cluster, consecutive_syncs=1, telemetry=tel)
+    d.sync_once(NOW)
+    text = tel.registry.render()
+    assert 'crane_desched_evictions_total{reason="cpu_usage_avg_5m"} 1' in text
+    assert "crane_desched_hotspot_nodes 1" in text
+    assert "crane_desched_cycle_seconds_count 1" in text
+
+
+# --- the closed loop: evict -> re-place -> imbalance falls ------------------
+
+
+def test_evicted_pod_replaces_onto_cool_node():
+    from crane_scheduler_tpu.fit import FitTracker, ResourceFitPlugin
+    from crane_scheduler_tpu.framework.scheduler import Scheduler
+    from crane_scheduler_tpu.plugins import DynamicPlugin
+
+    cluster = ClusterState()
+    cluster.add_node(Node(
+        name="hot",
+        annotations={
+            k: anno(0.9) for k in (
+                "cpu_usage_avg_5m", "cpu_usage_max_avg_1h",
+                "cpu_usage_max_avg_1d", "mem_usage_avg_5m",
+                "mem_usage_max_avg_1h", "mem_usage_max_avg_1d",
+            )
+        },
+        allocatable={"cpu": "8", "pods": "100"},
+    ))
+    cluster.add_node(Node(
+        name="cool",
+        annotations={
+            k: anno(0.2) for k in (
+                "cpu_usage_avg_5m", "cpu_usage_max_avg_1h",
+                "cpu_usage_max_avg_1d", "mem_usage_avg_5m",
+                "mem_usage_max_avg_1h", "mem_usage_max_avg_1d",
+            )
+        },
+        allocatable={"cpu": "8", "pods": "100"},
+    ))
+    cluster.add_pod(make_pod("w", cpu="1", node_name="hot"))
+
+    d = make_descheduler(cluster, consecutive_syncs=1)
+    report = d.sync_once(NOW)
+    assert [e.pod_key for e in report.evicted] == ["default/w"]
+    assert cluster.get_pod("default/w") is None
+
+    # re-place the displaced workload through the drip scheduler: the
+    # Dynamic score steers it onto the cool node, the fit filter allows
+    sched = Scheduler(cluster, clock=lambda: NOW)
+    sched.register(ResourceFitPlugin(FitTracker(cluster)), weight=1)
+    sched.register(DynamicPlugin(DEFAULT_POLICY, clock=lambda: NOW), weight=3)
+    replacement = make_pod("w", cpu="1")
+    cluster.add_pod(replacement)
+    result = sched.schedule_one(replacement)
+    assert result.node == "cool"
+
+
+# --- the stub round trip: eviction POSTs through the write path -------------
+
+
+def test_stub_eviction_round_trip_oracle():
+    import importlib.util
+    import os
+
+    from crane_scheduler_tpu.cluster import KubeClusterClient
+
+    stub_path = os.path.join(os.path.dirname(__file__), "kube_stub.py")
+    spec = importlib.util.spec_from_file_location("kube_stub", stub_path)
+    kube_stub = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(kube_stub)
+    KubeStubServer = kube_stub.KubeStubServer
+
+    srv = KubeStubServer().start()
+    try:
+        srv.state.add_node("hot", "10.0.0.1",
+                           annotations=usage_annotations(0.9),
+                           allocatable={"cpu": "8", "pods": "100"})
+        srv.state.add_node("cool", "10.0.0.2",
+                           annotations=usage_annotations(0.2),
+                           allocatable={"cpu": "8", "pods": "100"})
+        spec = {"nodeName": "hot",
+                "containers": [{"resources": {"requests": {"cpu": "1"}}}]}
+        srv.state.add_pod("default", "victim", spec=spec)
+        srv.state.add_pod(
+            "default", "ds", spec=spec,
+            owner_references=[{"kind": "DaemonSet", "name": "d"}],
+        )
+        srv.state.add_pod("kube-system", "sys", spec=spec)
+
+        client = KubeClusterClient(srv.url)
+        client.start()
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            if len(client.list_pods()) == 3 and len(client.list_nodes()) == 2:
+                break
+            time.sleep(0.02)
+        d = LoadAwareDescheduler(
+            client, DEFAULT_POLICY,
+            DeschedulerConfig(watermarks=WATERMARKS, consecutive_syncs=1,
+                              max_evictions_per_node=3),
+            clock=lambda: NOW,
+        )
+        report = d.sync_once(NOW)
+        assert [e.pod_key for e in report.evicted] == ["default/victim"]
+
+        # the stub's oracle: exactly one processed eviction POST, no
+        # duplicates, and no daemonset/system-namespace victims
+        assert sum(srv.state.evict_posts.values()) == 1
+        assert srv.state.duplicate_evictions() == 0
+        assert [e["key"] for e in srv.state.evictions] == ["default/victim"]
+        assert all(not e["daemonset"] for e in srv.state.evictions)
+        assert all(e["namespace"] != "kube-system"
+                   for e in srv.state.evictions)
+
+        # the DELETED watch event drains back into the mirror
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            if client.get_pod("default/victim") is None:
+                break
+            time.sleep(0.02)
+        assert client.get_pod("default/victim") is None
+        # a second sync with the same state finds nothing else movable
+        # on this node within budget discipline (cooldown active)
+        report2 = d.sync_once(NOW + 1)
+        assert not report2.evicted
+        assert sum(srv.state.evict_posts.values()) == 1
+        client.stop()
+    finally:
+        srv.stop()

@@ -202,5 +202,152 @@ def main() -> int:
     return 1 if failures else 0
 
 
+def desched_main() -> int:
+    """``make desched-smoke``: one dry-run descheduler cycle against the
+    kube stub, then a strict-parse scrape of the controller-side
+    ``/metrics`` (HealthServer) for the ``crane_desched_*`` families.
+    Dry-run means the stub must see ZERO eviction POSTs."""
+    import importlib.util
+    import time as _time
+
+    from crane_scheduler_tpu.cluster.kube import KubeClusterClient
+    from crane_scheduler_tpu.descheduler import (
+        DeschedulerConfig,
+        LoadAwareDescheduler,
+        WatermarkPolicy,
+    )
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+    from crane_scheduler_tpu.service.http import HealthServer
+    from crane_scheduler_tpu.telemetry import Telemetry
+    from crane_scheduler_tpu.telemetry.expfmt import (
+        ExpositionError,
+        parse_exposition,
+    )
+    from crane_scheduler_tpu.utils import format_local_time
+
+    failures = 0
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        nonlocal failures
+        mark = "ok" if ok else "FAIL"
+        print(f"[desched-smoke] {name}: {mark}{' — ' + detail if detail else ''}")
+        if not ok:
+            failures += 1
+
+    stub_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests", "kube_stub.py",
+    )
+    stub_spec = importlib.util.spec_from_file_location(
+        "kube_stub_smoke", stub_path
+    )
+    kube_stub = importlib.util.module_from_spec(stub_spec)
+    stub_spec.loader.exec_module(kube_stub)
+
+    now = _time.time()
+    hot = {"cpu_usage_avg_5m": f"0.92,{format_local_time(now)}"}
+    cool = {"cpu_usage_avg_5m": f"0.18,{format_local_time(now)}"}
+    stub = kube_stub.KubeStubServer().start()
+    tel = Telemetry()
+    client = KubeClusterClient(stub.url)
+    health = HealthServer(port=0, telemetry=tel)
+    health.start()
+    try:
+        stub.state.add_node("hot-0", "10.0.0.1", annotations=hot,
+                            allocatable={"cpu": "8", "pods": "100"})
+        stub.state.add_node("cool-0", "10.0.0.2", annotations=cool,
+                            allocatable={"cpu": "8", "pods": "100"})
+        spec = {"nodeName": "hot-0",
+                "containers": [{"resources": {"requests": {"cpu": "1"}}}]}
+        stub.state.add_pod("default", "worker", spec=spec)
+        stub.state.add_pod(
+            "default", "ds-agent", spec=spec,
+            owner_references=[{"kind": "DaemonSet", "name": "agent"}],
+        )
+        client.start()
+        deadline = _time.time() + 10
+        while _time.time() < deadline:
+            if len(client.list_pods()) == 2 and len(client.list_nodes()) == 2:
+                break
+            _time.sleep(0.02)
+
+        descheduler = LoadAwareDescheduler(
+            client, DEFAULT_POLICY,
+            DeschedulerConfig(
+                watermarks=(WatermarkPolicy(
+                    "cpu_usage_avg_5m", target=0.50, threshold=0.70
+                ),),
+                consecutive_syncs=1,
+                max_evictions_per_node=2,
+                dry_run=True,
+            ),
+            telemetry=tel,
+        )
+        report = descheduler.sync_once(now)
+        check("hotspot detected", report.actionable == ["hot-0"])
+        check("dry-run planned an eviction",
+              [e.pod_key for e in report.planned] == ["default/worker"])
+        check("daemonset gate held",
+              report.skipped.get("daemonset", 0) == 1)
+        check("dry-run sent no eviction POSTs",
+              sum(stub.state.evict_posts.values()) == 0)
+
+        # strict-parse the controller scrape surface
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{health.port}/metrics", timeout=10
+        ) as r:
+            ctype = r.headers["Content-Type"]
+            text = r.read().decode()
+        check("content-type", ctype.startswith("text/plain"), ctype)
+        try:
+            families = parse_exposition(text)
+            check("strict exposition parse", True,
+                  f"{len(families)} families")
+        except ExpositionError as e:
+            families = {}
+            check("strict exposition parse", False, str(e))
+        for required in (
+            "crane_desched_evictions_total",
+            "crane_desched_hotspot_nodes",
+            "crane_desched_skips_total",
+            "crane_desched_cycle_seconds",
+            "crane_fit_tracked_nodes",
+        ):
+            check(f"family {required}", required in families)
+        evictions = {
+            dict(s[1]).get("reason"): s[2]
+            for s in families.get(
+                "crane_desched_evictions_total", {}
+            ).get("samples", ())
+        }
+        check("evictions_total reason label",
+              evictions.get("cpu_usage_avg_5m") == 1, str(evictions))
+        hotspots = [
+            s[2]
+            for s in families.get(
+                "crane_desched_hotspot_nodes", {}
+            ).get("samples", ())
+        ]
+        check("hotspot_nodes gauge", hotspots == [1], str(hotspots))
+        cycle_count = sum(
+            s[2]
+            for s in families.get(
+                "crane_desched_cycle_seconds", {}
+            ).get("samples", ())
+            if s[0].endswith("_count")
+        )
+        check("cycle histogram observed", cycle_count >= 1,
+              f"count={cycle_count}")
+    finally:
+        client.stop()
+        health.stop()
+        stub.stop()
+
+    print(f"[desched-smoke] {'PASS' if not failures else 'FAIL'}")
+    return 1 if failures else 0
+
+
 if __name__ == "__main__":
-    raise SystemExit(main())
+    raise SystemExit(
+        desched_main() if "--desched" in sys.argv[1:] else main()
+    )
