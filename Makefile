@@ -9,7 +9,8 @@ IMAGE_ANNOTATOR := $(REGISTRY)/crane-annotator-tpu:$(GIT_VERSION)
 IMAGE_SCHEDULER := $(REGISTRY)/crane-scheduler-tpu:$(GIT_VERSION)
 
 .PHONY: all native test test-fast bench sim e2e metrics-smoke \
-	desched-smoke clean images image-annotator image-scheduler push-images
+	desched-smoke chaos-smoke clean images image-annotator \
+	image-scheduler push-images
 
 all: native test
 
@@ -40,6 +41,11 @@ metrics-smoke:
 # the controller /metrics for the crane_desched_* families
 desched-smoke:
 	$(PYTHON) tools/metrics_smoke.py --desched
+
+# scripted prometheus outage through the breaker + degraded-mode
+# controller + health registry; strict-parses the resilience families
+chaos-smoke:
+	$(PYTHON) tools/chaos_smoke.py
 
 # -- images (one parameterized Dockerfile per binary, like the
 # reference's ARG PKGNAME build; ref: Makefile images target) ----------

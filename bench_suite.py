@@ -1515,10 +1515,220 @@ def config11(dtype, rtt, n_cool=6, n_hot=2, cycles=12):
                   "the loop (every evictee re-placed)"})
 
 
+def config12(dtype, rtt, n_nodes=6, steps=24, outage_at=4, heal_at=16):
+    """Round-10 tentpole gate: chaos soak — a scripted Prometheus outage
+    against the annotation score path, resilience layer on vs off.
+
+    Both legs run the same annotator-shaped loop on a virtual 60s-step
+    clock against a fresh kube stub + ChaosPromServer: bulk metric query
+    -> ``value,timestamp`` annotations PATCHed through the write path ->
+    the mirror feeds the degraded-mode staleness evaluation. The stub
+    Prometheus goes dark at step ``outage_at`` (connections close
+    unanswered) and heals at ``heal_at``.
+
+      resilience    — breaker-wrapped client (trip at 3 failures,
+                      half-open probe after 1.5 virtual steps) + bounded
+                      retry + DegradedModeController over the mirror
+      no_resilience — PrometheusClient(retry_policy=None, breaker=None):
+                      every sweep hammers the dead endpoint
+
+    Headline: ``recovery_steps``/``recovery_ms`` — fault-heal to the
+    first step where the sweep succeeds, the breaker is closed, and
+    degraded mode has exited (the healthy score path). Gates: the
+    resilience leg recovers within 3 steps of heal without a restart,
+    fail-fasts at least one sweep with zero network attempts while the
+    breaker is open, and enters+exits degraded mode; the no-resilience
+    leg fails every outage sweep and never stops hitting the endpoint."""
+    from crane_scheduler_tpu.cluster.kube import KubeClusterClient
+    from crane_scheduler_tpu.metrics import PrometheusClient
+    from crane_scheduler_tpu.metrics.source import MetricsTransportError
+    from crane_scheduler_tpu.policy import (
+        DynamicSchedulerPolicy,
+        PolicySpec,
+        PredicatePolicy,
+        PriorityPolicy,
+        SyncPolicy,
+    )
+    from crane_scheduler_tpu.resilience import (
+        BreakerState,
+        CircuitBreaker,
+        DegradedModeController,
+        HealthRegistry,
+        RetryPolicy,
+    )
+    from crane_scheduler_tpu.utils import format_local_time
+
+    kube_stub = _load_kube_stub()
+    metric = "cpu_usage_avg_5m"
+    policy = DynamicSchedulerPolicy(
+        spec=PolicySpec(
+            sync_period=(SyncPolicy(metric, 180.0),),
+            predicate=(PredicatePolicy(metric, 0.65),),
+            priority=(PriorityPolicy(metric, 1.0),),
+        )
+    )
+    t0_epoch = 1753776000.0
+    step_s = 60.0
+
+    def leg(with_resilience):
+        server = kube_stub.KubeStubServer().start()
+        prom = kube_stub.ChaosPromServer().start()
+        try:
+            names, ips = [], {}
+            for i in range(n_nodes):
+                name, ip = f"n{i}", f"10.0.0.{i + 1}"
+                server.state.add_node(name, ip)
+                names.append(name)
+                ips[name] = ip
+            prom.set_all(ips.values(), 0.40)
+            client = KubeClusterClient(server.url)
+            client.start()
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                if len(client.list_nodes()) == n_nodes:
+                    break
+                time.sleep(0.02)
+
+            clock_now = t0_epoch
+            breaker = degraded = None
+            if with_resilience:
+                breaker = CircuitBreaker(
+                    "prometheus", failure_threshold=3,
+                    window_s=10 * step_s, reset_timeout_s=1.5 * step_s,
+                    clock=lambda: clock_now,
+                )
+                HealthRegistry().watch_breaker(breaker)
+                degraded = DegradedModeController(
+                    policy.spec, min_eval_interval_s=0.0
+                )
+                promc = PrometheusClient(
+                    prom.url, timeout=2.0,
+                    retry_policy=RetryPolicy(
+                        max_attempts=2, base_delay_s=0.0, max_delay_s=0.0,
+                        deadline_s=30.0, retryable=(MetricsTransportError,),
+                        seed=0, sleep=lambda s: None,
+                    ),
+                    breaker=breaker,
+                )
+            else:
+                promc = PrometheusClient(
+                    prom.url, timeout=2.0,
+                    retry_policy=None, breaker=None,
+                )
+
+            def healthy():
+                if not with_resilience:
+                    return True
+                return (breaker.state == BreakerState.CLOSED
+                        and not degraded.active)
+
+            failed = failfast = 0
+            outage_attempts = 0
+            breaker_opened = degraded_steps = 0
+            recovery_steps = recovery_ms = None
+            heal_wall = None
+            for step in range(steps):
+                clock_now = t0_epoch + step * step_s
+                if step == outage_at:
+                    prom.outage = True
+                if step == heal_at:
+                    prom.outage = False
+                    heal_wall = time.perf_counter()
+                hits_before = prom.hits
+                sweep_ok = False
+                try:
+                    by_inst = promc.query_all_by_metric(metric)
+                    stamp = format_local_time(clock_now)
+                    client.patch_node_annotations_bulk({
+                        name: {metric: f"{by_inst[ips[name]]},{stamp}"}
+                        for name in names if ips[name] in by_inst
+                    })
+                    want = f",{stamp}"
+                    deadline = time.time() + 2.0
+                    while time.time() < deadline:
+                        if any((n.annotations or {}).get(metric, "")
+                               .endswith(want)
+                               for n in client.list_nodes()):
+                            break
+                        time.sleep(0.01)
+                    sweep_ok = True
+                except MetricsTransportError:
+                    failed += 1
+                    if prom.hits == hits_before:
+                        failfast += 1
+                if outage_at <= step < heal_at:
+                    outage_attempts += prom.hits - hits_before
+                if with_resilience:
+                    degraded.update(
+                        (dict(n.annotations or {})
+                         for n in client.list_nodes()),
+                        clock_now,
+                    )
+                    if breaker.state == BreakerState.OPEN:
+                        breaker_opened = 1
+                    if degraded.active:
+                        degraded_steps += 1
+                if (recovery_steps is None and step >= heal_at
+                        and sweep_ok and healthy()):
+                    recovery_steps = step - heal_at
+                    recovery_ms = (time.perf_counter() - heal_wall) * 1e3
+            client.stop()
+            return {
+                "failed_sweeps": failed,
+                "failfast_sweeps": failfast,
+                "outage_network_attempts": outage_attempts,
+                "breaker_opened": bool(breaker_opened),
+                "degraded_steps": degraded_steps,
+                "recovery_steps": recovery_steps,
+                "recovery_ms": (round(recovery_ms, 1)
+                                if recovery_ms is not None else None),
+                "steps": steps,
+            }
+        finally:
+            server.stop()
+            prom.stop()
+
+    legs = {
+        "resilience": leg(True),
+        "no_resilience": leg(False),
+    }
+    res, base = legs["resilience"], legs["no_resilience"]
+    outage_len = heal_at - outage_at
+    # chaos-soak gates: recovery without restart, breaker load-shedding,
+    # degraded-mode engagement — and the baseline showing what they buy
+    assert res["recovery_steps"] is not None, "resilience leg never healed"
+    assert res["recovery_steps"] <= 3, \
+        f"recovery took {res['recovery_steps']} steps > 3"
+    assert res["breaker_opened"], "breaker never opened under outage"
+    assert res["failfast_sweeps"] >= 1, "no sweep ever failed fast"
+    assert res["degraded_steps"] >= 1, "degraded mode never engaged"
+    assert base["failed_sweeps"] == outage_len, \
+        "no-resilience leg should fail every outage sweep"
+    assert base["outage_network_attempts"] >= outage_len, \
+        "no-resilience leg should hammer the dead endpoint every step"
+    emit({"config": 12,
+          "desc": "chaos soak through the wire stubs: scripted "
+                  f"prometheus outage (steps {outage_at}->{heal_at} of "
+                  f"{steps}, {n_nodes} nodes), breaker+retry+degraded "
+                  "resilience leg vs bare-client baseline",
+          "recovery_steps": res["recovery_steps"],
+          "recovery_ms": res["recovery_ms"],
+          "failfast_sweeps": res["failfast_sweeps"],
+          "outage_attempts_resilience": res["outage_network_attempts"],
+          "outage_attempts_no_resilience": base["outage_network_attempts"],
+          "legs": legs,
+          "note": "recovery = fault-heal to the first step with a "
+                  "successful sweep, a closed breaker, and degraded "
+                  "mode exited (the healthy score path); while open "
+                  "the breaker fails sweeps fast (zero network "
+                  "attempts, bounded probes) where the baseline blocks "
+                  "on the dead endpoint every step"})
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--device", choices=["cpu", "default"], default="default")
-    parser.add_argument("--configs", default="1,2,3,4,5,6,7,7b,8,9,10,11")
+    parser.add_argument("--configs", default="1,2,3,4,5,6,7,7b,8,9,10,11,12")
     parser.add_argument("--f64", action="store_true")
     args = parser.parse_args(argv)
 
@@ -1558,6 +1768,8 @@ def main(argv=None) -> int:
         config10(dtype, rtt)
     if 11 in todo:
         config11(dtype, rtt)
+    if 12 in todo:
+        config12(dtype, rtt)
     return 0
 
 

@@ -42,7 +42,11 @@ from ..native.codec import bulk_parse_values
 from ..utils.logging import vlog
 from ..utils.timeutil import format_local_time
 from ..loadstore.store import NodeLoadStore
-from ..metrics.source import MetricsQueryError, MetricsSource
+from ..metrics.source import (
+    MetricsQueryError,
+    MetricsSource,
+    MetricsTransportError,
+)
 from ..policy.types import DynamicSchedulerPolicy
 from ..telemetry import Telemetry, active as active_telemetry
 from .bindings import BindingRecords, max_hot_value_time_range
@@ -107,16 +111,28 @@ class NodeAnnotator:
         policy: DynamicSchedulerPolicy,
         config: AnnotatorConfig | None = None,
         telemetry: Telemetry | None = None,
+        leader_check=None,
+        health=None,
     ):
         self.cluster = cluster
         self.metrics = metrics
         self.policy = policy
         self.config = config or AnnotatorConfig()
+        # ISSUE 8: ``leader_check()`` is consulted immediately before any
+        # annotation write dispatch — a lease stolen between queue pop
+        # and patch flush must abort the flush (a non-leader writing
+        # annotations races the new leader's sweeps). None = always lead.
+        self.leader_check = leader_check
+        # HealthRegistry: bulk-sweep outages flip the ``prometheus``
+        # component here (the breaker transition hook covers CLIs; this
+        # covers embedded annotators wired with just the registry)
+        self.health = health
         self._telemetry = (
             telemetry if telemetry is not None else active_telemetry()
         )
         self._m_sync_seconds = self._m_flush_seconds = None
         self._m_queue_depth = self._m_backoff = self._m_errors = None
+        self._m_leader_aborts = None
         if self._telemetry is not None:
             reg = self._telemetry.registry
             self._m_sync_seconds = reg.histogram(
@@ -138,6 +154,11 @@ class NodeAnnotator:
             self._m_errors = reg.counter(
                 "crane_annotator_sync_errors_total",
                 "Failed node/metric sync attempts",
+            )
+            self._m_leader_aborts = reg.counter(
+                "crane_annotator_leader_aborts_total",
+                "Annotation writes dropped because leadership was lost "
+                "between sweep and flush",
             )
         self.binding_records = None
         if self.config.use_native_bindings:
@@ -209,11 +230,29 @@ class NodeAnnotator:
         """(name, internal_ip) per node (see ``_node_tables``)."""
         return self._node_tables()[0]
 
+    def _leading(self) -> bool:
+        """False only when a leader_check is wired AND reports lost."""
+        check = self.leader_check
+        if check is None:
+            return True
+        try:
+            return bool(check())
+        except Exception:
+            return False  # can't prove leadership: don't write
+
+    def _abort_not_leader(self) -> None:
+        if self._m_leader_aborts is not None:
+            self._m_leader_aborts.inc()
+        vlog(1, "annotation write aborted: leadership lost")
+
     def _patch_per_node(self, per_node: dict) -> None:
         """Apply assembled ``{node: {key: raw}}`` patches through the
         cluster's per-node bulk primitive when present (one lock/HTTP
         PATCH per node), else per-(node, key). The ONE write-dispatch
         implementation for flush/sweep/backfill."""
+        if not self._leading():
+            self._abort_not_leader()
+            return
         bulk = getattr(self.cluster, "patch_node_annotations_bulk", None)
         if bulk is not None:
             bulk(per_node)
@@ -244,6 +283,12 @@ class NodeAnnotator:
         with self._anno_lock:
             cols, self._anno_cols = self._anno_cols, []
         if not cols:
+            return 0
+        if not self._leading():
+            # lease stolen between sweep (queue pop) and flush: the
+            # drained columns are DROPPED, not re-queued — the new
+            # leader's own sweeps are the source of truth now
+            self._abort_not_leader()
             return 0
         total = 0
         # group column segments by the identity of their names list (the
@@ -481,9 +526,24 @@ class NodeAnnotator:
             return 0
         try:
             samples = query_all(metric_name)
+        except MetricsTransportError as e:
+            # the source itself is down (not "no data"): fanning out a
+            # work item per node would just hammer a dead endpoint —
+            # count the error, flip health, and let the breaker's
+            # half-open probe decide when the next sweep goes through
+            self.sync_errors += 1
+            if self._m_errors is not None:
+                self._m_errors.inc()
+            if self.health is not None:
+                self.health.set(
+                    "prometheus", "degraded", f"bulk sweep failed: {e}"
+                )
+            return 0
         except MetricsQueryError:
             self.enqueue_metric(metric_name)
             return 0
+        if self.health is not None:
+            self.health.set("prometheus", "healthy")
         import numpy as np
 
         direct = self._store is not None and self.config.direct_store

@@ -63,14 +63,23 @@ def main(argv=None) -> int:
     from ..annotator import AnnotatorConfig, NodeAnnotator
     from ..cluster import ClusterState, Node, NodeAddress
     from ..policy import DEFAULT_POLICY, load_policy_from_file
+    from ..resilience import CircuitBreaker, HealthRegistry
     from ..service.http import HealthServer
     from ..service.leader import LeaderElector
+    from ..telemetry import active as active_telemetry
 
     policy = (
         load_policy_from_file(args.policy_config_path)
         if args.policy_config_path
         else DEFAULT_POLICY
     )
+
+    # resilience spine (ISSUE 8): per-fault-domain breakers feeding one
+    # health registry; /healthz serves its aggregated snapshot
+    tel = active_telemetry()
+    health_reg = HealthRegistry(telemetry=tel)
+    prom_breaker = CircuitBreaker("prometheus", telemetry=tel)
+    health_reg.watch_breaker(prom_breaker)
 
     if args.master:
         from ..cluster.kube import KubeClusterClient
@@ -79,6 +88,10 @@ def main(argv=None) -> int:
             args.master, args.token_file,
             concurrent_syncs=args.concurrent_syncs,
         )
+        cluster.read_breaker = CircuitBreaker("kube-read", telemetry=tel)
+        cluster.write_breaker = CircuitBreaker("kube-write", telemetry=tel)
+        health_reg.watch_breaker(cluster.read_breaker)
+        health_reg.watch_breaker(cluster.write_breaker)
         cluster.start()
         print(f"kube mirror: {len(cluster.list_nodes())} nodes from {args.master}",
               flush=True)
@@ -102,7 +115,7 @@ def main(argv=None) -> int:
     if args.prometheus_address:
         from ..metrics import PrometheusClient
 
-        metrics = PrometheusClient(args.prometheus_address)
+        metrics = PrometheusClient(args.prometheus_address, breaker=prom_breaker)
     else:
         from ..metrics import FakeMetricsSource
 
@@ -110,6 +123,14 @@ def main(argv=None) -> int:
         for node in cluster.list_nodes():
             for sp in policy.spec.sync_period:
                 metrics.set(sp.name, node.internal_ip(), 0.25, by="ip")
+
+    # the elector is constructed after the annotator, so the leadership
+    # gate late-binds through this holder; before election starts (or
+    # without --leader-elect) the annotator is considered leading
+    elector_box = []
+
+    def leader_check() -> bool:
+        return not elector_box or bool(elector_box[0].is_leader)
 
     annotator = NodeAnnotator(
         cluster,
@@ -119,9 +140,12 @@ def main(argv=None) -> int:
             binding_heap_size=args.binding_heap_size,
             concurrent_syncs=args.concurrent_syncs,
         ),
+        leader_check=leader_check if args.leader_elect else None,
+        health=health_reg,
     )
 
-    health = HealthServer(port=args.health_port)
+    health = HealthServer(port=args.health_port, telemetry=tel,
+                          health=health_reg)
     health.start()
     print(f"healthz on :{health.port}", flush=True)
 
@@ -183,6 +207,7 @@ def main(argv=None) -> int:
                 on_stopped_leading=lost_lease,
             )
             print(f"leader election on {args.lock_file}", flush=True)
+        elector_box.append(elector)
         thread = threading.Thread(target=elector.run, daemon=True)
         thread.start()
     else:

@@ -52,6 +52,12 @@ def main(argv=None) -> int:
                         help="cpu_usage_avg_5m safe-landing watermark")
     parser.add_argument("--dry-run", action="store_true",
                         help="plan and count, never evict")
+    parser.add_argument("--degraded-enter-fraction", type=float, default=0.5,
+                        help="suspend evictions when more than this "
+                             "fraction of nodes has stale annotations")
+    parser.add_argument("--degraded-exit-fraction", type=float, default=0.25,
+                        help="resume evictions once the stale fraction "
+                             "falls back below this (hysteresis)")
     parser.add_argument("--leader-elect", action="store_true")
     parser.add_argument("--lock-file", default="/tmp/crane-descheduler.lock")
     parser.add_argument("--run-seconds", type=float, default=0.0,
@@ -72,6 +78,11 @@ def main(argv=None) -> int:
         WatermarkPolicy,
     )
     from ..policy import DEFAULT_POLICY, load_policy_from_file
+    from ..resilience import (
+        CircuitBreaker,
+        DegradedModeController,
+        HealthRegistry,
+    )
     from ..service.http import HealthServer
     from ..service.leader import LeaderElector
 
@@ -81,11 +92,17 @@ def main(argv=None) -> int:
         else DEFAULT_POLICY
     )
     telemetry = telemetry_mod.enable()
+    health_reg = HealthRegistry(telemetry=telemetry)
 
     if args.master:
         from ..cluster.kube import KubeClusterClient
 
         cluster = KubeClusterClient.from_flags(args.master, args.token_file)
+        cluster.read_breaker = CircuitBreaker("kube-read", telemetry=telemetry)
+        cluster.write_breaker = CircuitBreaker("kube-write",
+                                               telemetry=telemetry)
+        health_reg.watch_breaker(cluster.read_breaker)
+        health_reg.watch_breaker(cluster.write_breaker)
         cluster.start()
         print(f"kube mirror: {len(cluster.list_nodes())} nodes from "
               f"{args.master}", flush=True)
@@ -124,11 +141,21 @@ def main(argv=None) -> int:
         sync_period_seconds=args.sync_period_seconds,
         dry_run=args.dry_run,
     )
+    # ISSUE 8: evictions are hard-suspended while the annotation fabric
+    # is degraded — evicting on stale load data makes outages worse
+    degraded = DegradedModeController(
+        policy.spec,
+        enter_fraction=args.degraded_enter_fraction,
+        exit_fraction=args.degraded_exit_fraction,
+        telemetry=telemetry,
+        health=health_reg,
+    )
     descheduler = LoadAwareDescheduler(
-        cluster, policy, config, telemetry=telemetry
+        cluster, policy, config, telemetry=telemetry, degraded=degraded
     )
 
-    health = HealthServer(port=args.health_port, telemetry=telemetry)
+    health = HealthServer(port=args.health_port, telemetry=telemetry,
+                          health=health_reg)
     health.start()
     print(f"healthz+metrics on :{health.port}"
           f"{' (dry-run)' if args.dry_run else ''}", flush=True)

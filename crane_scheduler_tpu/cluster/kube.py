@@ -779,8 +779,19 @@ class KubeClusterClient:
         concurrent_syncs: int = 4,
         pipeline_depth: int = 16,
         telemetry: Telemetry | None = None,
+        read_breaker=None,
+        write_breaker=None,
     ):
         self.base_url = base_url.rstrip("/")
+        # ISSUE 8: per-fault-domain breakers. The read breaker sees one
+        # outcome per LIST and per watch-stream iteration; the write
+        # breaker one per pooled write. Both are OBSERVATIONAL on this
+        # layer — the reflector loop keeps its own backoff and the write
+        # path its indeterminate-response discipline — but their state
+        # transitions drive /healthz and the degraded-mode interlocks,
+        # and CLIs consult them before scheduling non-critical work.
+        self.read_breaker = read_breaker
+        self.write_breaker = write_breaker
         self._telemetry = (
             telemetry if telemetry is not None else active_telemetry()
         )
@@ -993,8 +1004,22 @@ class KubeClusterClient:
                     workers.append(w)
                 self._pool = workers
             worker = self._pool[hash(key) % len(self._pool)]
+            if self.write_breaker is not None:
+                fut.add_done_callback(self._record_write_outcome)
             worker.queue.put((method, path, body, content_type, fut))
         return fut
+
+    def _record_write_outcome(self, fut: Future) -> None:
+        """Feed the kube-write breaker one outcome per pooled write."""
+        try:
+            result = fut.result()
+        except Exception:
+            self.write_breaker.record_failure()
+            return
+        if getattr(result, "ok", bool(result)):
+            self.write_breaker.record_success()
+        else:
+            self.write_breaker.record_failure()
 
     def _write(
         self,
@@ -1017,17 +1042,26 @@ class KubeClusterClient:
         sep = "&" if "?" in path else "?"
         token = None
         rv = None
-        while True:
-            url = f"{path}{sep}limit={self._list_page_limit}"
-            if token:
-                url += f"&continue={token}"
-            payload = self._get_json(url)
-            items.extend(payload.get("items", []))
-            meta = payload.get("metadata", {})
-            rv = meta.get("resourceVersion", rv)
-            token = meta.get("continue")
-            if not token:
-                return items, rv
+        breaker = self.read_breaker
+        try:
+            while True:
+                url = f"{path}{sep}limit={self._list_page_limit}"
+                if token:
+                    url += f"&continue={token}"
+                payload = self._get_json(url)
+                items.extend(payload.get("items", []))
+                meta = payload.get("metadata", {})
+                rv = meta.get("resourceVersion", rv)
+                token = meta.get("continue")
+                if not token:
+                    break
+        except Exception:
+            if breaker is not None:
+                breaker.record_failure()
+            raise
+        if breaker is not None:
+            breaker.record_success()
+        return items, rv
 
     @staticmethod
     def _peek_continue(body: bytes):
@@ -1072,6 +1106,7 @@ class KubeClusterClient:
         pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="list-prefetch"
         )
+        breaker = self.read_breaker
         try:
             body = self._get_bytes(page_url(None))
             while True:
@@ -1093,6 +1128,8 @@ class KubeClusterClient:
                 if not token:
                     if fut is not None:
                         fut.cancel()
+                    if breaker is not None:
+                        breaker.record_success()
                     return pages, rv
                 if fut is not None and peeked == token:
                     body = fut.result()
@@ -1100,6 +1137,10 @@ class KubeClusterClient:
                     if fut is not None:
                         fut.cancel()
                     body = self._get_bytes(page_url(token))
+        except Exception:
+            if breaker is not None:
+                breaker.record_failure()
+            raise
         finally:
             pool.shutdown(wait=False)
 
@@ -1831,9 +1872,11 @@ class KubeClusterClient:
 
         failures = 0
         delivered = False  # anything (incl. bookmarks) on the last stream
+        breaker = self.read_breaker
         while not self._stop.is_set():
             delivered = False
             idle_expired = False
+            failures_before = failures
             connected_at = _time.monotonic()
             try:
                 if relist is not None and self._rvs.get(rv_key) is None:
@@ -1875,6 +1918,14 @@ class KubeClusterClient:
             except (urllib.error.URLError, OSError, json.JSONDecodeError):
                 self.watch_errors += 1
                 failures += 1
+            if breaker is not None:
+                # one breaker outcome per stream iteration: a failed
+                # stream counts against the kube-read fault domain, a
+                # healthy one (delivered, or clean idle expiry) clears it
+                if failures > failures_before:
+                    breaker.record_failure()
+                elif delivered or idle_expired:
+                    breaker.record_success()
             lived = _time.monotonic() - connected_at
             if self._reconnect_immediately(
                 delivered, failures, lived, idle_expired

@@ -37,6 +37,7 @@ _SKIP_REASONS = (
     "cycle_budget",
     "no_fit",
     "evict_failed",
+    "degraded_suspended",
 )
 
 
@@ -59,6 +60,9 @@ class CycleReport:
     planned: list[Eviction] = field(default_factory=list)
     skipped: dict[str, int] = field(default_factory=dict)
     dry_run: bool = False
+    # cluster-wide degraded mode: the whole cycle was suspended because
+    # most load annotations are stale (evicting on them is unsafe)
+    suspended: bool = False
 
 
 class LoadAwareDescheduler:
@@ -78,9 +82,11 @@ class LoadAwareDescheduler:
         fit_tracker=None,
         clock=time.time,
         telemetry: Telemetry | None = None,
+        degraded=None,
     ):
         self.cluster = cluster
         self.policy = policy
+        self.degraded = degraded  # DegradedModeController | None
         self.config = config if config is not None else DeschedulerConfig()
         if fit_tracker is None:
             from ..fit import FitTracker
@@ -190,6 +196,17 @@ class LoadAwareDescheduler:
         cfg = self.config
         report = CycleReport(now=now, dry_run=cfg.dry_run)
         nodes = self.cluster.list_nodes()
+        if self.degraded is not None:
+            # hard interlock: evicting on stale load data is the one
+            # unsafe action in the system — suspend the whole cycle
+            # while the cluster-wide staleness tracker says degraded
+            self.degraded.update(
+                (dict(n.annotations or {}) for n in nodes), now
+            )
+            if self.degraded.active:
+                report.suspended = True
+                self._skip(report.skipped, "degraded_suspended")
+                return report
         live = {n.name for n in nodes}
         for gone in set(self._streak) - live:
             del self._streak[gone]

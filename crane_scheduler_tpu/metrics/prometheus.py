@@ -13,6 +13,21 @@ Reproduces ``promClient`` (ref: pkg/controller/prometheus/prometheus.go):
   errors; negative/NaN samples clamp to 0; the *last* vector element wins;
   the value re-serialized with 5-decimal fixed formatting.
 
+Beyond the reference (ISSUE 8):
+
+- node IPs/names are ``re.escape``\\ d before interpolation into the
+  ``instance=~"..."`` matcher — PromQL regexes are fully anchored, but a
+  dotted IP like ``10.0.0.1`` would otherwise also match the lookalike
+  instance ``10a0b0c1``;
+- transport/server failures (connection refused, timeout, 429/5xx,
+  malformed body) raise ``MetricsTransportError`` instead of being
+  swallowed into "no data" — an outage must surface, not masquerade as a
+  missing metric;
+- each logical query runs under an optional ``RetryPolicy`` (bounded
+  full-jitter backoff honoring Retry-After) and ``CircuitBreaker``
+  (target ``prometheus``): the breaker sees one outcome per query, and
+  while open the client fails fast without touching the network.
+
 Uses only the stdlib (urllib) so the framework has no HTTP dependency.
 """
 
@@ -20,34 +35,65 @@ from __future__ import annotations
 
 import json
 import math
+import re
 import urllib.error
 import urllib.parse
 import urllib.request
 
 from ..loadstore.codec import format_metric_value
-from .source import MetricsQueryError
+from ..resilience.retry import RetryBudgetExceeded, RetryPolicy
+from .source import MetricsQueryError, MetricsTransportError
 
 DEFAULT_QUERY_TIMEOUT_SECONDS = 10.0  # ref: prometheus.go:17
 
+_DEFAULT_RETRY = object()  # sentinel: build the standard policy
+
+
+def _parse_retry_after(headers) -> float:
+    try:
+        raw = headers.get("Retry-After") if headers is not None else None
+        return max(0.0, float(raw)) if raw else 0.0
+    except (TypeError, ValueError):
+        return 0.0
+
 
 class PrometheusClient:
-    def __init__(self, address: str, timeout: float = DEFAULT_QUERY_TIMEOUT_SECONDS):
+    def __init__(
+        self,
+        address: str,
+        timeout: float = DEFAULT_QUERY_TIMEOUT_SECONDS,
+        *,
+        retry_policy=_DEFAULT_RETRY,
+        breaker=None,
+    ):
         self.address = address.rstrip("/")
         self.timeout = timeout
+        if retry_policy is _DEFAULT_RETRY:
+            retry_policy = RetryPolicy(
+                max_attempts=3,
+                base_delay_s=0.2,
+                max_delay_s=2.0,
+                deadline_s=8.0,
+                retryable=(MetricsTransportError,),
+            )
+        self.retry_policy = retry_policy
+        self.breaker = breaker
 
     # -- public interface (ref: prometheus.go:21-28) -----------------------
 
     def query_by_node_ip(self, metric_name: str, ip: str) -> str:
-        result = self._try_query(f'{metric_name}{{instance=~"{ip}"}} /100')
+        pat = re.escape(ip)
+        result = self._try_query(f'{metric_name}{{instance=~"{pat}"}} /100')
         if result:
             return result
-        result = self._try_query(f'{metric_name}{{instance=~"{ip}:.+"}} /100')
+        result = self._try_query(f'{metric_name}{{instance=~"{pat}:.+"}} /100')
         if result:
             return result
         raise MetricsQueryError(f"no data for {metric_name}{{instance=~{ip}}}")
 
     def query_by_node_name(self, metric_name: str, name: str) -> str:
-        result = self._try_query(f'{metric_name}{{instance=~"{name}"}} /100')
+        pat = re.escape(name)
+        result = self._try_query(f'{metric_name}{{instance=~"{pat}"}} /100')
         if result:
             return result
         raise MetricsQueryError(f"no data for {metric_name}{{instance=~{name}}}")
@@ -70,14 +116,7 @@ class PrometheusClient:
         promql = f"{metric_name} /100"
         if offset:
             promql = f"{metric_name} offset {offset} /100"
-        url = f"{self.address}/api/v1/query?" + urllib.parse.urlencode(
-            {"query": promql}
-        )
-        try:
-            with urllib.request.urlopen(url, timeout=self.timeout) as resp:
-                payload = json.load(resp)
-        except (urllib.error.URLError, OSError, ValueError) as e:
-            raise MetricsQueryError(f"query failed: {e}") from e
+        payload = self._fetch(promql)
         if payload.get("status") != "success":
             raise MetricsQueryError(f"query error: {payload.get('error')}")
         data = payload.get("data", {})
@@ -96,11 +135,14 @@ class PrometheusClient:
         return out
 
     def query_by_node_ip_with_offset(self, metric_name: str, ip: str, offset: str) -> str:
-        result = self._try_query(f'{metric_name}{{instance=~"{ip}"}} offset {offset} /100')
+        pat = re.escape(ip)
+        result = self._try_query(
+            f'{metric_name}{{instance=~"{pat}"}} offset {offset} /100'
+        )
         if result:
             return result
         result = self._try_query(
-            f'{metric_name}{{instance=~"{ip}:.+"}} offset {offset} /100'
+            f'{metric_name}{{instance=~"{pat}:.+"}} offset {offset} /100'
         )
         if result:
             return result
@@ -109,19 +151,19 @@ class PrometheusClient:
     # -- internals ---------------------------------------------------------
 
     def _try_query(self, promql: str) -> str:
+        """"" when the query answered with no data; protocol anomalies on
+        a *healthy* server also fall through to the fallback query —
+        but transport/server failures propagate (ISSUE 8 satellite: an
+        outage must not masquerade as a missing metric)."""
         try:
             return self._query(promql)
+        except MetricsTransportError:
+            raise
         except MetricsQueryError:
             return ""
 
     def _query(self, promql: str) -> str:
-        url = f"{self.address}/api/v1/query?" + urllib.parse.urlencode({"query": promql})
-        try:
-            with urllib.request.urlopen(url, timeout=self.timeout) as resp:
-                payload = json.load(resp)
-        except (urllib.error.URLError, OSError, ValueError) as e:
-            raise MetricsQueryError(f"query failed: {e}") from e
-
+        payload = self._fetch(promql)
         if payload.get("status") != "success":
             raise MetricsQueryError(f"query error: {payload.get('error')}")
         if payload.get("warnings"):
@@ -140,3 +182,42 @@ class PrometheusClient:
                 value = 0.0
             metric_value = format_metric_value(value)  # last element wins
         return metric_value
+
+    def _fetch(self, promql: str) -> dict:
+        """One logical query = one breaker outcome; the retry policy runs
+        *inside* the breaker so a query that eventually succeeds counts
+        as a success."""
+        breaker = self.breaker
+        if breaker is not None and not breaker.allow():
+            raise MetricsTransportError(
+                f"prometheus breaker open ({promql})",
+                retry_after_s=breaker.retry_after_s(),
+            )
+        try:
+            if self.retry_policy is None:
+                payload = self._fetch_once(promql)
+            else:
+                try:
+                    payload = self.retry_policy.call(self._fetch_once, promql)
+                except RetryBudgetExceeded as e:
+                    raise e.last from e
+        except MetricsTransportError:
+            if breaker is not None:
+                breaker.record_failure()
+            raise
+        if breaker is not None:
+            breaker.record_success()
+        return payload
+
+    def _fetch_once(self, promql: str) -> dict:
+        url = f"{self.address}/api/v1/query?" + urllib.parse.urlencode({"query": promql})
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout) as resp:
+                return json.load(resp)
+        except urllib.error.HTTPError as e:
+            raise MetricsTransportError(
+                f"query failed: HTTP {e.code}",
+                retry_after_s=_parse_retry_after(e.headers),
+            ) from e
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            raise MetricsTransportError(f"query failed: {e}") from e

@@ -1,0 +1,49 @@
+"""Shared fault-domain resilience layer (ISSUE 8).
+
+The reference decouples the metrics sync path from the scheduling hot
+path through node annotations, so the system's real failure modes are
+*partial*: Prometheus down but the apiserver fine, annotations stale
+cluster-wide while binds still flow, 429/5xx storms against live
+eviction budgets. Before this layer each component handled its own
+slice ad hoc (per-node fail-open staleness in the oracle, workqueue
+backoff in the annotator, indeterminate-response discipline in the
+write path); nothing reasoned about a fault domain as a whole.
+
+Four pieces, shared by every component:
+
+- ``CircuitBreaker`` — closed/open/half-open over a sliding failure
+  window, one instance per fault target (``prometheus``, ``kube-read``,
+  ``kube-write``, ``device-dispatch``);
+- ``RetryPolicy`` — full-jitter exponential backoff with per-call
+  deadline budgets and ``Retry-After`` awareness;
+- ``HealthRegistry`` — aggregates component states
+  (healthy/degraded/failed, with reasons), served on ``/healthz`` and
+  exported as ``crane_health_state{component}`` gauges;
+- ``DegradedModeController`` — cluster-wide staleness tracker over the
+  ``value,timestamp`` annotations with enter/exit hysteresis; while
+  active the Dynamic plugin serves resource-fit + spread-only scores
+  and the descheduler hard-suspends evictions.
+
+``chaos`` holds the deterministic seeded ``ChaosPlan`` harness that
+drives the kube/prometheus stubs to prove the above under injected
+faults (tests/test_chaos.py, tools/chaos_smoke.py, bench config 12).
+"""
+
+from .breaker import BreakerOpenError, BreakerState, CircuitBreaker
+from .chaos import ChaosEvent, ChaosPlan
+from .degraded import DegradedModeController
+from .health import HealthRegistry, HealthState
+from .retry import RetryBudgetExceeded, RetryPolicy
+
+__all__ = [
+    "BreakerOpenError",
+    "BreakerState",
+    "CircuitBreaker",
+    "ChaosEvent",
+    "ChaosPlan",
+    "DegradedModeController",
+    "HealthRegistry",
+    "HealthState",
+    "RetryBudgetExceeded",
+    "RetryPolicy",
+]

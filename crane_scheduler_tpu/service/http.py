@@ -52,8 +52,12 @@ class ServiceRouter:
     ``(method, target, headers, body) -> (status, content_type, bytes)``.
     ``headers`` keys are lower-cased."""
 
-    def __init__(self, service: ScoringService):
+    def __init__(self, service: ScoringService, health=None):
         self.service = service
+        # HealthRegistry (ISSUE 8): /healthz serves its aggregated
+        # snapshot — overall worst-of state plus per-component reasons —
+        # instead of an unconditional "ok"
+        self.health = health
         reg = service.telemetry.registry
         self._m_request_seconds = reg.histogram(
             "crane_service_request_seconds",
@@ -104,6 +108,12 @@ class ServiceRouter:
         service = self.service
         path, _, query = target.partition("?")
         if path == "/healthz":
+            if self.health is not None:
+                snap = self.health.snapshot()
+                # degraded still probes 200 (the process serves, on the
+                # fallback path); only failed flips the probe
+                code = 503 if snap["status"] == "failed" else 200
+                return self._json(code, snap)
             return self._json(200, {"status": "ok"})
         if path == "/metrics":
             if self._wants_exposition(headers):
@@ -227,13 +237,14 @@ class ScoringHTTPServer:
         frontend: str | None = None,
         workers: int = 8,
         protocol: str = "HTTP/1.1",
+        health=None,
     ):
         if frontend is None:
             frontend = os.environ.get("CRANE_SERVICE_FRONTEND", "async")
         if frontend not in ("async", "threaded"):
             raise ValueError(f"unknown frontend {frontend!r}")
         self.frontend = frontend
-        self.router = ServiceRouter(service)
+        self.router = ServiceRouter(service, health=health)
         self.httpd = None  # the threaded front end's stdlib server
         self._async = None
         self._thread: threading.Thread | None = None
@@ -287,16 +298,29 @@ class HealthServer:
 
     ``telemetry``: optionally also serve the registry's Prometheus text
     exposition on ``/metrics`` — the scrape surface for controllers
-    (annotator, descheduler) that have no scoring sidecar."""
+    (annotator, descheduler) that have no scoring sidecar.
+
+    ``health``: a ``HealthRegistry`` — when wired, ``/healthz`` serves
+    its aggregated JSON snapshot (503 only when some component is
+    ``failed``; ``degraded`` still probes 200 because the process keeps
+    serving on its fallback path)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 8090,
-                 telemetry=None):
+                 telemetry=None, health=None):
         class Handler(BaseHTTPRequestHandler):
             # keep probe connections alive across requests
             protocol_version = "HTTP/1.1"
 
             def do_GET(self):
                 if self.path == "/healthz":
+                    if health is not None:
+                        snap = health.snapshot()
+                        code = 503 if snap["status"] == "failed" else 200
+                        self._reply(
+                            code, json.dumps(snap).encode(),
+                            "application/json",
+                        )
+                        return
                     self._reply(200, b"ok", "text/plain")
                 elif self.path == "/metrics" and telemetry is not None:
                     self._reply(

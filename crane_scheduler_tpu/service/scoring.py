@@ -42,9 +42,11 @@ import time
 from dataclasses import dataclass, field
 
 from ..cluster.state import ClusterState
+from ..constants import MAX_NODE_SCORE, MIN_NODE_SCORE
 from ..policy.compile import compile_policy
 from ..policy.types import DynamicSchedulerPolicy
 from ..loadstore.store import NodeLoadStore
+from ..resilience.breaker import BreakerOpenError
 from ..scorer import oracle
 from ..scorer.batched import BatchedScorer
 from ..telemetry import Telemetry
@@ -206,11 +208,21 @@ class ScoringService:
         backend: str = "xla",
         telemetry: Telemetry | None = None,
         now_bucket_s: float = 0.25,
+        device_breaker=None,
+        degraded=None,
     ):
         import jax.numpy as jnp
 
         self.cluster = cluster
         self.policy = policy
+        # ISSUE 8: breaker over the device dispatch — while open,
+        # score_batch goes straight to the scalar oracle (the existing
+        # fail-open path) without touching the device; half-open probes
+        # let a recovered device win back the traffic
+        self.device_breaker = device_breaker
+        # cluster-wide staleness tracker; refresh() re-evaluates it and
+        # while degraded the service serves annotation-free spread scores
+        self.degraded = degraded
         self.tensors = compile_policy(policy)
         self.store = NodeLoadStore(self.tensors)
         if backend == "pallas":
@@ -284,6 +296,10 @@ class ScoringService:
             "crane_service_response_cache_hits_total",
             "Score responses served as pre-rendered bytes",
         )
+        self._m_degraded_scores = reg.counter(
+            "crane_scoring_degraded_scores_total",
+            "score_batch calls served spread-only in degraded mode",
+        )
 
     # -- refresh -----------------------------------------------------------
 
@@ -302,6 +318,10 @@ class ScoringService:
         cv = self._cluster_version()
         with self._lock, self.telemetry.spans.span("refresh"):
             nodes = self.cluster.list_nodes()
+            if self.degraded is not None:
+                self.degraded.update(
+                    (n.annotations for n in nodes), self._clock()
+                )
             self.store.bulk_ingest((n.name, n.annotations) for n in nodes)
             self.store.prune_absent(n.name for n in nodes)
             with self._stats_lock:
@@ -352,14 +372,28 @@ class ScoringService:
                 else -1.0
             )
         self._m_staleness.set(staleness)
-        try:
-            with self.telemetry.spans.span("score_batch"):
-                verdicts = self._score_tpu(now)
-        except Exception:
-            self._m_fallbacks.inc()
-            with self._stats_lock:
-                self.stats.fallbacks += 1
-            verdicts = self._score_oracle(now)
+        if self.degraded is not None and self.degraded.active:
+            # one explicit mode transition instead of per-node neutral
+            # drift: every annotation the scorer would read is stale
+            verdicts = self._score_spread(now)
+            self._m_degraded_scores.inc()
+        else:
+            breaker = self.device_breaker
+            admitted = breaker is None or breaker.allow()
+            try:
+                if not admitted:
+                    raise BreakerOpenError(breaker.target)
+                with self.telemetry.spans.span("score_batch"):
+                    verdicts = self._score_tpu(now)
+                if breaker is not None:
+                    breaker.record_success()
+            except Exception:
+                if breaker is not None and admitted:
+                    breaker.record_failure()
+                self._m_fallbacks.inc()
+                with self._stats_lock:
+                    self.stats.fallbacks += 1
+                verdicts = self._score_oracle(now)
         elapsed = time.perf_counter() - start
         self._m_score_seconds.observe(elapsed)
         with self._stats_lock:
@@ -403,6 +437,25 @@ class ScoringService:
             schedulable=schedulable,
             scores=scores,
             backend="oracle-fallback",
+            staleness_seconds=0.0,
+        )
+
+    def _score_spread(self, now: float) -> BatchVerdicts:
+        """Degraded-mode verdicts: every node schedulable (ResourceFit
+        still guards capacity on the consumer side), fewest pods wins —
+        no annotation is consulted. Mirrors ``plugins.dynamic``'s
+        degraded path so drip and batch agree on the fallback policy."""
+        schedulable: dict[str, bool] = {}
+        scores: dict[str, int] = {}
+        list_pods = getattr(self.cluster, "list_pods", None)
+        for node in self.cluster.list_nodes():
+            schedulable[node.name] = True
+            npods = len(list_pods(node.name)) if callable(list_pods) else 0
+            scores[node.name] = max(MIN_NODE_SCORE, MAX_NODE_SCORE - npods)
+        return BatchVerdicts(
+            schedulable=schedulable,
+            scores=scores,
+            backend="degraded-spread",
             staleness_seconds=0.0,
         )
 

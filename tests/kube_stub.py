@@ -90,6 +90,14 @@ class KubeStubState:
         # kind injects an ERROR 410 mid-stream at that exact offset
         # (one-shot; set via inject_watch_410_after)
         self.watch_410_after: dict[str, int] = {}
+        # -- chaos injection (ISSUE 8, mirroring write_faults) --
+        # read_faults: canned failure responses served FIFO to upcoming
+        # non-watch GETs (LIST/lease reads); same entry format as
+        # write_faults incl. status 0 (reset) and -1 (wedge)
+        self.read_faults: deque = deque()
+        # response_delay_s: sleep before answering every non-control
+        # request — a slow apiserver (chaos kind "kube_slow")
+        self.response_delay_s = 0.0
 
     def inject_watch_410_after(self, kind: str, n_events: int) -> None:
         """The next watch stream on ``kind`` delivers exactly
@@ -165,6 +173,17 @@ class KubeStubState:
             for f in faults:
                 status, payload, *rest = f
                 self.write_faults.append(
+                    (int(status), payload or {}, (rest[0] if rest else {}))
+                )
+
+    def inject_read_faults(self, *faults):
+        """Same contract as ``inject_write_faults`` for the read side:
+        each fault answers the next non-watch GET instead of normal
+        handling (``_skip: k`` in the payload lets k reads pass)."""
+        with self.lock:
+            for f in faults:
+                status, payload, *rest = f
+                self.read_faults.append(
                     (int(status), payload or {}, (rest[0] if rest else {}))
                 )
 
@@ -386,14 +405,14 @@ def _make_handler(state: KubeStubState):
                 + extra + b"\r\n" + body
             )
 
-        def _pop_write_fault(self):
+        def _pop_fault(self, faults):
             """Serve one injected fault (body already read) or None. A
-            fault whose payload carries ``_skip: k`` lets k writes pass
+            fault whose payload carries ``_skip: k`` lets k requests pass
             through normally first — that is how a test lands a fault on
             the k+1-th request of a pipelined batch."""
             with state.lock:
-                if state.write_faults:
-                    status, payload, headers = state.write_faults[0]
+                if faults:
+                    status, payload, headers = faults[0]
                     skip = (
                         payload.get("_skip", 0)
                         if isinstance(payload, dict) else 0
@@ -401,9 +420,20 @@ def _make_handler(state: KubeStubState):
                     if skip > 0:
                         payload["_skip"] = skip - 1
                         return None
-                    state.write_faults.popleft()
+                    faults.popleft()
                     return (status, payload, headers)
             return None
+
+        def _pop_write_fault(self):
+            return self._pop_fault(state.write_faults)
+
+        def _chaos_delay(self):
+            # kube_slow: uniform added latency on every data-plane
+            # request (control endpoints stay fast so the chaos driver
+            # itself is never slowed)
+            delay = state.response_delay_s
+            if delay > 0:
+                time.sleep(delay)
 
         def _serve_fault(self, fault) -> None:
             """Answer (or transport-fail) one injected fault entry."""
@@ -639,6 +669,12 @@ def _make_handler(state: KubeStubState):
             state.requests.append(("GET", self.path))
             path, _, query = self.path.partition("?")
             watching = "watch=1" in query
+            if not path.startswith("/__stub"):
+                self._chaos_delay()
+                if not watching:
+                    fault = self._pop_fault(state.read_faults)
+                    if fault is not None:
+                        return self._serve_fault(fault)
             if path == "/__stub/stats":
                 # control endpoint (subprocess mode): counters the
                 # benchmark reads instead of touching state directly
@@ -734,6 +770,7 @@ def _make_handler(state: KubeStubState):
             # client writers aren't serialized on response I/O
             state.requests.append(("PATCH", self.path))
             body = self._read_body()
+            self._chaos_delay()
             fault = self._pop_write_fault()
             if fault is not None:
                 return self._serve_fault(fault)
@@ -785,6 +822,7 @@ def _make_handler(state: KubeStubState):
             parts = self.path.strip("/").split("/")
             code, payload = 404, {"message": "bad post path"}
             if parts[0] != "__stub":
+                self._chaos_delay()
                 fault = self._pop_write_fault()
                 if fault is not None:
                     return self._serve_fault(fault)
@@ -1238,6 +1276,126 @@ class NullAPIServer:
     def stop(self):
         self._stop.set()
         self._sock.close()
+
+
+class ChaosPromServer:
+    """Controllable Prometheus stub for the chaos harness (ISSUE 8):
+    answers ``/api/v1/query`` from an in-memory ``{instance: fraction}``
+    map and exposes the fault surface the ``ChaosPlan`` drives:
+
+    - ``outage = True`` — close every query connection unanswered (a
+      dead endpoint; the client sees a transport error, not "no data");
+    - ``inject_faults((status, retry_after_s), ...)`` — canned 429/5xx
+      answers, served FIFO, optionally with a Retry-After header;
+    - ``delay_s`` — added latency per query (a slow Prometheus).
+
+    Values are served as the POST-``/100`` fraction (the stub answers
+    the query result, it does not evaluate PromQL); an
+    ``instance=~"..."`` matcher in the query filters the instance map
+    by fullmatch, an unfiltered query returns every instance."""
+
+    def __init__(self):
+        state = self
+
+        self.lock = threading.RLock()
+        self.values: dict[str, float] = {}  # instance -> fraction
+        self.outage = False
+        self.faults: deque = deque()  # (status, retry_after_s | None)
+        self.delay_s = 0.0
+        self.hits = 0  # queries that reached the stub (incl. faulted)
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                import re as _re
+                from urllib.parse import parse_qs, urlparse
+
+                with state.lock:
+                    state.hits += 1
+                    outage = state.outage
+                    fault = state.faults.popleft() if state.faults else None
+                    delay = state.delay_s
+                    values = dict(state.values)
+                if outage:
+                    # die without answering: the client's read fails at
+                    # the transport layer (RemoteDisconnected)
+                    self.close_connection = True
+                    return
+                if delay > 0:
+                    time.sleep(delay)
+                if fault is not None:
+                    status, retry_after = fault
+                    body = json.dumps({"status": "error",
+                                       "error": f"injected {status}"}).encode()
+                    self.send_response(int(status))
+                    if retry_after is not None:
+                        self.send_header("Retry-After", str(retry_after))
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                promql = parse_qs(urlparse(self.path).query).get(
+                    "query", [""]
+                )[0]
+                m = _re.search(r'instance=~"((?:[^"\\]|\\.)*)"', promql)
+                if m:
+                    pat = _re.compile(m.group(1))
+                    values = {
+                        k: v for k, v in values.items() if pat.fullmatch(k)
+                    }
+                body = json.dumps({
+                    "status": "success",
+                    "data": {
+                        "resultType": "vector",
+                        "result": [
+                            {"metric": {"instance": inst},
+                             "value": [0, f"{val:.5f}"]}
+                            for inst, val in sorted(values.items())
+                        ],
+                    },
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = _Server(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address
+        return f"http://{host}:{port}"
+
+    def set_all(self, instances, value: float) -> None:
+        with self.lock:
+            for inst in instances:
+                self.values[inst] = value
+
+    def inject_faults(self, *faults) -> None:
+        """Each fault: ``status`` or ``(status, retry_after_s)``."""
+        with self.lock:
+            for f in faults:
+                if isinstance(f, tuple):
+                    self.faults.append((int(f[0]), f[1]))
+                else:
+                    self.faults.append((int(f), None))
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,697 @@
+"""Deterministic chaos harness (ISSUE 8): seeded fault plans against
+the wire stubs.
+
+A ``ChaosDriver`` runs the placement loop's resilience surface — breaker-
+wrapped Prometheus sweeps writing ``value,timestamp`` annotations through
+the kube write path, the degraded-mode controller watching their
+staleness, the descheduler (hard-suspended while degraded) and a drip
+scheduler (fit+spread while degraded) — on a virtual clock, one step per
+simulated minute, while a ``ChaosPlan`` injects faults into the stub
+apiserver and stub Prometheus.
+
+Invariants checked under every plan:
+- no duplicate bind or eviction POSTs (the stub's non-idempotent-POST
+  oracles);
+- zero evictions while degraded mode is active;
+- the mirror converges to the stub's state after the faults heal;
+- the prometheus breaker opens under sustained failure, half-open-probes
+  on the virtual-clock reset timeout, and closes after heal;
+- every scheduling attempt returns a verdict (the scheduler stays live).
+
+The second half covers the leadership/teardown satellites: a lease
+stolen between queue pop and patch flush aborts the flush for BOTH
+elector flavors, and SIGTERM during an open overlapped-bind window
+drains the ``_BindFlushQueue`` before kube client teardown.
+"""
+
+import importlib.util
+import os
+import signal
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from crane_scheduler_tpu.annotator import AnnotatorConfig, NodeAnnotator
+from crane_scheduler_tpu.cluster import (
+    ClusterState,
+    Container,
+    Node,
+    NodeAddress,
+    Pod,
+    ResourceRequirements,
+)
+from crane_scheduler_tpu.cluster.kube import KubeClusterClient
+from crane_scheduler_tpu.descheduler import (
+    DeschedulerConfig,
+    LoadAwareDescheduler,
+    WatermarkPolicy,
+)
+from crane_scheduler_tpu.fit import FitTracker, ResourceFitPlugin
+from crane_scheduler_tpu.framework.scheduler import Scheduler
+from crane_scheduler_tpu.metrics import FakeMetricsSource, PrometheusClient
+from crane_scheduler_tpu.metrics.source import MetricsTransportError
+from crane_scheduler_tpu.plugins import DynamicPlugin
+from crane_scheduler_tpu.policy import (
+    DEFAULT_POLICY,
+    DynamicSchedulerPolicy,
+    PolicySpec,
+    PredicatePolicy,
+    PriorityPolicy,
+    SyncPolicy,
+)
+from crane_scheduler_tpu.resilience import (
+    BreakerState,
+    ChaosPlan,
+    CircuitBreaker,
+    DegradedModeController,
+    HealthRegistry,
+    RetryPolicy,
+)
+from crane_scheduler_tpu.utils import format_local_time
+
+_STUB = os.path.join(os.path.dirname(__file__), "kube_stub.py")
+spec = importlib.util.spec_from_file_location("kube_stub", _STUB)
+kube_stub = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(kube_stub)
+
+T0 = 1753776000.0
+STEP_S = 60.0
+METRIC = "cpu_usage_avg_5m"
+
+# one tracked metric, 180s sync period -> 480s active window with the
+# oracle's fixed 5m grace: annotations go stale after 8 unsynced steps
+POLICY = DynamicSchedulerPolicy(
+    spec=PolicySpec(
+        sync_period=(SyncPolicy(METRIC, 180.0),),
+        predicate=(PredicatePolicy(METRIC, 0.65),),
+        priority=(PriorityPolicy(METRIC, 1.0),),
+    )
+)
+
+
+def _wait_until(predicate, timeout=5.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class ChaosDriver:
+    """Steps a ChaosPlan against live stubs on a virtual clock."""
+
+    def __init__(self, plan, n_hot=1, n_cool=3, schedule_every=2):
+        self.plan = plan
+        self.now = T0
+        self.step = 0
+        self.schedule_every = schedule_every
+        self.server = kube_stub.KubeStubServer().start()
+        self.prom = kube_stub.ChaosPromServer().start()
+
+        hot = [f"hot-{i}" for i in range(n_hot)]
+        cool = [f"cool-{i}" for i in range(n_cool)]
+        self.names, self.ips = [], {}
+        for i, name in enumerate(hot + cool):
+            ip = f"10.0.0.{i + 1}"
+            self.server.state.add_node(
+                name, ip, allocatable={"cpu": "16", "pods": "110"}
+            )
+            self.names.append(name)
+            self.ips[name] = ip
+        pod_spec = lambda node: {  # noqa: E731 - local literal builder
+            "nodeName": node,
+            "containers": [{"resources": {"requests": {"cpu": "1"}}}],
+        }
+        for node in hot:
+            for j in range(12):
+                self.server.state.add_pod(
+                    "default", f"{node}-w{j}", spec=pod_spec(node)
+                )
+        for node in cool:
+            self.server.state.add_pod(
+                "default", f"{node}-w0", spec=pod_spec(node)
+            )
+        self.prom.set_all([self.ips[n] for n in hot], 0.90)
+        self.prom.set_all([self.ips[n] for n in cool], 0.10)
+
+        self.client = KubeClusterClient(self.server.url)
+        self.client.start()
+        want_pods = n_hot * 12 + n_cool
+        assert _wait_until(
+            lambda: len(self.client.list_pods()) == want_pods
+            and len(self.client.list_nodes()) == len(self.names),
+            timeout=10.0,
+        ), "mirror never bootstrapped"
+
+        # breaker tuned to the virtual step: failures within a 10-step
+        # window trip it; half-open probes come 2 steps after opening
+        self.breaker = CircuitBreaker(
+            "prometheus",
+            failure_threshold=3,
+            window_s=10 * STEP_S,
+            reset_timeout_s=1.5 * STEP_S,
+            clock=lambda: self.now,
+        )
+        self.health = HealthRegistry()
+        self.health.watch_breaker(self.breaker)
+        self.promc = PrometheusClient(
+            self.prom.url,
+            timeout=2.0,
+            retry_policy=RetryPolicy(
+                max_attempts=2,
+                base_delay_s=0.0,
+                max_delay_s=0.0,
+                deadline_s=30.0,
+                retryable=(MetricsTransportError,),
+                seed=plan.seed,
+                sleep=lambda s: None,
+            ),
+            breaker=self.breaker,
+        )
+        self.degraded = DegradedModeController(
+            POLICY.spec, min_eval_interval_s=0.0
+        )
+        self.desched = LoadAwareDescheduler(
+            self.client,
+            POLICY,
+            DeschedulerConfig(
+                watermarks=(
+                    WatermarkPolicy(METRIC, target=0.32, threshold=0.35),
+                ),
+                consecutive_syncs=2,
+                max_evictions_per_node=2,
+                max_evictions_per_cycle=4,
+                node_cooldown_seconds=0.0,
+            ),
+            clock=lambda: self.now,
+            degraded=self.degraded,
+        )
+        self.sched = Scheduler(self.client, clock=lambda: self.now)
+        self.sched.register(ResourceFitPlugin(FitTracker(self.client)), weight=1)
+        self.sched.register(
+            DynamicPlugin(POLICY, clock=lambda: self.now,
+                          degraded=self.degraded),
+            weight=3,
+        )
+
+        # invariant recorders
+        self.breaker_states_seen = set()
+        self.sweep_ok = []
+        self.sweep_failures = 0
+        self.failfast_sweeps = 0  # failed without touching the network
+        self.degraded_steps = []
+        self.suspended_reports = 0
+        self.evictions_while_degraded = 0
+        self.evicted_total = 0
+        self.schedule_results = []
+        self.write_errors = 0
+        self._torn_until = None
+        self._seq = 0
+
+    # -- chaos appliers ----------------------------------------------------
+
+    def appliers(self):
+        st = self.server.state
+
+        def prom_outage(e):
+            self.prom.outage = True
+
+        def prom_heal(e):
+            self.prom.outage = False
+            self.prom.delay_s = 0.0
+
+        def prom_storm(e):
+            status = e.param("status", 503)
+            fault = (status, 0.01) if status == 429 else status
+            self.prom.inject_faults(*[fault] * e.param("count", 3))
+
+        def prom_slow(e):
+            self.prom.delay_s = e.param("delay_s", 0.1)
+
+        def kube_read_storm(e):
+            st.inject_read_faults(
+                *[(e.param("status", 503), {})] * e.param("count", 3)
+            )
+
+        def kube_write_storm(e):
+            status = e.param("status", 503)
+            headers = {"Retry-After": "0.01"} if status == 429 else {}
+            st.inject_write_faults(
+                *[(status, {}, headers)] * e.param("count", 3)
+            )
+
+        def kube_slow(e):
+            st.response_delay_s = e.param("delay_s", 0.05)
+
+        def torn_watch(e):
+            st.torn_watch_writes = True
+            self._torn_until = self.step + e.param("count", 1)
+
+        def close_watches(e):
+            st.close_watches()
+
+        def watch_410(e):
+            st.inject_watch_410_after("nodes", e.param("after", 1))
+            st.close_watches()
+
+        def skew_annotations(e):
+            # rewrite every node stamp to a skewed clock server-side, so
+            # the mirror sees annotations that LOOK expired (a node whose
+            # wall clock drifted hours behind)
+            stamp = format_local_time(self.now + e.param("offset_s", -3600.0))
+            with st.lock:
+                for node in st.nodes.values():
+                    anno = node["metadata"].setdefault("annotations", {})
+                    changed = False
+                    for k, v in list(anno.items()):
+                        parts = str(v).split(",")
+                        if len(parts) == 2:
+                            anno[k] = f"{parts[0]},{stamp}"
+                            changed = True
+                    if changed:
+                        st._stamp(node)
+                        st._notify("nodes", "MODIFIED", node)
+
+        def skew_heal(e):
+            pass  # healed by the next honest sweep; anchor for recovery
+
+        return {
+            "prom_outage": prom_outage,
+            "prom_heal": prom_heal,
+            "prom_storm": prom_storm,
+            "prom_slow": prom_slow,
+            "kube_read_storm": kube_read_storm,
+            "kube_write_storm": kube_write_storm,
+            "kube_slow": kube_slow,
+            "torn_watch": torn_watch,
+            "close_watches": close_watches,
+            "watch_410": watch_410,
+            "skew_annotations": skew_annotations,
+            "skew_heal": skew_heal,
+        }
+
+    # -- one simulated minute ----------------------------------------------
+
+    def run(self):
+        appliers = self.appliers()
+        for step in range(self.plan.steps):
+            self.step = step
+            self.now = T0 + step * STEP_S
+            if self._torn_until is not None and step >= self._torn_until:
+                self.server.state.torn_watch_writes = False
+                self._torn_until = None
+            self.plan.apply(step, appliers)
+            self._sweep()
+            self._observe()
+            self._desched_cycle()
+            if step % self.schedule_every == 0:
+                self._schedule_one()
+
+    def _sweep(self):
+        """One annotator-shaped sync: bulk prom query -> bulk PATCH."""
+        hits_before = self.prom.hits
+        try:
+            by_inst = self.promc.query_all_by_metric(METRIC)
+        except MetricsTransportError:
+            self.sweep_failures += 1
+            if self.prom.hits == hits_before:
+                self.failfast_sweeps += 1  # breaker rejected, no network
+            self.sweep_ok.append(False)
+            return
+        stamp = format_local_time(self.now)
+        per_node = {
+            name: {METRIC: f"{by_inst[self.ips[name]]},{stamp}"}
+            for name in self.names
+            if self.ips[name] in by_inst
+        }
+        try:
+            if per_node:
+                self.client.patch_node_annotations_bulk(per_node)
+        except Exception:
+            self.write_errors += 1
+            self.sweep_ok.append(False)
+            return
+        # bound the watch lag so the degraded evaluation this step sees
+        # this sweep (the annotator's own cadence gives the same slack)
+        want = f",{stamp}"
+        _wait_until(
+            lambda: any(
+                (n.annotations or {}).get(METRIC, "").endswith(want)
+                for n in self.client.list_nodes()
+            ),
+            timeout=2.0,
+            interval=0.01,
+        )
+        self.sweep_ok.append(True)
+
+    def _observe(self):
+        self.degraded.update(
+            (dict(n.annotations or {}) for n in self.client.list_nodes()),
+            self.now,
+        )
+        self.breaker_states_seen.add(self.breaker.state)
+        if self.degraded.active:
+            self.degraded_steps.append(self.step)
+
+    def _desched_cycle(self):
+        report = self.desched.sync_once(self.now)
+        if report.suspended:
+            self.suspended_reports += 1
+        evicted = len(report.evicted)
+        self.evicted_total += evicted
+        if self.degraded.active and evicted:
+            self.evictions_while_degraded += evicted
+
+    def _schedule_one(self):
+        pod = Pod(
+            name=f"chaos-{self._seq}",
+            namespace="default",
+            containers=(
+                Container("c", ResourceRequirements(requests={"cpu": "1"})),
+            ),
+        )
+        self._seq += 1
+        try:
+            self.client.add_pod(pod)
+        except Exception:
+            self.write_errors += 1
+            return
+        # the liveness invariant: schedule_one must return a verdict —
+        # never hang or raise — whatever the fault state
+        result = self.sched.schedule_one(pod)
+        self.schedule_results.append(result)
+
+    # -- teardown / convergence --------------------------------------------
+
+    def heal_and_settle(self, settle_steps=4):
+        st = self.server.state
+        self.prom.outage = False
+        self.prom.delay_s = 0.0
+        with self.prom.lock:
+            self.prom.faults.clear()
+        st.torn_watch_writes = False
+        st.response_delay_s = 0.0
+        with st.lock:
+            st.read_faults.clear()
+            st.write_faults.clear()
+        for _ in range(settle_steps):
+            self.step += 1
+            self.now += STEP_S
+            self._sweep()
+            self._observe()
+            self._desched_cycle()
+
+    def mirror_converged(self, timeout=10.0):
+        st = self.server.state
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with st.lock:
+                want = {
+                    name: dict(obj["metadata"].get("annotations") or {})
+                    for name, obj in st.nodes.items()
+                }
+            have = {
+                n.name: dict(n.annotations or {})
+                for n in self.client.list_nodes()
+            }
+            if have == want:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def assert_invariants(self):
+        st = self.server.state
+        assert st.duplicate_binds() == 0, "duplicate bind POST"
+        assert st.duplicate_evictions() == 0, "duplicate eviction POST"
+        assert self.evictions_while_degraded == 0, \
+            "evicted while degraded-mode was active"
+        assert self.mirror_converged(), "mirror never converged after heal"
+        assert all(r is not None for r in self.schedule_results), \
+            "schedule_one returned no verdict"
+
+    def close(self):
+        try:
+            self.client.stop()
+        finally:
+            self.server.stop()
+            self.prom.stop()
+
+
+# -- plan mechanics ---------------------------------------------------------
+
+
+def test_generated_plans_are_deterministic_and_converge():
+    a = ChaosPlan.generate(seed=7, steps=40, n_faults=5)
+    b = ChaosPlan.generate(seed=7, steps=40, n_faults=5)
+    assert a.events == b.events
+    assert a.describe() == b.describe()
+    # convergence by construction: nothing fires in the quiet tail
+    assert a.last_fault_step() <= 40 - 10 + 1
+    c = ChaosPlan.generate(seed=8, steps=40, n_faults=5)
+    assert c.events != a.events
+
+
+def test_unregistered_chaos_kind_fails_loudly():
+    plan = ChaosPlan(seed=0, steps=2).add(1, "quantum_flap")
+    with pytest.raises(KeyError):
+        plan.apply(1, {})
+
+
+# -- scripted outage: the headline recovery story ---------------------------
+
+
+def test_prom_outage_opens_breaker_degrades_and_recovers():
+    plan = ChaosPlan(seed=1, steps=18)
+    plan.add(2, "prom_outage")
+    plan.add(14, "prom_heal")
+    driver = ChaosDriver(plan)
+    try:
+        driver.run()
+        # breaker tripped during the outage and fail-fasted at least one
+        # sweep without touching the network, then half-open-probed
+        assert BreakerState.OPEN in driver.breaker_states_seen
+        assert driver.failfast_sweeps > 0
+        assert driver.sweep_failures > 0
+        # staleness crossed the enter threshold mid-outage...
+        assert driver.degraded_steps, "degraded mode never engaged"
+        # ...which hard-suspended the descheduler those cycles
+        assert driver.suspended_reports >= len(set(driver.degraded_steps))
+        # recovery without restart: post-heal sweeps are healthy, the
+        # breaker closed, degraded mode exited, health is green again
+        driver.heal_and_settle()
+        assert driver.sweep_ok[-1] is True
+        assert driver.breaker.state == BreakerState.CLOSED
+        assert not driver.degraded.active
+        assert driver.health.overall() == "healthy"
+        driver.assert_invariants()
+    finally:
+        driver.close()
+
+
+def test_evictions_suspended_while_degraded_then_resume():
+    # no annotations at all at t0: every node is stale, degraded engages
+    # on the very first evaluation — the descheduler must sit on its
+    # hands despite a genuine hotspot, then act once the fabric heals
+    plan = ChaosPlan(seed=2, steps=14)
+    plan.add(0, "prom_outage")
+    plan.add(8, "prom_heal")
+    driver = ChaosDriver(plan)
+    try:
+        driver.run()
+        driver.heal_and_settle(settle_steps=3)
+        assert driver.evictions_while_degraded == 0
+        assert driver.suspended_reports > 0
+        # after heal the hotspot (0.90 > 0.35 threshold) is actionable
+        assert driver.evicted_total >= 1, \
+            "descheduler never resumed after degraded exit"
+        assert driver.server.state.evictions, "no eviction reached the stub"
+        driver.assert_invariants()
+    finally:
+        driver.close()
+
+
+# -- seeded plans: invariants hold for any generated timeline ---------------
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_seeded_plans_hold_invariants(seed):
+    plan = ChaosPlan.generate(seed, steps=24, n_faults=3, quiet_tail=8)
+    driver = ChaosDriver(plan)
+    try:
+        driver.run()
+        driver.heal_and_settle()
+        driver.assert_invariants()
+        # liveness: a placement attempt ran on cadence throughout
+        assert len(driver.schedule_results) + driver.write_errors >= \
+            plan.steps // driver.schedule_every
+    finally:
+        driver.close()
+
+
+# -- leadership satellites --------------------------------------------------
+
+
+def test_file_lock_leader_loss_mid_sync_aborts_flush(tmp_path, monkeypatch):
+    from crane_scheduler_tpu.service.leader import LeaderElector
+
+    cluster = ClusterState()
+    cluster.add_node(
+        Node(name="n1", addresses=(NodeAddress("InternalIP", "10.0.0.1"),))
+    )
+    started = threading.Event()
+    elector = LeaderElector(
+        str(tmp_path / "crane.lock"),
+        identity="annotator-a",
+        on_started_leading=lambda stop: started.set(),
+        lease_duration=0.5,
+        renew_deadline=0.2,
+        retry_period=0.05,
+    )
+    thread = threading.Thread(target=elector.run, daemon=True)
+    thread.start()
+    assert started.wait(3.0) and elector.is_leader
+
+    annotator = NodeAnnotator(
+        cluster, FakeMetricsSource(), DEFAULT_POLICY, AnnotatorConfig(),
+        leader_check=lambda: elector.is_leader,
+    )
+    # a sweep's column is queued (popped from the metric queue)...
+    annotator._emit_annotation_column(
+        METRIC, ["n1"], ["0.50000,2026-07-29T00:00:00Z"]
+    )
+    # ...then the lease dies before the flush: heartbeat writes fail
+    monkeypatch.setattr(
+        elector, "_write_lease",
+        lambda: (_ for _ in ()).throw(OSError("lock file gone")),
+    )
+    assert _wait_until(lambda: not elector.is_leader, timeout=5.0)
+
+    assert annotator.flush_annotations() == 0
+    assert METRIC not in (cluster.get_node("n1").annotations or {})
+    # drained and DROPPED, not re-queued: the new leader's sweeps are
+    # the source of truth now
+    assert annotator._anno_cols == []
+    elector.stop()
+    thread.join(timeout=2.0)
+
+
+def test_kube_leader_loss_mid_sync_aborts_flush():
+    from crane_scheduler_tpu.service.kube_leader import KubeLeaderElector
+
+    server = kube_stub.KubeStubServer().start()
+    client = None
+    elector = None
+    try:
+        server.state.add_node("n1", "10.0.0.1")
+        client = KubeClusterClient(server.url)
+        client.start()
+        assert _wait_until(lambda: len(client.list_nodes()) == 1)
+
+        started = threading.Event()
+        elector = KubeLeaderElector(
+            client,
+            lease_name="crane-chaos-test",
+            identity="annotator-a",
+            namespace="crane-system",
+            on_started_leading=lambda stop: started.set(),
+            lease_duration=5.0,
+            renew_deadline=0.3,
+            retry_period=0.05,
+        )
+        thread = threading.Thread(target=elector.run, daemon=True)
+        thread.start()
+        assert started.wait(3.0) and elector.is_leader
+
+        annotator = NodeAnnotator(
+            client, FakeMetricsSource(), DEFAULT_POLICY, AnnotatorConfig(),
+            leader_check=lambda: elector.is_leader,
+        )
+        annotator._emit_annotation_column(
+            METRIC, ["n1"], ["0.50000,2026-07-29T00:00:00Z"]
+        )
+        # steal the lease server-side: new holder + bumped
+        # resourceVersion, so the old leader's CAS renew answers 409
+        with server.state.lock:
+            lease = server.state.leases["crane-system/crane-chaos-test"]
+            lease["spec"]["holderIdentity"] = "annotator-b"
+            server.state._lease_rv += 1
+            lease["metadata"]["resourceVersion"] = str(server.state._lease_rv)
+        assert _wait_until(lambda: not elector.is_leader, timeout=5.0)
+
+        assert annotator.flush_annotations() == 0
+        assert annotator._anno_cols == []
+        # no node PATCH ever reached the apiserver from the deposed leader
+        assert not any(
+            m == "PATCH" and "/api/v1/nodes/" in p
+            for m, p in server.state.requests
+        )
+        thread.join(timeout=2.0)
+    finally:
+        if elector is not None:
+            elector.stop()
+        if client is not None:
+            client.stop()
+        server.stop()
+
+
+# -- SIGTERM bind-drain satellite -------------------------------------------
+
+
+def test_sigterm_drains_bind_window_before_client_teardown():
+    from crane_scheduler_tpu.framework.scheduler import (
+        BatchResult,
+        _BindFlushQueue,
+    )
+
+    server = kube_stub.KubeStubServer().start()
+    old_handler = signal.getsignal(signal.SIGTERM)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    client = None
+    try:
+        for i in range(3):
+            server.state.add_node(f"n{i}", f"10.0.0.{i + 1}")
+        for i in range(24):
+            server.state.add_pod("default", f"p{i}")
+        client = KubeClusterClient(server.url)
+        client.start()
+        assert _wait_until(lambda: len(client.list_pods()) == 24)
+
+        queue = _BindFlushQueue(
+            SimpleNamespace(_telemetry=None, cluster=client), window_s=0.3
+        )
+        assignments = {f"default/p{i}": f"n{i % 3}" for i in range(24)}
+        queue.submit_batch(
+            BatchResult(
+                assignments=dict(assignments), unassigned=[],
+                scores={}, schedulable={}, now=T0,
+            ),
+            T0,
+        )
+        # SIGTERM lands while the 300ms bind window is still open
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert stop.wait(2.0)
+
+        # the CLI teardown contract under test: drain the bind queue
+        # FIRST (close() flushes the open window), THEN tear down the
+        # kube client — no submitted bind may be dropped or doubled
+        queue.close()
+        client.stop()
+        client = None
+
+        assert sum(server.state.bind_posts.values()) == 24
+        assert server.state.duplicate_binds() == 0
+        with server.state.lock:
+            bound = [
+                p for p in server.state.pods.values()
+                if p["spec"].get("nodeName")
+            ]
+        assert len(bound) == 24
+    finally:
+        signal.signal(signal.SIGTERM, old_handler)
+        if client is not None:
+            client.stop()
+        server.stop()

@@ -9,7 +9,8 @@ from urllib.parse import parse_qs, urlparse
 import pytest
 
 from crane_scheduler_tpu.metrics import PrometheusClient
-from crane_scheduler_tpu.metrics.source import MetricsQueryError
+from crane_scheduler_tpu.metrics.source import MetricsQueryError, MetricsTransportError
+from crane_scheduler_tpu.resilience import BreakerState, CircuitBreaker, RetryPolicy
 
 
 class StubProm(BaseHTTPRequestHandler):
@@ -56,18 +57,19 @@ def vector(*values):
 
 
 def test_query_by_ip_direct_hit(stub):
+    # the interpolated IP is regex-escaped (ISSUE 8 satellite)
     client = PrometheusClient(f"http://127.0.0.1:{stub.server_port}")
-    StubProm.responses['cpu_usage_avg_5m{instance=~"10.0.0.1"} /100'] = vector(0.42)
+    StubProm.responses['cpu_usage_avg_5m{instance=~"10\\.0\\.0\\.1"} /100'] = vector(0.42)
     assert client.query_by_node_ip("cpu_usage_avg_5m", "10.0.0.1") == "0.42000"
 
 
 def test_query_by_ip_falls_back_to_port_pattern(stub):
     client = PrometheusClient(f"http://127.0.0.1:{stub.server_port}")
-    StubProm.responses['cpu_usage_avg_5m{instance=~"10.0.0.1:.+"} /100'] = vector(0.5)
+    StubProm.responses['cpu_usage_avg_5m{instance=~"10\\.0\\.0\\.1:.+"} /100'] = vector(0.5)
     assert client.query_by_node_ip("cpu_usage_avg_5m", "10.0.0.1") == "0.50000"
     assert StubProm.queries == [
-        'cpu_usage_avg_5m{instance=~"10.0.0.1"} /100',
-        'cpu_usage_avg_5m{instance=~"10.0.0.1:.+"} /100',
+        'cpu_usage_avg_5m{instance=~"10\\.0\\.0\\.1"} /100',
+        'cpu_usage_avg_5m{instance=~"10\\.0\\.0\\.1:.+"} /100',
     ]
 
 
@@ -113,7 +115,7 @@ def test_query_by_name_no_port_fallback(stub):
     client = PrometheusClient(f"http://127.0.0.1:{stub.server_port}")
     with pytest.raises(MetricsQueryError):
         client.query_by_node_name("m", "node-1")
-    assert StubProm.queries == ['m{instance=~"node-1"} /100']
+    assert StubProm.queries == ['m{instance=~"node\\-1"} /100']
 
 
 def test_query_all_by_metric_bulk(stub):
@@ -135,3 +137,207 @@ def test_query_all_by_metric_bulk(stub):
         "10.0.0.2:9100": "0.00000",  # negative clamped
         "10.0.0.3": "0.75000",
     }
+
+
+# -- ISSUE 8: regex escaping, transport-error surfacing, retry + breaker ----
+
+
+class EvalProm(BaseHTTPRequestHandler):
+    """Evaluates the instance matcher the way Prometheus does (fully
+    anchored regex over the label value) instead of exact promql-string
+    lookup — so escaping bugs actually over-match here."""
+
+    instances = {}  # instance label -> raw value (pre-/100)
+
+    def do_GET(self):
+        import re as _re
+
+        url = urlparse(self.path)
+        q = parse_qs(url.query).get("query", [""])[0]
+        m = _re.match(r'^(\w+)\{instance=~"(.*)"\} /100$', q)
+        result = []
+        if m:
+            pat = m.group(2)
+            for inst, val in sorted(type(self).instances.items()):
+                if _re.fullmatch(pat, inst):
+                    result.append(
+                        {"metric": {"instance": inst}, "value": [0, str(val / 100.0)]}
+                    )
+        body = json.dumps(
+            {"status": "success", "data": {"resultType": "vector", "result": result}}
+        ).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def eval_stub():
+    EvalProm.instances = {}
+    server = HTTPServer(("127.0.0.1", 0), EvalProm)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield server
+    server.shutdown()
+
+
+def test_dotted_ip_does_not_match_lookalike_instance(eval_stub):
+    # "10.0.0.1" unescaped would regex-match the lookalike "10a0b0c1";
+    # with escaping only the real instance answers.
+    client = PrometheusClient(f"http://127.0.0.1:{eval_stub.server_port}")
+    EvalProm.instances = {"10a0b0c1": 99.0}
+    with pytest.raises(MetricsQueryError):
+        client.query_by_node_ip("cpu_usage_avg_5m", "10.0.0.1")
+    EvalProm.instances = {"10a0b0c1": 99.0, "10.0.0.1": 40.0}
+    assert client.query_by_node_ip("cpu_usage_avg_5m", "10.0.0.1") == "0.40000"
+
+
+def test_node_name_with_regex_metachars_is_escaped(eval_stub):
+    client = PrometheusClient(f"http://127.0.0.1:{eval_stub.server_port}")
+    EvalProm.instances = {"nodeX1": 80.0, "node+1": 30.0}
+    # unescaped "node+1" matches "nodeX1"? no — but "node.1" style
+    # over-match is the risk; assert the + is taken literally.
+    assert client.query_by_node_name("m", "node+1") == "0.30000"
+
+
+class FlakyProm(BaseHTTPRequestHandler):
+    """Fails the first ``fail_next`` requests with ``status`` (optionally
+    sending Retry-After), then serves a fixed vector."""
+
+    fail_next = 0
+    status = 500
+    retry_after = None
+    hits = 0
+
+    def do_GET(self):
+        cls = type(self)
+        cls.hits += 1
+        if cls.fail_next > 0:
+            cls.fail_next -= 1
+            self.send_response(cls.status)
+            if cls.retry_after is not None:
+                self.send_header("Retry-After", str(cls.retry_after))
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        body = json.dumps(
+            {
+                "status": "success",
+                "data": {
+                    "resultType": "vector",
+                    "result": [{"metric": {}, "value": [0, "0.5"]}],
+                },
+            }
+        ).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def flaky_stub():
+    FlakyProm.fail_next = 0
+    FlakyProm.status = 500
+    FlakyProm.retry_after = None
+    FlakyProm.hits = 0
+    server = HTTPServer(("127.0.0.1", 0), FlakyProm)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield server
+    server.shutdown()
+
+
+def _fast_retry(**kw):
+    sleeps = []
+    policy = RetryPolicy(
+        max_attempts=kw.pop("max_attempts", 3),
+        base_delay_s=0.001,
+        max_delay_s=0.002,
+        deadline_s=5.0,
+        retryable=(MetricsTransportError,),
+        seed=7,
+        sleep=sleeps.append,
+        **kw,
+    )
+    return policy, sleeps
+
+
+def test_transport_error_surfaces_not_no_data(flaky_stub):
+    # a 500 must raise MetricsTransportError, not fall through to the
+    # port-pattern fallback query and report "no data" (ISSUE 8 satellite)
+    client = PrometheusClient(
+        f"http://127.0.0.1:{flaky_stub.server_port}", retry_policy=None
+    )
+    FlakyProm.fail_next = 10
+    with pytest.raises(MetricsTransportError):
+        client.query_by_node_ip("m", "ip")
+    assert FlakyProm.hits == 1  # no fallback query attempted
+
+
+def test_connection_refused_is_transport_error():
+    client = PrometheusClient("http://127.0.0.1:1", retry_policy=None, timeout=0.5)
+    with pytest.raises(MetricsTransportError):
+        client.query_by_node_ip("m", "ip")
+
+
+def test_retry_recovers_from_transient_5xx(flaky_stub):
+    policy, sleeps = _fast_retry()
+    client = PrometheusClient(
+        f"http://127.0.0.1:{flaky_stub.server_port}", retry_policy=policy
+    )
+    FlakyProm.fail_next = 2
+    assert client.query_by_node_ip("m", "ip") == "0.50000"
+    assert len(sleeps) == 2
+
+
+def test_retry_honors_retry_after_floor(flaky_stub):
+    policy, sleeps = _fast_retry(max_attempts=2)
+    client = PrometheusClient(
+        f"http://127.0.0.1:{flaky_stub.server_port}", retry_policy=policy
+    )
+    FlakyProm.fail_next = 1
+    FlakyProm.status = 429
+    FlakyProm.retry_after = 3
+    assert client.query_by_node_ip("m", "ip") == "0.50000"
+    assert sleeps == [3.0]  # Retry-After floors the jittered backoff
+
+
+def test_breaker_opens_on_outage_and_fails_fast(flaky_stub):
+    clock = [0.0]
+    breaker = CircuitBreaker(
+        "prometheus",
+        failure_threshold=3,
+        window_s=60.0,
+        reset_timeout_s=30.0,
+        clock=lambda: clock[0],
+    )
+    client = PrometheusClient(
+        f"http://127.0.0.1:{flaky_stub.server_port}",
+        retry_policy=None,
+        breaker=breaker,
+    )
+    FlakyProm.fail_next = 1000
+    for _ in range(3):
+        with pytest.raises(MetricsTransportError):
+            client.query_by_node_ip("m", "ip")
+    assert breaker.state == BreakerState.OPEN
+    hits_before = FlakyProm.hits
+    with pytest.raises(MetricsTransportError):  # fails fast, no network
+        client.query_by_node_ip("m", "ip")
+    assert FlakyProm.hits == hits_before
+
+    # heal + reset-timeout: half-open probe succeeds and closes
+    FlakyProm.fail_next = 0
+    clock[0] = 31.0
+    assert client.query_by_node_ip("m", "ip") == "0.50000"
+    assert breaker.state == BreakerState.CLOSED

@@ -1,0 +1,241 @@
+"""Chaos smoke gate (``make chaos-smoke``): one short ``ChaosPlan``
+(a 12-virtual-minute Prometheus outage) driven through the
+breaker-wrapped client, the degraded-mode controller, and the health
+registry — then a strict-parse scrape of the resilience metric families
+and the ``/healthz`` snapshot.
+
+Checks, in order:
+- during the outage the ``prometheus`` breaker opens and at least one
+  query fails fast without touching the stub (hits counter frozen);
+- annotation staleness crosses the enter threshold and degraded mode
+  engages; ``/healthz`` reports degraded but still answers 200;
+- after heal the breaker half-open-probes closed, degraded mode exits
+  with hysteresis, and ``/healthz`` is healthy again;
+- ``crane_breaker_*``, ``crane_health_state`` and ``crane_degraded_*``
+  families render through the strict exposition parser.
+
+Exit 0 = every check passed; any violation prints the failure and exits
+nonzero. Runs in a few wall-clock seconds (the outage clock is virtual).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+T0 = 1753776000.0
+STEP_S = 60.0
+METRIC = "cpu_usage_avg_5m"
+
+
+def main() -> int:
+    from crane_scheduler_tpu.metrics import PrometheusClient
+    from crane_scheduler_tpu.metrics.source import MetricsTransportError
+    from crane_scheduler_tpu.policy import (
+        DynamicSchedulerPolicy,
+        PolicySpec,
+        PredicatePolicy,
+        PriorityPolicy,
+        SyncPolicy,
+    )
+    from crane_scheduler_tpu.resilience import (
+        BreakerState,
+        ChaosPlan,
+        CircuitBreaker,
+        DegradedModeController,
+        HealthRegistry,
+        RetryPolicy,
+    )
+    from crane_scheduler_tpu.service.http import HealthServer
+    from crane_scheduler_tpu.telemetry import Telemetry
+    from crane_scheduler_tpu.telemetry.expfmt import (
+        ExpositionError,
+        parse_exposition,
+    )
+    from crane_scheduler_tpu.utils import format_local_time
+
+    stub_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests", "kube_stub.py",
+    )
+    stub_spec = importlib.util.spec_from_file_location(
+        "kube_stub_smoke", stub_path
+    )
+    kube_stub = importlib.util.module_from_spec(stub_spec)
+    stub_spec.loader.exec_module(kube_stub)
+
+    failures = 0
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        nonlocal failures
+        mark = "ok" if ok else "FAIL"
+        print(f"[chaos-smoke] {name}: {mark}{' — ' + detail if detail else ''}")
+        if not ok:
+            failures += 1
+
+    policy = DynamicSchedulerPolicy(
+        spec=PolicySpec(
+            sync_period=(SyncPolicy(METRIC, 180.0),),
+            predicate=(PredicatePolicy(METRIC, 0.65),),
+            priority=(PriorityPolicy(METRIC, 1.0),),
+        )
+    )
+    clock = {"now": T0}
+    tel = Telemetry()
+    health_reg = HealthRegistry(telemetry=tel)
+    breaker = CircuitBreaker(
+        "prometheus",
+        failure_threshold=3,
+        window_s=10 * STEP_S,
+        reset_timeout_s=1.5 * STEP_S,
+        clock=lambda: clock["now"],
+        telemetry=tel,
+    )
+    health_reg.watch_breaker(breaker)
+    degraded = DegradedModeController(
+        policy.spec, min_eval_interval_s=0.0, telemetry=tel,
+        health=health_reg, health_component="annotations",
+    )
+    prom = kube_stub.ChaosPromServer().start()
+    instances = [f"10.0.0.{i}" for i in range(1, 5)]
+    prom.set_all(instances, 0.40)
+    promc = PrometheusClient(
+        prom.url,
+        timeout=2.0,
+        retry_policy=RetryPolicy(
+            max_attempts=2, base_delay_s=0.0, max_delay_s=0.0,
+            deadline_s=30.0, retryable=(MetricsTransportError,),
+            seed=0, sleep=lambda s: None,
+        ),
+        breaker=breaker,
+    )
+    health = HealthServer(port=0, telemetry=tel, health=health_reg)
+    health.start()
+    base = f"http://127.0.0.1:{health.port}"
+
+    annotations = {inst: {} for inst in instances}
+    opened = False
+    failfast = False
+
+    def sweep_and_observe(step: int) -> bool:
+        nonlocal opened, failfast
+        clock["now"] = T0 + step * STEP_S
+        hits_before = prom.hits
+        ok = True
+        try:
+            by_inst = promc.query_all_by_metric(METRIC)
+            stamp = format_local_time(clock["now"])
+            for inst, value in by_inst.items():
+                annotations[inst] = {METRIC: f"{value},{stamp}"}
+        except MetricsTransportError:
+            ok = False
+            if prom.hits == hits_before:
+                failfast = True
+        if breaker.state == BreakerState.OPEN:
+            opened = True
+        degraded.update(iter(annotations.values()), clock["now"])
+        return ok
+
+    def probe() -> tuple[int, dict]:
+        req = urllib.request.Request(f"{base}/healthz")
+        try:
+            with urllib.request.urlopen(req, timeout=5) as r:
+                return r.status, json.load(r)
+        except urllib.error.HTTPError as e:
+            return e.code, json.load(e)
+
+    # the fault timeline rides the ChaosPlan machinery the full test
+    # suite uses: steps 0-1 healthy, outage at 2, heal at 14, settle
+    plan = ChaosPlan(seed=12, steps=18)
+    plan.add(2, "prom_outage")
+    plan.add(14, "prom_heal")
+    appliers = {
+        "prom_outage": lambda e: setattr(prom, "outage", True),
+        "prom_heal": lambda e: setattr(prom, "outage", False),
+    }
+
+    try:
+        for step in range(plan.steps):
+            plan.apply(step, appliers)
+            sweep_and_observe(step)
+            if step == 10:
+                check("breaker opened during outage", opened)
+                check("fail-fast query skipped the network", failfast)
+                check("degraded mode engaged on staleness",
+                      degraded.active,
+                      f"stale_fraction={degraded.stale_fraction:.2f}")
+                code, snap = probe()
+                check("/healthz degraded still probes 200",
+                      code == 200 and snap["status"] == "degraded",
+                      f"{code} {snap.get('status')}")
+
+        check("post-heal sweep recovered", sweep_and_observe(18))
+        check("breaker closed after heal",
+              breaker.state == BreakerState.CLOSED, str(breaker.state))
+        check("degraded mode exited", not degraded.active,
+              f"stale_fraction={degraded.stale_fraction:.2f}")
+        code, snap = probe()
+        check("/healthz healthy after heal",
+              code == 200 and snap["status"] == "healthy",
+              f"{code} {snap.get('status')}")
+
+        # strict-parse the resilience families off the live scrape
+        with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        try:
+            families = parse_exposition(text)
+            check("strict exposition parse", True,
+                  f"{len(families)} families")
+        except ExpositionError as e:
+            families = {}
+            check("strict exposition parse", False, str(e))
+        for required in (
+            "crane_breaker_state",
+            "crane_breaker_transitions_total",
+            "crane_breaker_rejected_total",
+            "crane_health_state",
+            "crane_degraded_mode",
+            "crane_degraded_stale_fraction",
+            "crane_degraded_transitions_total",
+        ):
+            check(f"family {required}", required in families)
+        breaker_state = {
+            dict(s[1]).get("target"): s[2]
+            for s in families.get("crane_breaker_state", {}).get(
+                "samples", ()
+            )
+        }
+        check("breaker gauge closed (0)",
+              breaker_state.get("prometheus") == 0, str(breaker_state))
+        rejected = sum(
+            s[2]
+            for s in families.get("crane_breaker_rejected_total", {}).get(
+                "samples", ()
+            )
+        )
+        check("rejected_total counted fail-fasts", rejected >= 1,
+              f"rejected={rejected}")
+        degraded_flips = sum(
+            s[2]
+            for s in families.get(
+                "crane_degraded_transitions_total", {}
+            ).get("samples", ())
+        )
+        check("degraded transitions counted (enter+exit)",
+              degraded_flips >= 2, f"transitions={degraded_flips}")
+    finally:
+        health.stop()
+        prom.stop()
+
+    print(f"[chaos-smoke] {'PASS' if not failures else 'FAIL'}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
