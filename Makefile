@@ -9,8 +9,8 @@ IMAGE_ANNOTATOR := $(REGISTRY)/crane-annotator-tpu:$(GIT_VERSION)
 IMAGE_SCHEDULER := $(REGISTRY)/crane-scheduler-tpu:$(GIT_VERSION)
 
 .PHONY: all native test test-fast bench sim e2e metrics-smoke \
-	desched-smoke chaos-smoke clean images image-annotator \
-	image-scheduler push-images
+	desched-smoke chaos-smoke trace-smoke dashboards clean images \
+	image-annotator image-scheduler push-images
 
 all: native test
 
@@ -46,6 +46,17 @@ desched-smoke:
 # controller + health registry; strict-parses the resilience families
 chaos-smoke:
 	$(PYTHON) tools/chaos_smoke.py
+
+# one pod traced end to end over a live stub apiserver (traceparent on
+# the bind POST, lifecycle record in the flight ring), then replayed
+# through crane_trace.py explain/slo
+trace-smoke:
+	$(PYTHON) tools/trace_smoke.py
+
+# regenerate the Grafana placement-SLO dashboard from the registry's
+# family list (deterministic; CI diffs it against the committed JSON)
+dashboards:
+	$(PYTHON) tools/gen_dashboard.py --out deploy/dashboards/placement-slo.json
 
 # -- images (one parameterized Dockerfile per binary, like the
 # reference's ARG PKGNAME build; ref: Makefile images target) ----------

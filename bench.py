@@ -361,13 +361,17 @@ def main() -> int:
 
     # --- telemetry overhead probe (acceptance: <3% regress enabled) ----
     # the same pipelined loop with the unified telemetry layer live:
-    # per-cycle dispatch/d2h_wait spans, a cycle counter, and a latency
-    # histogram — what BatchScheduler's instrumented loops record per
-    # cycle. The delta vs the bare pass above IS the telemetry overhead,
-    # and the spans dump to a Perfetto-loadable Chrome trace file.
-    from crane_scheduler_tpu.telemetry import Telemetry
+    # per-cycle dispatch/d2h_wait spans under a per-cycle trace context,
+    # a cycle counter, a latency histogram, AND the pod-lifecycle state
+    # machine (seen -> scored on dispatch, bind_post -> watch_confirm on
+    # drain, finalizing into the stage/e2e histograms with a trace-ID
+    # exemplar) — everything BatchScheduler's instrumented loops record
+    # per cycle. The delta vs the bare pass above IS the telemetry
+    # overhead, and the spans dump to a Perfetto-loadable Chrome trace.
+    from crane_scheduler_tpu.telemetry import Telemetry, tracing
 
     tel = Telemetry(span_capacity=4096)
+    lc = tel.lifecycle
     m_cycles = tel.registry.counter(
         "bench_pipelined_cycles_total", "pipelined cycles completed"
     )
@@ -376,28 +380,41 @@ def main() -> int:
     )
 
     def _drain_one(tel_item):
-        dev, c0 = tel_item
-        with tel.spans.span("d2h_wait"):
-            np.asarray(dev)
+        dev, c0, tracked, ctx = tel_item
+        with tracing.use(ctx):
+            with tel.spans.span("d2h_wait"):
+                np.asarray(dev)
+        lc.posted_batch([(k, "bench-node") for k in tracked])
+        lc.confirmed_batch([(k, "bench-node") for k in tracked])
         m_cycles.inc()
         m_cycle_s.observe(time.perf_counter() - c0)
 
-    def _sustained_pass_telemetry():
+    def _sustained_pass_telemetry(pass_idx):
         t0 = time.perf_counter()
         in_flight = deque()
-        for _ in range(k_sustained):
+        for i in range(k_sustained):
             c0 = time.perf_counter()
-            with tel.spans.span("dispatch"):
-                dev = step.packed(prepared, N_PODS)
-                dev.copy_to_host_async()
-            in_flight.append((dev, c0))
+            ctx = tracing.new_context()
+            with tracing.use(ctx):
+                with tel.spans.span("dispatch"):
+                    dev = step.packed(prepared, N_PODS)
+                    dev.copy_to_host_async()
+            # the batch path tracks a prefix sample of each dispatch
+            keys = [
+                f"bench/p{pass_idx}-{i}-{j}" for j in range(lc.batch_sample)
+            ]
+            tracked = lc.seen_batch(keys)
+            lc.stage_batch(
+                tracked, "scored", cycle_trace=ctx.trace_id, anno_ts=t0
+            )
+            in_flight.append((dev, c0, tracked, ctx))
             if len(in_flight) >= pipe_depth:
                 _drain_one(in_flight.popleft())
         while in_flight:
             _drain_one(in_flight.popleft())
         return time.perf_counter() - t0
 
-    sustained_tel_s = min(_sustained_pass_telemetry() for _ in range(2))
+    sustained_tel_s = min(_sustained_pass_telemetry(p) for p in range(2))
     tel_cycles_per_sec = k_sustained / sustained_tel_s
     tel_overhead_pct = (
         (cycles_per_sec - tel_cycles_per_sec) / cycles_per_sec * 100.0
@@ -406,7 +423,8 @@ def main() -> int:
     spans_written = tel.spans.dump(trace_file)
     log(
         f"telemetry enabled: {tel_cycles_per_sec:.1f} cycles/s "
-        f"(overhead {tel_overhead_pct:+.2f}% vs disabled); "
+        f"(overhead {tel_overhead_pct:+.2f}% vs disabled, lifecycle "
+        f"tracking on: {lc.confirmed_total} placements finalized); "
         f"{spans_written} spans -> {trace_file} (Perfetto-loadable)"
     )
 

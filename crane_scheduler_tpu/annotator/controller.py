@@ -49,6 +49,7 @@ from ..metrics.source import (
 )
 from ..policy.types import DynamicSchedulerPolicy
 from ..telemetry import Telemetry, active as active_telemetry
+from ..telemetry import tracing
 from .bindings import BindingRecords, max_hot_value_time_range
 from .events import EventIngestor
 from .workqueue import RateLimitedQueue
@@ -352,8 +353,27 @@ class NodeAnnotator:
         if node is None:
             return True  # node gone: drop
         try:
-            self.annotate_node_load(node, metric_name, now)
-            self.annotate_node_hot_value(node, now)
+            tel = self._telemetry
+            if tel is not None:
+                # same anno_ts join key as the bulk sweep: the wire
+                # truncates the timestamp, so lifecycle records match
+                # only the truncated value
+                _, anno_ts = decode_annotation_or_missing(
+                    f"0,{format_local_time(now)}"
+                )
+                ctx = tracing.current() or tracing.new_context()
+                with tracing.use(ctx):
+                    with tel.spans.span(
+                        "annotator_sync",
+                        metric=metric_name,
+                        node=node_name,
+                        anno_ts=anno_ts,
+                    ):
+                        self.annotate_node_load(node, metric_name, now)
+                        self.annotate_node_hot_value(node, now)
+            else:
+                self.annotate_node_load(node, metric_name, now)
+                self.annotate_node_hot_value(node, now)
         except MetricsQueryError:
             self.sync_errors += 1
             if self._m_errors is not None:
@@ -502,11 +522,22 @@ class NodeAnnotator:
             return self._sync_metric_bulk_impl(
                 metric_name, now, hot_by_node, hot_emitted
             )
+        if now is None:
+            now = time.time()
+        # the sweep stamps ONE wire-truncated timestamp on every row it
+        # patches (see _sync_metric_bulk_impl); carrying that exact value
+        # on the span is the join key between a placement's lifecycle
+        # record (rec["anno_ts"]) and the annotator sync that fed it
+        _, anno_ts = decode_annotation_or_missing(f"0,{format_local_time(now)}")
+        ctx = tracing.current() or tracing.new_context()
         t0 = time.perf_counter()
-        with tel.spans.span("annotator_sync", metric=metric_name):
-            patched = self._sync_metric_bulk_impl(
-                metric_name, now, hot_by_node, hot_emitted
-            )
+        with tracing.use(ctx):
+            with tel.spans.span(
+                "annotator_sync", metric=metric_name, anno_ts=anno_ts
+            ):
+                patched = self._sync_metric_bulk_impl(
+                    metric_name, now, hot_by_node, hot_emitted
+                )
         self._m_sync_seconds.labels(metric=metric_name).observe(
             time.perf_counter() - t0
         )

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 
@@ -51,11 +52,19 @@ def main(argv=None) -> int:
                              "equal-score feasible nodes (the stock "
                              "framework's dispersion behavior); default "
                              "off = lowest node index, deterministic")
+    parser.add_argument("--flight-dir", default=None,
+                        help="directory for the crash-safe flight recorder "
+                             "(lifecycle records + spans as a bounded JSONL "
+                             "ring); implies telemetry")
     parser.add_argument("-v", "--verbose", action="count", default=0,
                         help="raise [crane] log verbosity (-v sweeps/"
                              "windows, -vv cycles, -vvv per-pod); "
                              "default run is quiet")
     args = parser.parse_args(argv)
+
+    if args.flight_dir:
+        os.environ["CRANE_FLIGHT_DIR"] = args.flight_dir
+        os.environ.setdefault("CRANE_TELEMETRY", "1")
 
     from ..utils.logging import set_verbosity
 

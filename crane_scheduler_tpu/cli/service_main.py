@@ -19,6 +19,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import threading
 
@@ -55,11 +56,19 @@ def main(argv=None) -> int:
                         help="host:port of process 0 (jax.distributed)")
     parser.add_argument("--num-processes", type=int, default=None)
     parser.add_argument("--process-id", type=int, default=None)
+    parser.add_argument("--flight-dir", default=None,
+                        help="directory for the crash-safe flight recorder "
+                             "(lifecycle records + spans as a bounded JSONL "
+                             "ring); implies telemetry")
     parser.add_argument("-v", "--verbose", action="count", default=0,
                         help="raise [crane] log verbosity (-v sweeps/"
                              "windows, -vv cycles, -vvv per-pod); "
                              "default run is quiet")
     args = parser.parse_args(argv)
+
+    if args.flight_dir:
+        os.environ["CRANE_FLIGHT_DIR"] = args.flight_dir
+        os.environ.setdefault("CRANE_TELEMETRY", "1")
 
     from ..utils.logging import set_verbosity
 

@@ -568,9 +568,10 @@ class _PooledWriter(threading.Thread):
                 if self._conn is not None:
                     self._conn.close()
                 return
-            method, path, body, content_type, fut = item
+            method, path, body, content_type, extra_headers, fut = item
             try:
-                result = self._do(method, path, body, content_type)
+                result = self._do(method, path, body, content_type,
+                                  extra_headers)
             except Exception as exc:  # noqa: BLE001 — a worker must never die
                 self._drop_conn()
                 self.status_failures[0] = self.status_failures.get(0, 0) + 1
@@ -597,7 +598,8 @@ class _PooledWriter(threading.Thread):
                 pass  # HTTP-date form: fall through to backoff
         return min(backoff, _MAX_RETRY_SLEEP)
 
-    def _do(self, method: str, path: str, body, content_type: str) -> WriteResult:
+    def _do(self, method: str, path: str, body, content_type: str,
+            extra_headers: dict | None = None) -> WriteResult:
         if body is None:
             data = None
         elif isinstance(body, bytes):
@@ -609,6 +611,8 @@ class _PooledWriter(threading.Thread):
             headers["Content-Type"] = content_type
         if self._token:
             headers["Authorization"] = f"Bearer {self._token}"
+        if extra_headers:
+            headers.update(extra_headers)  # e.g. traceparent (ISSUE 9)
         transport_retried = False
         status_retries = 0
         backoff = 0.05
@@ -795,6 +799,9 @@ class KubeClusterClient:
         self._telemetry = (
             telemetry if telemetry is not None else active_telemetry()
         )
+        # ISSUE 9: pod-lifecycle tracker — bind/evict POSTs carry the
+        # pod's traceparent and the watch apply confirms placements
+        self._lifecycle = getattr(self._telemetry, "lifecycle", None)
         self._m_flush_seconds = None
         self._m_status_retries = None
         self._m_native_failures = None
@@ -977,6 +984,7 @@ class KubeClusterClient:
         path: str,
         body,
         content_type: str = "application/json",
+        headers: dict | None = None,
     ) -> Future:
         """Route a write to the pool worker owning ``key``. All writes
         for one object land on one worker's FIFO queue, so per-object
@@ -1006,7 +1014,7 @@ class KubeClusterClient:
             worker = self._pool[hash(key) % len(self._pool)]
             if self.write_breaker is not None:
                 fut.add_done_callback(self._record_write_outcome)
-            worker.queue.put((method, path, body, content_type, fut))
+            worker.queue.put((method, path, body, content_type, headers, fut))
         return fut
 
     def _record_write_outcome(self, fut: Future) -> None:
@@ -1028,8 +1036,11 @@ class KubeClusterClient:
         path: str,
         body,
         content_type: str = "application/json",
+        headers: dict | None = None,
     ) -> bool:
-        return self._submit_write(key, method, path, body, content_type).result()
+        return self._submit_write(
+            key, method, path, body, content_type, headers
+        ).result()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -1449,14 +1460,18 @@ class KubeClusterClient:
             return self._native_flusher
 
     def _render_request(self, method: str, path: str, body,
-                        content_type: str = "application/json") -> bytes:
+                        content_type: str = "application/json",
+                        extra_headers: dict | None = None) -> bytes:
         data = body if isinstance(body, bytes) else json.dumps(body).encode()
         host = f"{self._host}:{self._port}" if self._port else self._host
         auth = f"Authorization: Bearer {self._token}\r\n" if self._token else ""
+        extra = ""
+        if extra_headers:
+            extra = "".join(f"{k}: {v}\r\n" for k, v in extra_headers.items())
         return (
             f"{method} {path} HTTP/1.1\r\nHost: {host}\r\n"
             f"Content-Length: {len(data)}\r\n"
-            f"Content-Type: {content_type}\r\n{auth}\r\n"
+            f"Content-Type: {content_type}\r\n{auth}{extra}\r\n"
         ).encode("latin-1") + data
 
     @staticmethod
@@ -2014,6 +2029,7 @@ class KubeClusterClient:
         else:
             if self._m_watch_batch_pods is not None:
                 self._m_watch_batch_pods.observe(len(batch))
+            self._confirm_placements(batch)
             self._mirror.apply_pod_changes(batch)
 
     def _invalidate_node_rvs(self, names) -> None:
@@ -2042,19 +2058,33 @@ class KubeClusterClient:
         self._invalidate_node_rvs(n.name for _, n in decoded)
         self._mirror.apply_node_changes(decoded)
 
+    def _confirm_placements(self, decoded: list) -> None:
+        """Watch-CONFIRMED lifecycle hook: a non-DELETED pod event
+        carrying a nodeName is the authoritative end of a placement.
+        Untracked keys cost one dict miss inside one lock."""
+        lc = self._lifecycle
+        if lc is None:
+            return
+        lc.confirmed_batch(
+            (pod.key(), pod.node_name)
+            for t, pod in decoded
+            if t != "DELETED" and pod.node_name
+        )
+
     def _apply_pod(self, change_type: str, obj: dict) -> None:
         pod = pod_from_json(obj)
         if change_type == "DELETED":
             self._mirror.delete_pod(pod.key())
         else:
             self._mirror.add_pod(pod)
+            self._confirm_placements(((change_type, pod),))
 
     def _apply_pod_batch(self, changes: list) -> None:
         if self._m_watch_batch_pods is not None:
             self._m_watch_batch_pods.observe(len(changes))
-        self._mirror.apply_pod_changes(
-            [(t, pod_from_json(o)) for t, o in changes]
-        )
+        decoded = [(t, pod_from_json(o)) for t, o in changes]
+        self._confirm_placements(decoded)
+        self._mirror.apply_pod_changes(decoded)
 
     def _apply_nrt(self, change_type: str, obj: dict) -> None:
         nrt = nrt_from_json(obj)
@@ -2410,6 +2440,7 @@ class KubeClusterClient:
             "POST",
             f"/api/v1/namespaces/{namespace}/pods/{name}/eviction",
             body,
+            headers=self._trace_header(key),
         ):
             return False
         # optimistic mirror apply; the watch's authoritative DELETED
@@ -2485,16 +2516,36 @@ class KubeClusterClient:
         finally:
             m.labels(kind="post_batch").observe(time.perf_counter() - t0)
 
+    def _trace_header(self, key: str) -> dict | None:
+        """``{"traceparent": ...}`` when the pod is lifecycle-tracked."""
+        lc = self._lifecycle
+        if lc is None:
+            return None
+        tp = lc.traceparent(key)
+        return {"traceparent": tp} if tp else None
+
     def _post_batch_impl(self, items: list[tuple[str, str, dict]]) -> list[bool]:
         n = len(items)
         ok = [False] * n
         retry: list[int] = []
         statuses = None
+        lc = self._lifecycle
+        # one lock for the whole batch; only tracked pods get headers
+        tp = (
+            lc.traceparent_batch([key for key, _, _ in items])
+            if lc is not None else {}
+        )
+
+        def _hdr(key):
+            v = tp.get(key)
+            return {"traceparent": v} if v else None
+
         flusher = self._get_native_flusher()
         if flusher is not None and n >= _NATIVE_FLUSH_MIN:
             reqs = [
-                self._render_request("POST", path, body)
-                for _, path, body in items
+                self._render_request("POST", path, body,
+                                     extra_headers=_hdr(key))
+                for key, path, body in items
             ]
             if self._pipeline_disabled:
                 statuses = flusher.flush(reqs, idempotent=False).tolist()
@@ -2507,8 +2558,9 @@ class KubeClusterClient:
             # fan-out still beats one-request-per-round-trip pooled
             # writers for storm-sized POST batches
             reqs = [
-                self._render_request("POST", path, body)
-                for _, path, body in items
+                self._render_request("POST", path, body,
+                                     extra_headers=_hdr(key))
+                for key, path, body in items
             ]
             statuses = self._pipelined_flush(reqs, idempotent=False)
         if statuses is None:
@@ -2528,11 +2580,20 @@ class KubeClusterClient:
         if retry:
             futs = [
                 (i, self._submit_write(
-                    items[i][0], "POST", items[i][1], items[i][2]))
+                    items[i][0], "POST", items[i][1], items[i][2],
+                    headers=_hdr(items[i][0])))
                 for i in retry
             ]
             for i, fut in futs:
                 ok[i] = bool(fut.result())
+        if lc is not None and tp:
+            posted = [
+                (items[i][0], None) for i in range(n)
+                if ok[i] and items[i][0] in tp
+                and items[i][1].endswith("/binding")
+            ]
+            if posted:
+                lc.posted_batch(posted)
         return ok
 
     # -- columnar bursts through the API -----------------------------------
@@ -2694,8 +2755,11 @@ class KubeClusterClient:
         The apiserver emits the Scheduled event; it reaches subscribers
         through the event watch (the closed loop of SURVEY §3.4)."""
         path, body = self._binding_request(pod_key, node_name)
-        if not self._write(pod_key, "POST", path, body):
+        if not self._write(pod_key, "POST", path, body,
+                           headers=self._trace_header(pod_key)):
             return False
+        if self._lifecycle is not None:
+            self._lifecycle.posted(pod_key, node=node_name)
         self._apply_bound(pod_key, node_name)
         return True
 

@@ -287,6 +287,11 @@ class LoadAwareDescheduler:
                     self._skip(report.skipped, "evict_failed")
                     continue
                 report.evicted.append(ev)
+                lc = getattr(self._telemetry, "lifecycle", None)
+                if lc is not None:
+                    # finalize this placement attempt as evicted; a
+                    # re-placement of the same key continues the trace
+                    lc.evicted(pod.key(), reason=ev.reason)
                 self.evictions += 1
                 node_budget -= 1
                 cycle_budget -= 1

@@ -34,6 +34,7 @@ from ..loadstore.store import NodeLoadStore
 from ..policy.compile import compile_policy
 from ..policy.types import DynamicSchedulerPolicy
 from ..telemetry import Telemetry, active as active_telemetry, maybe_span
+from ..telemetry import tracing
 from ..utils.logging import vlog, verbosity
 
 
@@ -142,6 +143,17 @@ class _OverlappedRefresh:
         self._pool.shutdown(wait=False, cancel_futures=True)
 
 
+def _burst_posted_pairs(tracked, node_idx, table):
+    """``(key, node)`` pairs for the lifecycle-tracked prefix of a burst
+    that actually got a node row (post-reconcile)."""
+    pairs = []
+    for i, key in enumerate(tracked):
+        idx = int(node_idx[i])
+        if idx >= 0:
+            pairs.append((key, table[idx]))
+    return pairs
+
+
 class _BindFlushQueue:
     """Coalescing, overlapped bind flush for the pipelined loops — the
     write-side twin of ``_OverlappedRefresh``: binds accumulate for up
@@ -193,17 +205,20 @@ class _BindFlushQueue:
 
     # -- producer side (scheduling thread) --------------------------------
 
-    def submit_batch(self, result: "BatchResult", now: float) -> None:
+    def submit_batch(self, result: "BatchResult", now: float,
+                     tracked=()) -> None:
         with self._lock:
             self._outstanding += 1
-        self._q.put(("batch", result, now))
+        self._q.put(("batch", result, now, tracked))
 
     def submit_burst(self, namespace: str, names: list, node_table,
-                     node_idx, result: "BurstResult", now: float) -> None:
+                     node_idx, result: "BurstResult", now: float,
+                     tracked=()) -> None:
         with self._lock:
             self._outstanding += 1
         self._q.put(
-            ("burst", namespace, names, node_table, node_idx, result, now)
+            ("burst", namespace, names, node_table, node_idx, result, now,
+             tracked)
         )
 
     def flush(self) -> None:
@@ -289,29 +304,39 @@ class _BindFlushQueue:
         cluster = sched.cluster
         batches = [i for i in window if i[0] == "batch"]
         bursts = [i for i in window if i[0] == "burst"]
+        # scheduler-shaped stand-ins (tests, embedders) may not carry one
+        lc = getattr(sched, "_lifecycle", None)
         if batches:
             # one merged bind transaction for the window's batch results
             merged: dict = {}
-            for _, result, _now in batches:
+            for _, result, _now, _tr in batches:
                 merged.update(result.assignments)
             now = batches[-1][2]
             bound = set(cluster.bind_pods(merged, now))
-            for _, result, _now in batches:
+            posted_pairs = []
+            for _, result, _now, tracked in batches:
                 failed = [k for k in result.assignments if k not in bound]
                 for k in failed:
                     del result.assignments[k]
                 result.unassigned.extend(failed)
+                if lc is not None:
+                    posted_pairs.extend(
+                        (k, result.assignments[k]) for k in tracked
+                        if k in result.assignments
+                    )
+            if posted_pairs:
+                lc.posted_batch(posted_pairs)
         if bursts:
             # creations first (a bind of an uncreated pod is refused),
             # then one coalesced columnar bind across the window
             add_burst = cluster.add_pod_burst
             handles = [
                 add_burst(ns, names)
-                for _, ns, names, _t, _i, _r, _n in bursts
+                for _, ns, names, _t, _i, _r, _n, _tr in bursts
             ]
             triples = []
-            for handle, (_, _ns, _names, table, node_idx, result, _now) in zip(
-                    handles, bursts):
+            for handle, (_, _ns, _names, table, node_idx, result, _now,
+                         _tr) in zip(handles, bursts):
                 failed = getattr(handle, "failed", None)
                 if failed:
                     # rows the server refused to create can never bind
@@ -327,7 +352,8 @@ class _BindFlushQueue:
                 bound_lists = [
                     cluster.bind_burst(h, t, i, now) for h, t, i in triples
                 ]
-            for (_, _ns, _names, table, _i, result, _now), bound in zip(
+            posted_pairs = []
+            for (_, _ns, _names, table, _i, result, _now, tracked), bound in zip(
                     bursts, bound_lists):
                 result.bound_rows = bound
                 node_idx = np.asarray(result.node_idx)
@@ -337,6 +363,12 @@ class _BindFlushQueue:
                     result.node_idx = np.where(
                         mask, node_idx, -1
                     ).astype(np.int32)
+                if lc is not None and tracked:
+                    posted_pairs.extend(_burst_posted_pairs(
+                        tracked, np.asarray(result.node_idx), table
+                    ))
+            if posted_pairs:
+                lc.posted_batch(posted_pairs)
 
 
 class Scheduler:
@@ -442,8 +474,17 @@ class Scheduler:
         if tel is None:
             return self._schedule_one(pod, None)
         reasons: dict[str, int] = {}
-        with tel.spans.span("schedule_one"):
-            result = self._schedule_one(pod, reasons)
+        # lifecycle: first-seen mints the pod's trace; the schedule_one
+        # span (and everything under it) parents to that root context
+        lc = getattr(tel, "lifecycle", None)
+        ctx = lc.seen(pod.key(), source="drip") if lc is not None else None
+        with tel.spans.span("schedule_one", ctx=ctx):
+            result = self._schedule_one(pod, reasons, lc=lc)
+        if lc is not None and result.node:
+            # the bind POST already happened inside _schedule_one (kube
+            # clients mark bind_post at POST-accept; this covers the
+            # in-memory ClusterState, idempotently)
+            lc.posted(pod.key(), node=result.node)
         self._m_decisions.labels(
             outcome="scheduled" if result.node else "failed"
         ).inc()
@@ -461,7 +502,7 @@ class Scheduler:
         return result
 
     def _schedule_one(
-        self, pod: Pod, reasons: dict | None
+        self, pod: Pod, reasons: dict | None, lc=None
     ) -> ScheduleResult:
         state = CycleState()
         nodes = self.snapshot()
@@ -495,6 +536,8 @@ class Scheduler:
                     reasons[verdict.reason] = reasons.get(verdict.reason, 0) + 1
         if not feasible:
             return ScheduleResult(pod.key(), None, 0, last_reason or "no feasible nodes")
+        if lc is not None:
+            lc.stage(pod.key(), "filtered")
 
         # Score: weighted sum over score plugins
         totals: dict[str, int] = {}
@@ -548,6 +591,11 @@ class Scheduler:
             vlog(3, f"schedule_one {pod.key()}: {len(feasible)} feasible, "
                     f"picked {best_name} score {totals[best_name]}")
 
+        # stage marks must land BEFORE the bind POST: the confirming
+        # watch event can finalize the record the instant the POST is
+        # accepted (stage marks after that point would be dropped)
+        if lc is not None:
+            lc.stage(pod.key(), "scored", node=best_name)
         prev = self.cluster.get_pod(pod.key())
         was_bound = prev is not None and bool(prev.node_name)
         pre_version = self.cluster.sched_version
@@ -752,6 +800,11 @@ class BatchScheduler:
         else:
             self.refresh_stats = stats_init
         self._last_refresh_wall = 0.0  # decision-trace staleness anchor
+        # newest annotation timestamp the store has seen — the join key
+        # between lifecycle records and the annotator sync that stamped
+        # the scores a cycle consumed (ISSUE 9)
+        self.last_anno_ts: float | None = None
+        self._lifecycle = getattr(self._telemetry, "lifecycle", None)
         # last decoded-columns version ingested (refresh()'s columnar
         # fast path): matching version == nothing changed == skip
         self._columns_consumed = None
@@ -788,6 +841,7 @@ class BatchScheduler:
         mirror costs nothing. Any mirror change since the relist
         invalidates them and the object path below takes over."""
         if not self._refresh_from_cluster:
+            self._update_anno_ts()  # the annotator owns the store
             return
         t0 = time.perf_counter()
         with maybe_span(self._telemetry, "ingest"):
@@ -810,7 +864,23 @@ class BatchScheduler:
                 )
                 self.store.prune_absent(n.name for n in nodes)
         self.refresh_stats["ingest_ms"] += (time.perf_counter() - t0) * 1e3
+        self._update_anno_ts()
         self._last_refresh_wall = self._clock()
+
+    def _update_anno_ts(self) -> None:
+        """Track the newest hot-value timestamp in the store — the
+        annotator stamps one shared ts per sweep, so this identifies
+        WHICH sync fed the scores (one [N] max; telemetry-gated)."""
+        if self._telemetry is None:
+            return
+        try:
+            n = len(self.store)
+            if n:
+                ts = float(self.store.hot_ts[:n].max())
+                if ts > float("-inf"):
+                    self.last_anno_ts = ts
+        except (AttributeError, TypeError, ValueError):
+            pass
 
     # Delta uploads only pay off while the dirt is sparse: past this
     # fraction of rows a full column re-upload is cheaper than the
@@ -953,20 +1023,38 @@ class BatchScheduler:
         import numpy as np
 
         tel = self._telemetry
+        lc = self._lifecycle
         now = self._clock()
-        self.refresh()
-        with maybe_span(tel, "prepare"):
-            prepared = self._prepare(now)
+        ctx = tracing.new_context() if tel is not None else None
+        with tracing.use(ctx):
+            self.refresh()
+            with maybe_span(tel, "prepare"):
+                prepared = self._prepare(now)
 
-        with maybe_span(tel, "exec_fetch", pods=len(pods)):
-            packed = np.asarray(
-                self._sharded.packed(prepared, len(pods), now=now)
-            )  # the cycle's single device->host fetch
-        result = self._build_result(packed, [pod.key() for pod in pods], now=now)
+            with maybe_span(tel, "exec_fetch", pods=len(pods)):
+                packed = np.asarray(
+                    self._sharded.packed(prepared, len(pods), now=now)
+                )  # the cycle's single device->host fetch
+            keys = [pod.key() for pod in pods]
+            tracked = lc.seen_batch(keys) if lc is not None else ()
+            result = self._build_result(packed, keys, now=now)
+            if tracked:
+                lc.stage_batch(
+                    tracked, "scored",
+                    cycle_trace=ctx.trace_id if ctx is not None else None,
+                    anno_ts=self.last_anno_ts,
+                )
 
-        if bind:
-            with maybe_span(tel, "bind_flush"):
-                self._apply_binds(result, now)
+            if bind:
+                with maybe_span(tel, "bind_flush"):
+                    self._apply_binds(result, now)
+                if tracked:
+                    # idempotent vs the kube write path's POST-side mark;
+                    # covers in-memory ClusterState binds too
+                    lc.posted_batch([
+                        (k, result.assignments[k]) for k in tracked
+                        if k in result.assignments
+                    ])
         if verbosity() >= 2:
             vlog(2, f"batch cycle: {len(result.assignments)}/{len(pods)} "
                     f"assigned, {len(result.unassigned)} unassigned")
@@ -1052,23 +1140,30 @@ class BatchScheduler:
             ThreadPoolExecutor(max_workers=1, thread_name_prefix="d2h-prefetch")
             if depth > 1 else None
         )
+        lc = self._lifecycle
         try:
             for pods in batches:
                 now = self._clock()
-                with maybe_span(tel, "refresh_tick"):
-                    if refresher is not None:
-                        refresher.tick()
-                    else:
-                        self.refresh()
-                with maybe_span(tel, "prepare"):
-                    prepared = self._prepare(now)
-                with maybe_span(tel, "dispatch", pods=len(pods)):
-                    dev = self._sharded.packed(prepared, len(pods), now=now)
-                    dev.copy_to_host_async()
+                # per-cycle trace context: the cycle's spans stamp with
+                # one trace id so lifecycle records can join the cycle
+                # that scored them (rec["cycle_trace"])
+                ctx = tracing.new_context() if tel is not None else None
+                with tracing.use(ctx):
+                    with maybe_span(tel, "refresh_tick"):
+                        if refresher is not None:
+                            refresher.tick()
+                        else:
+                            self.refresh()
+                    with maybe_span(tel, "prepare"):
+                        prepared = self._prepare(now)
+                    with maybe_span(tel, "dispatch", pods=len(pods)):
+                        dev = self._sharded.packed(prepared, len(pods), now=now)
+                        dev.copy_to_host_async()
                 keys = [pod.key() for pod in pods]
+                tracked = lc.seen_batch(keys) if lc is not None else ()
                 pending.append((
                     _submit_fetch(pool, dev, tel), keys, now,
-                    self._prepared_names, self._prepared_n,
+                    self._prepared_names, self._prepared_n, tracked, ctx,
                 ))
                 if len(pending) >= depth:
                     yield self._drain_pipelined(pending.popleft(), bind, bindq)
@@ -1090,18 +1185,31 @@ class BatchScheduler:
     def _drain_pipelined(self, pending, bind: bool,
                          bindq: "_BindFlushQueue | None" = None) -> BatchResult:
         tel = self._telemetry
-        fut, keys, now, names, n = pending
-        with maybe_span(tel, "d2h_wait"):
-            packed = fut.result()  # the only synchronization point
-        result = self._build_result(packed, keys, now=now, names=names, n=n)
-        if bind:
-            if bindq is not None:
-                # coalesced background flush: the result's bind fields
-                # settle when the window flushes
-                bindq.submit_batch(result, now)
-            else:
-                with maybe_span(tel, "bind_flush"):
-                    self._apply_binds(result, now)
+        lc = self._lifecycle
+        fut, keys, now, names, n, tracked, ctx = pending
+        with tracing.use(ctx):
+            with maybe_span(tel, "d2h_wait"):
+                packed = fut.result()  # the only synchronization point
+            result = self._build_result(packed, keys, now=now, names=names, n=n)
+            if tracked:
+                lc.stage_batch(
+                    tracked, "scored",
+                    cycle_trace=ctx.trace_id if ctx is not None else None,
+                    anno_ts=self.last_anno_ts,
+                )
+            if bind:
+                if bindq is not None:
+                    # coalesced background flush: the result's bind fields
+                    # settle when the window flushes
+                    bindq.submit_batch(result, now, tracked)
+                else:
+                    with maybe_span(tel, "bind_flush"):
+                        self._apply_binds(result, now)
+                    if tracked:
+                        lc.posted_batch([
+                            (k, result.assignments[k]) for k in tracked
+                            if k in result.assignments
+                        ])
         return result
 
     # -- columnar bursts (pods as rows, binds as one array transaction) ----
@@ -1167,19 +1275,22 @@ class BatchScheduler:
             ThreadPoolExecutor(max_workers=1, thread_name_prefix="d2h-prefetch")
             if depth > 1 else None
         )
+        lc = self._lifecycle
         try:
             for namespace, names in bursts:
                 now = self._clock()
-                with maybe_span(tel, "refresh_tick"):
-                    if refresher is not None:
-                        refresher.tick()
-                    else:
-                        self.refresh()
-                with maybe_span(tel, "prepare"):
-                    prepared = self._prepare(now)
-                with maybe_span(tel, "dispatch", pods=len(names)):
-                    dev = self._sharded.packed(prepared, len(names), now=now)
-                    dev.copy_to_host_async()
+                ctx = tracing.new_context() if tel is not None else None
+                with tracing.use(ctx):
+                    with maybe_span(tel, "refresh_tick"):
+                        if refresher is not None:
+                            refresher.tick()
+                        else:
+                            self.refresh()
+                    with maybe_span(tel, "prepare"):
+                        prepared = self._prepare(now)
+                    with maybe_span(tel, "dispatch", pods=len(names)):
+                        dev = self._sharded.packed(prepared, len(names), now=now)
+                        dev.copy_to_host_async()
                 # with a bind queue, the creation POST rides the flush
                 # worker too (ordered before the bind on its FIFO), so
                 # the dispatch thread never waits on the wire
@@ -1187,9 +1298,18 @@ class BatchScheduler:
                     add_burst(namespace, names)
                     if bind and bindq is None else None
                 )
+                # sample-prefix lifecycle tracking; tracked[i] <-> row i
+                tracked = (
+                    lc.seen_batch(
+                        [f"{namespace}/{nm}"
+                         for nm in names[:lc.batch_sample]],
+                        source="burst",
+                    ) if lc is not None else ()
+                )
                 pending.append(
                     (_submit_fetch(pool, dev, tel), namespace, names,
-                     handle, now, self._prepared_names, self._prepared_n)
+                     handle, now, self._prepared_names, self._prepared_n,
+                     tracked, ctx)
                 )
                 if len(pending) >= depth:
                     yield self._drain_burst(pending.popleft(), bind, bindq)
@@ -1208,52 +1328,64 @@ class BatchScheduler:
         import numpy as np
 
         tel = self._telemetry
-        fut, namespace, names, handle, now, node_names, n = item
-        with maybe_span(tel, "d2h_wait"):
-            packed = fut.result()  # the only synchronization point
-        schedulable, scores, counts, _unassigned, _ = self._sharded.unpack(
-            packed, n
-        )
-        scores = np.asarray(scores)
-        counts = np.asarray(counts)
-        # same stable score-descending expansion as _expand_counts, kept
-        # columnar: order[i] is pod-row i's node row
-        by_score = np.argsort(-scores, kind="stable")
-        order = np.repeat(by_score, counts[by_score]).astype(np.int32)
-        node_idx = np.full((len(names),), -1, dtype=np.int32)
-        k = min(len(order), len(names))
-        node_idx[:k] = order[:k]
-        table = self._burst_node_table(node_names, n)
-        if tel is not None:
-            self._trace_batch_decision(
-                tel, scores, schedulable, counts, n, node_names,
-                len(names), now, source="burst",
+        lc = self._lifecycle
+        fut, namespace, names, handle, now, node_names, n, tracked, ctx = item
+        with tracing.use(ctx):
+            with maybe_span(tel, "d2h_wait"):
+                packed = fut.result()  # the only synchronization point
+            schedulable, scores, counts, _unassigned, _ = self._sharded.unpack(
+                packed, n
             )
-        bound = None
-        if bind and handle is not None:
-            with maybe_span(tel, "bind_flush"):
-                bound = self.cluster.bind_burst(handle, table, node_idx, now)
-            if len(bound) != int((node_idx >= 0).sum()):
-                # reconcile with what actually bound (rows deleted or
-                # shadowed between dispatch and drain) — reporting them
-                # as scheduled would be the phantom-placement bug
-                mask = np.zeros((len(names),), dtype=bool)
-                mask[bound] = True
-                node_idx = np.where(mask, node_idx, -1).astype(np.int32)
-        result = BurstResult(
-            namespace=namespace,
-            names=names,
-            node_idx=node_idx,
-            node_table=table,
-            bound_rows=bound,
-            scores_row=scores,
-            schedulable_row=np.asarray(schedulable),
-            now=now,
-        )
-        if bind and bindq is not None:
-            # coalesced path: creation + bind run on the flush worker;
-            # bound_rows/node_idx settle when the window flushes
-            bindq.submit_burst(namespace, names, table, node_idx, result, now)
+            scores = np.asarray(scores)
+            counts = np.asarray(counts)
+            # same stable score-descending expansion as _expand_counts, kept
+            # columnar: order[i] is pod-row i's node row
+            by_score = np.argsort(-scores, kind="stable")
+            order = np.repeat(by_score, counts[by_score]).astype(np.int32)
+            node_idx = np.full((len(names),), -1, dtype=np.int32)
+            k = min(len(order), len(names))
+            node_idx[:k] = order[:k]
+            table = self._burst_node_table(node_names, n)
+            if tel is not None:
+                self._trace_batch_decision(
+                    tel, scores, schedulable, counts, n, node_names,
+                    len(names), now, source="burst",
+                )
+            if tracked:
+                lc.stage_batch(
+                    tracked, "scored",
+                    cycle_trace=ctx.trace_id if ctx is not None else None,
+                    anno_ts=self.last_anno_ts,
+                )
+            bound = None
+            if bind and handle is not None:
+                with maybe_span(tel, "bind_flush"):
+                    bound = self.cluster.bind_burst(handle, table, node_idx, now)
+                if len(bound) != int((node_idx >= 0).sum()):
+                    # reconcile with what actually bound (rows deleted or
+                    # shadowed between dispatch and drain) — reporting them
+                    # as scheduled would be the phantom-placement bug
+                    mask = np.zeros((len(names),), dtype=bool)
+                    mask[bound] = True
+                    node_idx = np.where(mask, node_idx, -1).astype(np.int32)
+                if tracked:
+                    lc.posted_batch(_burst_posted_pairs(tracked, node_idx, table))
+            result = BurstResult(
+                namespace=namespace,
+                names=names,
+                node_idx=node_idx,
+                node_table=table,
+                bound_rows=bound,
+                scores_row=scores,
+                schedulable_row=np.asarray(schedulable),
+                now=now,
+            )
+            if bind and bindq is not None:
+                # coalesced path: creation + bind run on the flush worker;
+                # bound_rows/node_idx settle when the window flushes
+                bindq.submit_burst(
+                    namespace, names, table, node_idx, result, now, tracked
+                )
         return result
 
     def _burst_node_table(self, node_names, n: int) -> tuple:

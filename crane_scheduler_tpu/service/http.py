@@ -20,12 +20,19 @@ produce byte-identical payloads):
   HTTP/1.1 and Content-Length is always sent).
 
 ``/metrics`` content-negotiates: a scraper Accept header mentioning
-``text/plain`` or ``openmetrics`` gets the Prometheus text exposition
-(rendered by the telemetry registry); anything else gets the legacy
-JSON counters, so pre-telemetry clients keep working unchanged.
-``GET /debug/decisions`` serves the sampled decision-trace ring
-(``?n=`` caps the newest entries) and ``GET /debug/trace`` the
-Chrome trace-event JSON of the recorded spans.
+``openmetrics`` gets the OpenMetrics exposition (exemplars + ``# EOF``),
+one mentioning ``text/plain`` gets the Prometheus 0.0.4 text exposition,
+and anything else gets the legacy JSON counters, so pre-telemetry
+clients keep working unchanged. ``GET /debug/decisions`` serves the
+sampled decision-trace ring (``?n=`` caps the newest entries),
+``GET /debug/lifecycle`` the pod-lifecycle records, and
+``GET /debug/trace`` the Chrome trace-event JSON of the recorded spans.
+
+Cross-process tracing (ISSUE 9): an incoming W3C ``traceparent`` header
+is parsed in ``ServiceRouter.handle`` and installed as the thread's
+trace context for the request, so the request span — and every service
+span recorded underneath (refresh, score_batch, ...) — parents to the
+caller's trace. Untraced requests pay one dict lookup.
 
 Stdlib-only.
 """
@@ -38,12 +45,14 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..telemetry import tracing
 from .scoring import ScoringService
 
 _JSON = "application/json"
+_OPENMETRICS = "application/openmetrics-text; version=1.0.0; charset=utf-8"
 _ENDPOINTS = (
-    "/healthz", "/metrics", "/debug/decisions", "/debug/trace",
-    "/v1/score", "/v1/assign", "/v1/refresh",
+    "/healthz", "/metrics", "/debug/decisions", "/debug/lifecycle",
+    "/debug/trace", "/v1/score", "/v1/assign", "/v1/refresh",
 )
 
 
@@ -71,11 +80,21 @@ class ServiceRouter:
     def handle(self, method, target, headers, body):
         path, _, _ = target.partition("?")
         endpoint = path if path in _ENDPOINTS else "other"
+        ctx = tracing.parse_traceparent(headers.get("traceparent"))
         self._m_inflight.inc()
         start = time.perf_counter()
         try:
             try:
-                return self._route(method, target, headers, body)
+                if ctx is None:
+                    return self._route(method, target, headers, body)
+                # traced request: the request span parents to the caller
+                # (the pod's root context) and service spans recorded
+                # inside — refresh, score_batch — parent to the request
+                with self.service.telemetry.spans.span(
+                    "service_request", ctx=ctx, endpoint=endpoint,
+                    method=method,
+                ):
+                    return self._route(method, target, headers, body)
             except Exception:
                 return 500, _JSON, json.dumps(
                     {"error": "internal error"}
@@ -97,6 +116,23 @@ class ServiceRouter:
         accept = (headers.get("accept") or "").lower()
         return "text/plain" in accept or "openmetrics" in accept
 
+    @staticmethod
+    def _parse_limit(query):
+        """Parse ``?n=`` strictly: (ok, limit). Non-integer or negative
+        values are a client error (400), never a 500."""
+        from urllib.parse import parse_qs
+
+        n = parse_qs(query).get("n", [None])[0]
+        if n is None:
+            return True, None
+        try:
+            limit = int(n)
+        except ValueError:
+            return False, None
+        if limit < 0:
+            return False, None
+        return True, limit
+
     def _route(self, method, target, headers, body):
         if method == "GET":
             return self._route_get(target, headers)
@@ -116,6 +152,13 @@ class ServiceRouter:
                 return self._json(code, snap)
             return self._json(200, {"status": "ok"})
         if path == "/metrics":
+            accept = (headers.get("accept") or "").lower()
+            if "openmetrics" in accept:
+                return (
+                    200,
+                    _OPENMETRICS,
+                    service.render_prometheus(openmetrics=True).encode(),
+                )
             if self._wants_exposition(headers):
                 return (
                     200,
@@ -124,18 +167,26 @@ class ServiceRouter:
                 )
             return self._json(200, service.metrics())
         if path == "/debug/decisions":
-            from urllib.parse import parse_qs
-
-            try:
-                n = parse_qs(query).get("n", [None])[0]
-                limit = int(n) if n is not None else None
-            except ValueError:
-                return self._json(400, {"error": "n must be an integer"})
+            ok, limit = self._parse_limit(query)
+            if not ok:
+                return self._json(
+                    400, {"error": "n must be a non-negative integer"}
+                )
             buf = service.telemetry.decisions
             return self._json(
                 200,
                 {"stats": buf.stats(), "decisions": buf.snapshot(limit=limit)},
             )
+        if path == "/debug/lifecycle":
+            ok, limit = self._parse_limit(query)
+            if not ok:
+                return self._json(
+                    400, {"error": "n must be a non-negative integer"}
+                )
+            lc = getattr(service.telemetry, "lifecycle", None)
+            if lc is None:
+                return self._json(200, {"stats": {}, "records": []})
+            return self._json(200, lc.snapshot(limit=limit))
         if path == "/debug/trace":
             return self._json(200, service.telemetry.export_chrome_trace())
         return self._json(404, {"error": "not found"})

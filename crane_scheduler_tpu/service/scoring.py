@@ -691,7 +691,8 @@ class ScoringService:
                 "nodes": len(self.store),
             }
 
-    def render_prometheus(self) -> str:
-        """The registry in Prometheus text exposition format."""
+    def render_prometheus(self, openmetrics: bool = False) -> str:
+        """The registry in Prometheus text exposition format (or the
+        OpenMetrics variant with exemplars when ``openmetrics``)."""
         self._m_nodes.set(len(self.store))
-        return self.telemetry.registry.render()
+        return self.telemetry.registry.render(openmetrics=openmetrics)

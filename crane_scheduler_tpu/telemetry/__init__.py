@@ -25,7 +25,9 @@ import contextlib
 import os
 import threading
 
+from . import tracing
 from .decisions import DecisionTraceBuffer
+from .lifecycle import FlightRecorder, PodLifecycleTracker, slo_report
 from .registry import (
     DEFAULT_LATENCY_BUCKETS,
     Counter,
@@ -41,6 +43,10 @@ __all__ = [
     "MetricsRegistry",
     "SpanRecorder",
     "DecisionTraceBuffer",
+    "PodLifecycleTracker",
+    "FlightRecorder",
+    "slo_report",
+    "tracing",
     "Counter",
     "Gauge",
     "Histogram",
@@ -61,9 +67,12 @@ class Telemetry:
         registry: MetricsRegistry | None = None,
         spans: SpanRecorder | None = None,
         decisions: DecisionTraceBuffer | None = None,
+        lifecycle: PodLifecycleTracker | None = None,
         span_capacity: int = 16384,
         decision_capacity: int = 512,
         decision_sample_every: int = 1,
+        lifecycle_capacity: int = 8192,
+        flight_dir: str | None = None,
     ):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.spans = (
@@ -77,12 +86,69 @@ class Telemetry:
                 sample_every=decision_sample_every,
             )
         )
+        if flight_dir is None:
+            flight_dir = os.environ.get("CRANE_FLIGHT_DIR") or None
+        self.flight = FlightRecorder(flight_dir) if flight_dir else None
+        self.lifecycle = (
+            lifecycle
+            if lifecycle is not None
+            else PodLifecycleTracker(
+                registry=self.registry,
+                spans=self.spans,
+                capacity=lifecycle_capacity,
+                flight=self.flight,
+            )
+        )
+        # incremental flight-drain cursors (flush_flight)
+        self._span_cursor = 0
+        self._decision_cursor = 0
+        self._flush_lock = threading.Lock()
+        if self.flight is not None:
+            # stream spans to disk without explicit wiring: the CLIs
+            # never pump flush_flight themselves, and a crash is exactly
+            # when the tail matters
+            import atexit
 
-    def render_prometheus(self) -> str:
-        return self.registry.render()
+            self._flight_stop = threading.Event()
+            threading.Thread(
+                target=self._flight_pump,
+                name="crane-flight-flush",
+                daemon=True,
+            ).start()
+            atexit.register(self.flush_flight)
+
+    def render_prometheus(self, openmetrics: bool = False) -> str:
+        return self.registry.render(openmetrics=openmetrics)
 
     def export_chrome_trace(self) -> dict:
         return self.spans.export_chrome_trace()
+
+    def flush_flight(self) -> dict:
+        """Drain spans + decision traces recorded since the last call
+        into the flight recorder (lifecycle records stream on completion
+        already). A flight-enabled bundle also pumps this from a daemon
+        thread every second, plus once at interpreter exit. Returns
+        written counts; no-op without a flight dir."""
+        if self.flight is None:
+            return {"spans": 0, "decisions": 0}
+        with self._flush_lock:
+            spans, self._span_cursor = self.spans.drain_since(
+                self._span_cursor
+            )
+            decisions, self._decision_cursor = self.decisions.drain_since(
+                self._decision_cursor
+            )
+            return {
+                "spans": self.flight.write_many("span", spans),
+                "decisions": self.flight.write_many("decision", decisions),
+            }
+
+    def _flight_pump(self) -> None:
+        while not self._flight_stop.wait(1.0):
+            try:
+                self.flush_flight()
+            except Exception:
+                pass
 
 
 _active: Telemetry | None = None

@@ -107,8 +107,17 @@ class DecisionTraceBuffer:
             entry.update(extra)
         with self._lock:
             self.recorded += 1
+            entry["seq"] = self.recorded  # flight-recorder drain cursor
             self._buf.append(entry)
         return True
+
+    def drain_since(self, cursor: int) -> tuple[list[dict], int]:
+        """Entries recorded after ``cursor`` (a seq from a prior call)
+        plus the new cursor — the flight recorder's incremental pull."""
+        with self._lock:
+            new_cursor = self.recorded
+            picked = [e for e in self._buf if e["seq"] > cursor]
+        return picked, new_cursor
 
     def snapshot(self, limit: int | None = None) -> list[dict]:
         """Most recent decisions, oldest first; ``limit`` keeps the

@@ -96,6 +96,51 @@ def _parse_labels(lineno: int, raw: str) -> tuple[tuple[str, str], ...]:
     return tuple(labels)
 
 
+def _label_block_end(lineno: int, raw: str) -> int:
+    """Index of the ``}`` closing the label block ``raw`` starts with,
+    honoring quoted values and escapes — ``rfind`` would grab a brace
+    from an exemplar tail (or a quoted value) further right."""
+    in_quote = False
+    i, n = 1, len(raw)
+    while i < n:
+        ch = raw[i]
+        if in_quote:
+            if ch == "\\":
+                i += 1
+            elif ch == '"':
+                in_quote = False
+        elif ch == '"':
+            in_quote = True
+        elif ch == "}":
+            return i
+        i += 1
+    raise ExpositionError(lineno, "unterminated label block")
+
+
+def _parse_exemplar(
+    lineno: int, raw: str
+) -> tuple[tuple, float, float | None]:
+    """Parse an OpenMetrics exemplar tail ``{labels} value [timestamp]``
+    (the part after `` # ``); returns (labels, value, timestamp)."""
+    raw = raw.strip()
+    if not raw.startswith("{"):
+        raise ExpositionError(lineno, f"exemplar must start with '{{': {raw!r}")
+    end = _label_block_end(lineno, raw)
+    labels = _parse_labels(lineno, raw[1:end])
+    fields = raw[end + 1:].split()
+    if len(fields) not in (1, 2):
+        raise ExpositionError(lineno, f"malformed exemplar tail: {raw!r}")
+    value = _parse_value(lineno, fields[0])
+    if not math.isfinite(value):
+        raise ExpositionError(lineno, f"exemplar value not finite: {value}")
+    ts = None
+    if len(fields) == 2:
+        ts = _parse_value(lineno, fields[1])
+        if not math.isfinite(ts):
+            raise ExpositionError(lineno, "exemplar timestamp not finite")
+    return labels, value, ts
+
+
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
 _SUMMARY_SUFFIXES = ("_sum", "_count")
 
@@ -124,6 +169,7 @@ def parse_exposition(text: str) -> dict:
     types: dict[str, str] = {}
     helps: dict[str, str] = {}
     samples: dict[str, list] = {}
+    exemplars: dict[str, list] = {}
     seen_series: set[tuple] = set()
     families_with_samples: set[str] = set()
 
@@ -158,11 +204,14 @@ def parse_exposition(text: str) -> dict:
         rest = line[len(name):]
         labels: tuple = ()
         if rest.startswith("{"):
-            end = rest.rfind("}")
-            if end < 0:
-                raise ExpositionError(lineno, "unterminated label block")
+            end = _label_block_end(lineno, rest)
             labels = _parse_labels(lineno, rest[1:end])
             rest = rest[end + 1:]
+        exemplar = None
+        if " # " in rest:
+            # OpenMetrics exemplar: `` # {labels} value [timestamp]``
+            rest, _, ex_raw = rest.partition(" # ")
+            exemplar = _parse_exemplar(lineno, ex_raw)
         fields = rest.split()
         if len(fields) not in (1, 2):
             raise ExpositionError(lineno, f"malformed sample tail: {rest!r}")
@@ -183,6 +232,14 @@ def parse_exposition(text: str) -> dict:
             raise ExpositionError(
                 lineno, f"counter {name} has invalid value {value}"
             )
+        if exemplar is not None:
+            if types[family] != "histogram" or not name.endswith("_bucket"):
+                raise ExpositionError(
+                    lineno, f"exemplar on non-bucket sample {name!r}"
+                )
+            exemplars.setdefault(family, []).append(
+                (name, labels) + exemplar
+            )
         samples.setdefault(family, []).append((name, labels, value))
 
     _validate_histograms(types, samples)
@@ -191,6 +248,7 @@ def parse_exposition(text: str) -> dict:
             "type": kind,
             "help": helps.get(fam, ""),
             "samples": samples.get(fam, []),
+            "exemplars": exemplars.get(fam, []),
         }
         for fam, kind in types.items()
     }

@@ -26,6 +26,7 @@ from __future__ import annotations
 import math
 import re
 import threading
+import time
 
 _METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -119,7 +120,7 @@ class GaugeChild(_Child):
 
 
 class HistogramChild(_Child):
-    __slots__ = ("_bounds", "_counts", "_sum", "_total")
+    __slots__ = ("_bounds", "_counts", "_sum", "_total", "_exemplars")
 
     def __init__(self, bounds: tuple[float, ...]):
         super().__init__()
@@ -127,21 +128,36 @@ class HistogramChild(_Child):
         self._counts = [0] * len(bounds)
         self._sum = 0.0
         self._total = 0
+        # OpenMetrics exemplars: bucket index -> (labels, value, unix ts);
+        # index len(bounds) is the +Inf bucket. Lazy — the common
+        # observe() path never allocates it.
+        self._exemplars: dict | None = None
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: dict | None = None) -> None:
         with self._lock:
             self._sum += value
             self._total += 1
+            idx = len(self._bounds)
             # linear scan beats bisect below ~30 bounds (no call overhead)
             for i, b in enumerate(self._bounds):
                 if value <= b:
                     self._counts[i] += 1
+                    idx = i
                     break
+            if exemplar is not None:
+                if self._exemplars is None:
+                    self._exemplars = {}
+                self._exemplars[idx] = (dict(exemplar), value, time.time())
 
     def snapshot(self) -> tuple[list[int], float, int]:
         """(per-bucket counts, sum, total count) — non-cumulative."""
         with self._lock:
             return list(self._counts), self._sum, self._total
+
+    def snapshot_exemplars(self) -> dict:
+        """Latest exemplar per bucket index (may be empty)."""
+        with self._lock:
+            return dict(self._exemplars) if self._exemplars else {}
 
 
 class _Family:
@@ -211,7 +227,7 @@ class Counter(_Family):
     def value(self) -> float:
         return self._default().value
 
-    def render_into(self, out: list[str]) -> None:
+    def render_into(self, out: list[str], openmetrics: bool = False) -> None:
         for values, child in self.children():
             out.append(
                 f"{self.name}{self._label_str(values)} "
@@ -254,27 +270,45 @@ class Histogram(_Family):
     def _new_child(self):
         return HistogramChild(self.buckets)
 
-    def observe(self, value: float) -> None:
-        self._default().observe(value)
+    def observe(self, value: float, exemplar: dict | None = None) -> None:
+        self._default().observe(value, exemplar=exemplar)
 
     def time(self):
         """Context manager observing the block's wall seconds."""
         return _HistogramTimer(self._default())
 
-    def render_into(self, out: list[str]) -> None:
+    @staticmethod
+    def _exemplar_str(ex) -> str:
+        """OpenMetrics exemplar tail: `` # {labels} value timestamp``."""
+        labels, value, ts = ex
+        inner = ",".join(
+            f'{k}="{_escape_label_value(str(v))}"'
+            for k, v in sorted(labels.items())
+        )
+        return f" # {{{inner}}} {format_value(value)} {ts:.3f}"
+
+    def render_into(self, out: list[str], openmetrics: bool = False) -> None:
         for values, child in self.children():
             counts, total_sum, total = child.snapshot()
+            exemplars = child.snapshot_exemplars() if openmetrics else {}
             running = 0
-            for bound, c in zip(self.buckets, counts):
+            for i, (bound, c) in enumerate(zip(self.buckets, counts)):
                 running += c
                 le = f'le="{format_value(bound)}"'
+                tail = ""
+                if i in exemplars:
+                    tail = self._exemplar_str(exemplars[i])
                 out.append(
-                    f"{self.name}_bucket{self._label_str(values, le)} {running}"
+                    f"{self.name}_bucket{self._label_str(values, le)} "
+                    f"{running}{tail}"
                 )
             inf_label = 'le="+Inf"'
+            inf_tail = ""
+            if len(self.buckets) in exemplars:
+                inf_tail = self._exemplar_str(exemplars[len(self.buckets)])
             out.append(
                 f"{self.name}_bucket{self._label_str(values, inf_label)} "
-                f"{total}"
+                f"{total}{inf_tail}"
             )
             out.append(
                 f"{self.name}_sum{self._label_str(values)} "
@@ -356,14 +390,18 @@ class MetricsRegistry:
         with self._lock:
             return [self._families[k] for k in sorted(self._families)]
 
-    def render(self) -> str:
-        """Prometheus text exposition (version 0.0.4)."""
+    def render(self, openmetrics: bool = False) -> str:
+        """Prometheus text exposition (version 0.0.4), or the OpenMetrics
+        variant (``openmetrics=True``): histogram buckets carry their
+        latest exemplar and the payload ends with ``# EOF``."""
         out: list[str] = []
         for fam in self.families():
             if fam.help:
                 out.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
             out.append(f"# TYPE {fam.name} {fam.kind}")
-            fam.render_into(out)
+            fam.render_into(out, openmetrics=openmetrics)
+        if openmetrics:
+            out.append("# EOF")
         return "\n".join(out) + "\n" if out else ""
 
     def snapshot(self) -> dict:

@@ -44,6 +44,8 @@ class KubeStubState:
         self.events: list[dict] = []
         self.watchers: list[tuple[str, queue.Queue]] = []  # (kind, q)
         self.requests: list[tuple[str, str]] = []  # (method, path) log
+        # W3C trace headers observed on writes: (method, path, traceparent)
+        self.trace_headers: list[tuple[str, str, str]] = []
         self.connections = 0  # TCP accepts (keep-alive reuse visible here)
         self.open_sockets: list = []  # live connections (severed on stop)
         self._rv = 0  # global resourceVersion counter (like etcd's)
@@ -818,6 +820,9 @@ def _make_handler(state: KubeStubState):
 
         def do_POST(self):
             state.requests.append(("POST", self.path))
+            tp = self.headers.get("traceparent")
+            if tp:
+                state.trace_headers.append(("POST", self.path, tp))
             body = self._read_body()
             parts = self.path.strip("/").split("/")
             code, payload = 404, {"message": "bad post path"}

@@ -404,3 +404,53 @@ def test_service_telemetry_families_exposed(server):
         "crane_service_response_cache_hits_total",
     ):
         assert family in text, family
+
+
+# --- debug endpoints: strict ?n= parsing ------------------------------------
+
+
+def test_debug_endpoints_reject_malformed_n(server):
+    """A bad ``?n=`` is a client error (400 with a reason), never a 500 —
+    the old ``int(query)`` path let a typo crash into the generic
+    internal-error handler."""
+    sim, svc, srv = server
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+    try:
+        for path in ("/debug/decisions", "/debug/lifecycle"):
+            for bad in ("abc", "-1", "1.5", "%20"):
+                conn.request("GET", f"{path}?n={bad}")
+                resp = conn.getresponse()
+                body = json.loads(resp.read())
+                assert resp.status == 400, (path, bad, resp.status)
+                assert body == {"error": "n must be a non-negative integer"}
+            # valid and absent limits still serve
+            for target in (f"{path}?n=3", f"{path}?n=0", path):
+                conn.request("GET", target)
+                resp = conn.getresponse()
+                payload = json.loads(resp.read())
+                assert resp.status == 200, target
+                assert "stats" in payload
+    finally:
+        conn.close()
+
+
+def test_debug_lifecycle_snapshot_shape(server):
+    sim, svc, srv = server
+    lc = svc.telemetry.lifecycle
+    lc.seen("smoke/pod-a")
+    lc.stage("smoke/pod-a", "scored", node="n0")
+    lc.posted("smoke/pod-a", node="n0")
+    lc.confirmed("smoke/pod-a")
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+    try:
+        conn.request("GET", "/debug/lifecycle?n=5")
+        resp = conn.getresponse()
+        payload = json.loads(resp.read())
+    finally:
+        conn.close()
+    assert resp.status == 200
+    assert payload["stats"]["confirmed_total"] == 1
+    (rec,) = [
+        r for r in payload["records"] if r.get("pod") == "smoke/pod-a"
+    ]
+    assert rec["done"] and rec["node"] == "n0"

@@ -1,0 +1,567 @@
+"""End-to-end placement tracing tests (ISSUE 9, doc/observability.md).
+
+The contract under test: a trace ID minted at pod first-seen rides the
+W3C ``traceparent`` header across every hop — annotator sync, scheduler
+refresh/score, bind POST, watch confirm, scoring-service request — and
+the lifecycle state machine stitches them into one bounded, crash-safe
+record that ``tools/crane_trace.py`` can replay. Specifically:
+
+- strict W3C traceparent parse/format round-trips; malformed headers
+  never raise;
+- both HTTP front ends (async and threaded) parse the header and parent
+  the ``service_request`` span to the caller's context;
+- the span export carries Perfetto flow events chaining a trace across
+  tracks, survives (ts, dur) ties between spans with dict args, and
+  dumps atomically;
+- the lifecycle state machine finalizes on {bind_post, watch_confirm}
+  in EITHER order (watch events outrun POST acks on a busy apiserver),
+  clamps out-of-order deltas to zero, stays bounded under 50k pods, and
+  continues an evicted pod's trace into its re-placement attempt;
+- the OpenMetrics exposition carries a trace-ID exemplar on the e2e
+  histogram and strict-parses;
+- the flight recorder rotates segments, drops the oldest, and skips a
+  torn tail;
+- one trace observably spans four processes over a live stub apiserver.
+"""
+
+import http.client
+import importlib.util
+import json
+import os
+import socket
+import time
+
+import pytest
+
+from crane_scheduler_tpu.policy import DEFAULT_POLICY
+from crane_scheduler_tpu.telemetry import Telemetry, tracing
+from crane_scheduler_tpu.telemetry.expfmt import parse_exposition
+from crane_scheduler_tpu.telemetry.lifecycle import (
+    FlightRecorder,
+    PodLifecycleTracker,
+    stage_durations,
+)
+from crane_scheduler_tpu.telemetry.spans import SpanRecorder
+
+_STUB = os.path.join(os.path.dirname(__file__), "kube_stub.py")
+_TOOLS = os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+
+
+def _load_module(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _wait_until(predicate, timeout=15.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# --- traceparent parse/format ----------------------------------------------
+
+
+def test_traceparent_roundtrip_and_strictness():
+    ctx = tracing.new_context()
+    header = tracing.format_traceparent(ctx)
+    assert len(header) == 55
+    parsed = tracing.parse_traceparent(header)
+    assert parsed == ctx
+
+    trace, span = "ab" * 16, "cd" * 8
+    ok = tracing.parse_traceparent(f"00-{trace}-{span}-01")
+    assert ok is not None and ok.trace_id == trace and ok.span_id == span
+
+    bad = [
+        None,
+        "",
+        "garbage",
+        f"00-{trace}-{span}",  # missing flags
+        f"00-{'0' * 32}-{span}-01",  # all-zero trace id
+        f"00-{trace}-{'0' * 16}-01",  # all-zero span id
+        f"00-{trace[:-1]}-{span}-01",  # short trace id
+        f"00-{trace}-{span}-1",  # short flags
+        f"ff-{trace}-{span}-01",  # forbidden version
+        f"00-{trace}-{span}-01-extra",  # version 00 forbids extra fields
+        f"00-{trace.upper()}-{span}-01",  # uppercase hex is invalid
+    ]
+    for value in bad:
+        assert tracing.parse_traceparent(value) is None, value
+    # future versions may carry extra fields (spec 4.3)
+    assert tracing.parse_traceparent(f"01-{trace}-{span}-01-extra") is not None
+
+
+def test_use_none_is_passthrough_and_nesting_restores():
+    assert tracing.current() is None
+    with tracing.use(None):
+        assert tracing.current() is None
+    outer = tracing.new_context()
+    with tracing.use(outer):
+        assert tracing.current() is outer
+        inner = outer.child()
+        with tracing.use(inner):
+            assert tracing.current() is inner
+        assert tracing.current() is outer
+    assert tracing.current() is None
+
+
+# --- span recorder: parenting, flow export, sort tie, atomic dump ----------
+
+
+def test_spans_parent_to_active_context():
+    rec = SpanRecorder()
+    ctx = tracing.new_context()
+    with tracing.use(ctx):
+        with rec.span("outer", track="t1"):
+            with rec.span("inner", track="t1"):
+                pass
+    spans, _ = rec.drain_since(0)
+    by_name = {s["name"]: s for s in spans}
+    outer, inner = by_name["outer"], by_name["inner"]
+    assert outer["trace_id"] == inner["trace_id"] == ctx.trace_id
+    assert outer["parent_id"] == ctx.span_id
+    assert inner["parent_id"] == outer["span_id"]
+
+
+def test_flow_events_chain_a_trace_across_tracks():
+    rec = SpanRecorder(clock=iter(range(100)).__next__)
+    ctx = tracing.new_context()
+    with tracing.use(ctx):
+        with rec.span("hop-a", track="annotator"):
+            pass
+        with rec.span("hop-b", track="scheduler"):
+            pass
+        with rec.span("hop-c", track="kube-writer"):
+            pass
+    rec.record("untraced", 50, 51, track="scheduler")
+    trace = rec.export_chrome_trace()
+    events = trace["traceEvents"]
+
+    x = [e for e in events if e["ph"] == "X"]
+    traced = [e for e in x if (e.get("args") or {}).get("trace_id")]
+    assert len(traced) == 3
+    assert all(e["args"]["trace_id"] == ctx.trace_id for e in traced)
+    # the untraced span carries no trace fields at all
+    untraced = [e for e in x if e["name"] == "untraced"]
+    assert "args" not in untraced[0]
+
+    flows = [e for e in events if e["ph"] in ("s", "t", "f")]
+    assert [e["ph"] for e in sorted(flows, key=lambda e: e["ts"])] == [
+        "s", "t", "f",
+    ]
+    assert len({e["id"] for e in flows}) == 1  # one flow per trace
+    assert all(e["ph"] != "f" or e.get("bp") == "e" for e in flows)
+    # a single-span trace has no flow (needs two ends)
+    solo = SpanRecorder()
+    with tracing.use(tracing.new_context()):
+        with solo.span("only"):
+            pass
+    assert not [
+        e for e in solo.export_chrome_trace()["traceEvents"]
+        if e["ph"] in ("s", "t", "f")
+    ]
+
+
+def test_export_survives_timestamp_ties_with_dict_args():
+    # regression: sorted(self._buf) with no key fell through tied
+    # (ts, dur, name, track) prefixes into comparing args dicts ->
+    # TypeError: '<' not supported between instances of 'dict'
+    rec = SpanRecorder()
+    rec.record("same", 1.0, 2.0, track="t", args={"x": 1})
+    rec.record("same", 1.0, 2.0, track="t", args={"y": 2})
+    trace = rec.export_chrome_trace()
+    assert sum(1 for e in trace["traceEvents"] if e["ph"] == "X") == 2
+
+
+def test_dump_is_atomic(tmp_path):
+    rec = SpanRecorder()
+    rec.record("a", 0.0, 1.0, track="t")
+    path = tmp_path / "spans.json"
+    assert rec.dump(str(path)) == 1
+    with open(path) as f:
+        trace = json.load(f)
+    assert any(e["ph"] == "X" for e in trace["traceEvents"])
+    leftovers = [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+    assert not leftovers
+
+
+# --- lifecycle state machine ------------------------------------------------
+
+
+def _complete(lc, key, node="n0"):
+    lc.seen(key)
+    lc.stage(key, "filtered")
+    lc.stage(key, "scored", node=node)
+    lc.posted(key, node=node)
+    lc.confirmed(key)
+
+
+def test_lifecycle_confirm_before_post_ack():
+    # the stub (and a busy apiserver) can deliver the confirming watch
+    # event before the writer thread marks the POST done
+    lc = PodLifecycleTracker()
+    lc.seen("ns/p", source="drip")
+    lc.stage("ns/p", "filtered")
+    lc.stage("ns/p", "scored", node="n1")
+    lc.confirmed("ns/p")
+    assert lc.live_count() == 1  # not finalized: bind_post still missing
+    assert not lc.records()
+    lc.posted("ns/p", node="n1")
+    assert lc.live_count() == 0
+    (rec,) = lc.records()
+    assert rec["done"] and not rec["evicted"]
+    assert rec["node"] == "n1"
+    assert "bind_post" in rec["stages"] and "watch_confirm" in rec["stages"]
+    durs = stage_durations(rec)
+    assert all(v >= 0.0 for v in durs.values())  # out-of-order deltas clamp
+    assert "e2e" in durs
+    assert lc.confirmed_total == 1
+
+
+def test_lifecycle_stage_marks_idempotent():
+    lc = PodLifecycleTracker()
+    lc.seen("ns/p")
+    lc.stage("ns/p", "scored", node="a")
+    first = lc._live["ns/p"]["stages"]["scored"]
+    time.sleep(0.002)
+    lc.stage("ns/p", "scored", node="a")
+    assert lc._live["ns/p"]["stages"]["scored"] == first
+    # untracked keys are a cheap no-op, not an implicit record
+    assert lc.stage("ns/other", "scored") is False
+    assert lc.live_count() == 1
+
+
+def test_lifecycle_bounded_under_50k_pods():
+    lc = PodLifecycleTracker(
+        capacity=512, completed_capacity=128, batch_sample=100
+    )
+    total = 50_000
+    for i in range(0, total, 100):
+        lc.seen_batch([f"ns/p{j}" for j in range(i, i + 100)])
+    stats = lc.stats()
+    assert stats["live"] <= 512
+    assert stats["completed"] <= 128
+    assert stats["tracked_total"] == total
+    assert stats["dropped_total"] == total - 512
+    # batch sampling: a huge dispatch tracks only the prefix sample
+    lc2 = PodLifecycleTracker(batch_sample=64)
+    tracked = lc2.seen_batch([f"ns/q{i}" for i in range(10_000)])
+    assert len(tracked) == 64
+    assert lc2.live_count() == 64
+
+
+def test_evicted_pod_keeps_trace_across_replacement():
+    lc = PodLifecycleTracker()
+    ctx1 = lc.seen("ns/p")
+    _complete(lc, "ns/p", node="hot")
+    lc.evicted("ns/p", reason="hotspot")
+    evict_rec = lc.records()[-1]
+    assert evict_rec["evicted"] and evict_rec["evict_reason"] == "hotspot"
+    ctx2 = lc.seen("ns/p")
+    assert ctx2.trace_id == ctx1.trace_id  # the trace continues
+    _complete(lc, "ns/p", node="cool")
+    rec2 = lc.records()[-1]
+    assert rec2["trace_id"] == ctx1.trace_id
+    assert rec2["attempt"] == 2
+    assert not rec2["evicted"]
+    assert lc.evicted_total == 1
+
+
+def test_traceparent_for_live_records_only():
+    lc = PodLifecycleTracker()
+    lc.seen("ns/p")
+    header = lc.traceparent("ns/p")
+    assert tracing.parse_traceparent(header) is not None
+    batch = lc.traceparent_batch(["ns/p", "ns/missing"])
+    assert set(batch) == {"ns/p"} and batch["ns/p"] == header
+    _complete(lc, "ns/p")
+    assert lc.traceparent("ns/p") is None  # finalized records drop out
+
+
+# --- exemplar exposition ----------------------------------------------------
+
+
+def test_e2e_exemplar_strict_parses_in_openmetrics():
+    tel = Telemetry()
+    _complete(tel.lifecycle, "ns/p")
+    rec = tel.lifecycle.records()[-1]
+
+    text = tel.render_prometheus(openmetrics=True)
+    assert text.rstrip().endswith("# EOF")
+    families = parse_exposition(text)
+    exemplars = families["crane_placement_e2e_seconds"]["exemplars"]
+    assert any(
+        dict(e[2]).get("trace_id") == rec["trace_id"] for e in exemplars
+    )
+    stage = families["crane_placement_stage_seconds"]
+    stages = {
+        dict(labels).get("stage")
+        for name, labels, _ in stage["samples"]
+        if name.endswith("_bucket")
+    }
+    assert {"filtered", "scored", "bind_post", "watch_confirm"} <= stages
+    # the legacy 0.0.4 exposition must stay exemplar-free
+    legacy = tel.render_prometheus()
+    assert "# {" not in legacy
+    parse_exposition(legacy)
+
+
+# --- flight recorder --------------------------------------------------------
+
+
+def test_flight_recorder_rotates_and_skips_torn_tail(tmp_path):
+    d = str(tmp_path)
+    fr = FlightRecorder(d, max_segment_bytes=256, max_segments=2)
+    for i in range(64):
+        fr.write("lifecycle", {"pod": f"ns/p{i}", "pad": "x" * 32})
+    fr.close()
+    segments = sorted(n for n in os.listdir(d) if n.startswith("flight-"))
+    assert len(segments) <= 2  # oldest segments deleted
+
+    # a crash can tear the tail mid-line; the reader skips it
+    with open(os.path.join(d, segments[-1]), "a") as f:
+        f.write('{"kind": "lifecycle", "pod": "ns/tor')
+    records = list(FlightRecorder.read(d))
+    assert records
+    assert all(r.get("kind") == "lifecycle" for r in records)
+    assert not any(r.get("pod") == "ns/tor" for r in records)
+    # the newest writes survived rotation
+    assert any(r.get("pod") == "ns/p63" for r in records)
+
+
+def test_flight_recorder_resumes_existing_segment(tmp_path):
+    d = str(tmp_path)
+    fr = FlightRecorder(d)
+    fr.write("span", {"name": "a"})
+    fr.close()
+    fr2 = FlightRecorder(d)  # append, never truncate
+    fr2.write("span", {"name": "b"})
+    fr2.close()
+    names = [r["name"] for r in FlightRecorder.read(d)]
+    assert names == ["a", "b"]
+
+
+# --- traceparent over both HTTP front ends ----------------------------------
+
+
+def _make_service():
+    from crane_scheduler_tpu.service import ScoringService
+    from crane_scheduler_tpu.sim import SimConfig, Simulator
+
+    sim = Simulator(SimConfig(n_nodes=3, seed=19))
+    sim.sync_metrics()
+    svc = ScoringService(sim.cluster, DEFAULT_POLICY)
+    svc.refresh()
+    return sim, svc
+
+
+@pytest.mark.parametrize("frontend", ["async", "threaded"])
+def test_traceparent_roundtrip_over_http_frontend(frontend):
+    from crane_scheduler_tpu.service import ScoringHTTPServer
+
+    sim, svc = _make_service()
+    kwargs = {} if frontend == "async" else {"frontend": frontend}
+    srv = ScoringHTTPServer(svc, port=0, **kwargs)
+    srv.start()
+    trace_id, span_id = "ab" * 16, "cd" * 8
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        body = json.dumps({"now": sim.clock.now(), "refresh": False})
+        conn.request(
+            "POST", "/v1/score", body=body,
+            headers={
+                "Content-Type": "application/json",
+                "traceparent": f"00-{trace_id}-{span_id}-01",
+            },
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert json.loads(resp.read())["backend"] == "tpu"
+        # malformed header: still served, just untraced
+        conn.request(
+            "POST", "/v1/score", body=body,
+            headers={
+                "Content-Type": "application/json",
+                "traceparent": "00-bogus-01",
+            },
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        resp.read()
+        conn.close()
+    finally:
+        srv.stop()
+
+    spans, _ = svc.telemetry.spans.drain_since(0)
+    reqs = [s for s in spans if s["name"] == "service_request"]
+    traced = [s for s in reqs if s.get("trace_id") == trace_id]
+    assert len(traced) == 1  # the malformed request recorded no trace
+    req = traced[0]
+    assert req["parent_id"] == span_id  # parented to the caller's span
+    assert req["span_id"] and req["span_id"] != span_id
+    assert req["args"]["endpoint"] == "/v1/score"
+
+
+# --- four processes, one trace ---------------------------------------------
+
+
+def test_single_trace_spans_four_processes(tmp_path):
+    """One placement over a live stub apiserver, each pipeline role on
+    its OWN telemetry bundle (as in the real four-binary deployment),
+    all writing one shared flight dir: annotator sync -> scheduler
+    refresh/score -> bind POST (traceparent on the wire) -> watch
+    confirm, plus a scoring-service request carrying the pod's
+    traceparent — stitched back into ONE parented trace by crane_trace.
+    """
+    from crane_scheduler_tpu.annotator import AnnotatorConfig, NodeAnnotator
+    from crane_scheduler_tpu.cluster.kube import KubeClusterClient
+    from crane_scheduler_tpu.framework.scheduler import BatchScheduler
+    from crane_scheduler_tpu.metrics import FakeMetricsSource
+    from crane_scheduler_tpu.service import ScoringHTTPServer, ScoringService
+    from crane_scheduler_tpu.sim import SimConfig, Simulator
+
+    kube_stub = _load_module("kube_stub", _STUB)
+    crane_trace = _load_module(
+        "crane_trace", os.path.join(_TOOLS, "crane_trace.py")
+    )
+
+    flight_dir = str(tmp_path / "flight")
+    pod_key = "default/e2e-1"
+    stub = kube_stub.KubeStubServer().start()
+    clients = []
+    try:
+        stub.state.add_node("node-hot", "10.0.0.1")
+        stub.state.add_node("node-cool", "10.0.0.2")
+
+        # process 1: annotator — its sync span stamps the shared
+        # annotation timestamp every patched row carries
+        tel_ann = Telemetry(flight_dir=flight_dir)
+        client_ann = KubeClusterClient(stub.url, telemetry=tel_ann)
+        client_ann.start()
+        clients.append(client_ann)
+        fake = FakeMetricsSource()
+        for metric in {sp.name for sp in DEFAULT_POLICY.spec.sync_period}:
+            fake.set(metric, "10.0.0.1", 0.9, by="ip")
+            fake.set(metric, "10.0.0.2", 0.1, by="ip")
+        ann = NodeAnnotator(
+            client_ann, fake, DEFAULT_POLICY, AnnotatorConfig(),
+            telemetry=tel_ann,
+        )
+        ann.sync_all_once_bulk(time.time())
+
+        # process 2: batch scheduler + kube write path (separate bundle,
+        # separate mirror — refresh() ingests the patched annotations)
+        tel_sched = Telemetry(flight_dir=flight_dir)
+        client = KubeClusterClient(stub.url, telemetry=tel_sched)
+        client.start()
+        clients.append(client)
+        assert _wait_until(
+            lambda: any(
+                "," in v
+                for n in client.list_nodes()
+                for v in n.annotations.values()
+            )
+        )
+        sched = BatchScheduler(client, DEFAULT_POLICY, telemetry=tel_sched)
+        stub.state.add_pod("default", "e2e-1")
+        assert _wait_until(lambda: client.get_pod(pod_key) is not None)
+
+        result = sched.schedule_batch([client.get_pod(pod_key)], bind=True)
+        assert result.assignments.get(pod_key)
+
+        # the stub's watch event confirms and finalizes the record
+        assert _wait_until(
+            lambda: any(
+                r.get("pod") == pod_key for r in tel_sched.lifecycle.records()
+            )
+        )
+        rec = [
+            r for r in tel_sched.lifecycle.records() if r.get("pod") == pod_key
+        ][-1]
+        for stage in ("seen", "scored", "bind_post", "watch_confirm"):
+            assert stage in rec["stages"], rec["stages"]
+        assert rec["cycle_trace"]  # joins the scoring cycle's spans
+        assert rec["anno_ts"] is not None  # joins the annotator sync
+
+        # wire-level propagation: the binding POST carried the header
+        tps = [
+            tp for method, path, tp in stub.state.trace_headers
+            if path.endswith("/pods/e2e-1/binding")
+        ]
+        assert tps and any(tp and rec["trace_id"] in tp for tp in tps)
+
+        # process 3: scoring service queried under the pod's traceparent
+        tel_svc = Telemetry(flight_dir=flight_dir)
+        sim = Simulator(SimConfig(n_nodes=3, seed=21))
+        sim.sync_metrics()
+        svc = ScoringService(sim.cluster, DEFAULT_POLICY, telemetry=tel_svc)
+        svc.refresh()
+        srv = ScoringHTTPServer(svc, port=0)
+        srv.start()
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", srv.port, timeout=10
+            )
+            conn.request(
+                "POST", "/v1/score",
+                body=json.dumps({"now": sim.clock.now(), "refresh": False}),
+                headers={
+                    "Content-Type": "application/json",
+                    "traceparent": (
+                        f"00-{rec['trace_id']}-{rec['root_span']}-01"
+                    ),
+                },
+            )
+            assert conn.getresponse().status == 200
+            conn.close()
+        finally:
+            srv.stop()
+
+        # every bundle drains its spans into the shared flight ring
+        for tel in (tel_ann, tel_sched, tel_svc):
+            tel.flush_flight()
+    finally:
+        for c in clients:
+            c.stop()
+        stub.stop()
+
+    # replay the flight dir: the hops stitch into one parented trace
+    flight = crane_trace.load_flight(flight_dir)
+    rec = crane_trace.find_record(flight["lifecycle"], pod_key)
+    assert rec is not None
+    joined = crane_trace.stitch(rec, flight["span"], flight["decision"])
+    names = {s["name"] for s in joined["pod_spans"]}
+    assert "service_request" in names  # scoring-service hop
+    assert {"lifecycle:bind_post", "lifecycle:watch_confirm"} <= names
+    assert joined["cycle_spans"]  # scheduler refresh/score hop
+    assert joined["annotator_spans"]  # annotator sync hop (anno_ts join)
+    assert all(
+        s["trace_id"] == rec["cycle_trace"] for s in joined["cycle_spans"]
+    )
+
+    trace = crane_trace.stitched_trace(rec, flight["span"], flight["decision"])
+    events = trace["traceEvents"]
+    assert events and trace["otherData"]["trace_id"] == rec["trace_id"]
+    for e in events:
+        assert e["args"]["trace_id"] == rec["trace_id"]
+        if e["args"].get("span_id") != rec["root_span"]:
+            assert e["args"].get("parent_id")  # everything hangs off the root
+
+    lines = crane_trace.explain_lines(joined)
+    text = "\n".join(lines)
+    assert pod_key in text and rec["trace_id"] in text
+    assert crane_trace.main(
+        ["--flight-dir", flight_dir, "explain", pod_key]
+    ) == 0
+    assert crane_trace.main(
+        ["--flight-dir", flight_dir, "slo", "--target", "60",
+         "--max-burn-rate", "1.0"]
+    ) == 0
+    assert crane_trace.main(
+        ["--flight-dir", flight_dir, "explain", "default/absent"]
+    ) == 2

@@ -1,0 +1,263 @@
+"""crane-trace: explain placements and check SLOs from the flight recorder.
+
+The flight recorder (``CRANE_FLIGHT_DIR`` / ``--flight-dir``) is a
+crash-safe JSONL ring of lifecycle records, spans, and decision traces
+written by any crane process. This tool replays it:
+
+- ``explain <pod>`` — reconstruct the pod's full placement timeline:
+  every lifecycle stage with deltas, the scoring cycle that placed it,
+  the annotator sync that fed the scores (joined by the annotation
+  timestamp the sweep stamped), its decision trace (score vector), and
+  every span carrying its trace ID. Exit 0 when the pod is found, 2
+  when not.
+- ``slo [--target S]`` — p50/p99 per stage and e2e compliance / burn
+  rate against a latency target, computed from raw records (the
+  cross-check for the ``crane_placement_*`` histograms).
+
+Pure stdlib; importable as a library (``load_flight`` / ``stitch`` /
+``explain_lines``) — the e2e tests drive the same code paths.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from crane_scheduler_tpu.telemetry.lifecycle import (  # noqa: E402
+    STAGES,
+    FlightRecorder,
+    slo_report,
+    stage_durations,
+)
+
+
+def load_flight(directory: str) -> dict:
+    """Partition a flight directory's records by kind."""
+    out: dict[str, list] = {"lifecycle": [], "span": [], "decision": []}
+    for obj in FlightRecorder.read(directory):
+        out.setdefault(obj.get("kind", "unknown"), []).append(obj)
+    return out
+
+
+def find_record(lifecycle: list[dict], pod: str) -> dict | None:
+    """The newest completed lifecycle record for ``pod`` (a re-placed
+    pod has one record per attempt; the last one wins)."""
+    match = None
+    for rec in lifecycle:
+        if rec.get("pod") == pod:
+            match = rec
+    return match
+
+
+def stitch(rec: dict, spans: list[dict], decisions: list[dict]) -> dict:
+    """Join everything observable about one placement:
+
+    - spans whose ``trace_id`` is the pod's trace (lifecycle stage spans,
+      service requests carrying its traceparent, kube write spans);
+    - spans of the scoring cycle that placed it (``rec["cycle_trace"]``);
+    - annotator sync spans stamped with the annotation timestamp the
+      cycle's scores carried (``rec["anno_ts"]`` — the sweep writes ONE
+      wire-truncated ts on every row, so equality is exact);
+    - the pod's decision-trace entries (score vector, reason).
+    """
+    trace_id = rec.get("trace_id")
+    cycle = rec.get("cycle_trace")
+    anno_ts = rec.get("anno_ts")
+    pod_spans, cycle_spans, anno_spans = [], [], []
+    for s in spans:
+        tid = s.get("trace_id")
+        if tid is not None and tid == trace_id:
+            pod_spans.append(s)
+        elif cycle is not None and tid == cycle:
+            cycle_spans.append(s)
+        if (
+            s.get("name") == "annotator_sync"
+            and anno_ts is not None
+            and (s.get("args") or {}).get("anno_ts") == anno_ts
+        ):
+            anno_spans.append(s)
+    pod_decisions = [d for d in decisions if d.get("pod") == rec.get("pod")]
+    return {
+        "record": rec,
+        "pod_spans": pod_spans,
+        "cycle_spans": cycle_spans,
+        "annotator_spans": anno_spans,
+        "decisions": pod_decisions,
+    }
+
+
+def stitched_trace(rec: dict, spans: list[dict], decisions=()) -> dict:
+    """One exported Chrome-trace dict for the placement: every joined
+    span re-rooted under the pod's trace (cycle/annotator spans keep
+    their own span IDs but parent to the pod's root span), so Perfetto
+    shows the cross-process hops as ONE parented trace."""
+    joined = stitch(rec, list(spans), list(decisions))
+    trace_id = rec.get("trace_id")
+    root = rec.get("root_span")
+    events = []
+    for group, reparent in (
+        ("pod_spans", False),
+        ("cycle_spans", True),
+        ("annotator_spans", True),
+    ):
+        for s in joined[group]:
+            args = dict(s.get("args") or {})
+            args["trace_id"] = trace_id
+            if s.get("span_id"):
+                args["span_id"] = s["span_id"]
+            parent = s.get("parent_id")
+            if reparent or (s.get("trace_id") == trace_id and parent is None
+                            and s.get("span_id") != root):
+                parent = root
+            if parent and s.get("span_id") != root:
+                args["parent_id"] = parent
+            events.append({
+                "name": s["name"],
+                "ph": "X",
+                "ts": s["ts_us"],
+                "dur": s["dur_us"],
+                "pid": 0,
+                "tid": 0,
+                "cat": s.get("track") or "span",
+                "args": args,
+            })
+    events.sort(key=lambda e: (e["ts"], e["dur"]))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"trace_id": trace_id, "pod": rec.get("pod")},
+    }
+
+
+def _fmt_s(v: float) -> str:
+    if v >= 1.0:
+        return f"{v:.3f}s"
+    if v >= 1e-3:
+        return f"{v * 1e3:.2f}ms"
+    return f"{v * 1e6:.0f}us"
+
+
+def explain_lines(joined: dict) -> list[str]:
+    """Human-readable timeline for one stitched placement."""
+    rec = joined["record"]
+    lines = [
+        f"pod {rec.get('pod')}  attempt {rec.get('attempt')}  "
+        f"trace {rec.get('trace_id')}",
+        f"  source={rec.get('source')}  node={rec.get('node')}  "
+        f"evicted={bool(rec.get('evicted'))}",
+    ]
+    durs = stage_durations(rec)
+    stages = rec.get("stages") or {}
+    lines.append("  timeline:")
+    order = [s for s in STAGES if s in stages]
+    for extra in sorted(set(stages) - set(STAGES)):
+        order.append(extra)
+    for s in order:
+        delta = durs.get(s)
+        suffix = f"  (+{_fmt_s(delta)})" if delta is not None else ""
+        lines.append(f"    {s:<14} @ {stages[s]:.6f}{suffix}")
+    if "e2e" in durs:
+        lines.append(f"  e2e: {_fmt_s(durs['e2e'])} (first-seen -> confirmed)")
+    if rec.get("evict_reason"):
+        lines.append(f"  evict reason: {rec['evict_reason']}")
+    if rec.get("cycle_trace"):
+        lines.append(
+            f"  scoring cycle trace: {rec['cycle_trace']} "
+            f"({len(joined['cycle_spans'])} spans)"
+        )
+    if rec.get("anno_ts") is not None:
+        n = len(joined["annotator_spans"])
+        lines.append(
+            f"  annotations stamped at {rec['anno_ts']:.0f} "
+            f"({n} annotator sync span{'s' if n != 1 else ''} joined)"
+        )
+    for d in joined["decisions"][-3:]:
+        top = ", ".join(f"{n}={s}" for n, s in d.get("top_scores", [])[:5])
+        lines.append(
+            f"  decision [{d.get('source')}] reason={d.get('reason')} "
+            f"feasible={d.get('feasible')} staleness="
+            f"{d.get('staleness_seconds')}s"
+        )
+        if top:
+            lines.append(f"    top scores: {top}")
+    if joined["pod_spans"]:
+        lines.append(f"  spans on this trace ({len(joined['pod_spans'])}):")
+        for s in sorted(joined["pod_spans"],
+                        key=lambda s: (s.get("ts_us", 0.0), s.get("dur_us", 0.0))):
+            parent = s.get("parent_id")
+            tag = f" parent={parent}" if parent else " (root child)"
+            lines.append(
+                f"    {s['name']:<24} {_fmt_s(s.get('dur_us', 0.0) / 1e6)}"
+                f" [{s.get('track') or 'span'}]{tag}"
+            )
+    return lines
+
+
+def cmd_explain(args) -> int:
+    flight = load_flight(args.flight_dir)
+    rec = find_record(flight["lifecycle"], args.pod)
+    if rec is None:
+        known = {r.get("pod") for r in flight["lifecycle"]}
+        print(f"pod {args.pod!r} not found in flight dir "
+              f"{args.flight_dir!r} ({len(known)} pods recorded)")
+        return 2
+    joined = stitch(rec, flight["span"], flight["decision"])
+    for line in explain_lines(joined):
+        print(line)
+    if args.export:
+        trace = stitched_trace(rec, flight["span"], flight["decision"])
+        with open(args.export, "w") as f:
+            json.dump(trace, f, indent=1)
+        print(f"  exported {len(trace['traceEvents'])} spans -> {args.export}")
+    return 0
+
+
+def cmd_slo(args) -> int:
+    flight = load_flight(args.flight_dir)
+    records = flight["lifecycle"]
+    if not records:
+        print(f"no lifecycle records in {args.flight_dir!r}")
+        return 2
+    report = slo_report(
+        records, target_seconds=args.target, objective=args.objective
+    )
+    print(json.dumps(report, indent=2, sort_keys=True))
+    slo = report.get("slo")
+    if slo is not None and args.max_burn_rate is not None:
+        if slo["burn_rate"] > args.max_burn_rate:
+            print(f"FAIL: burn rate {slo['burn_rate']:.2f} > "
+                  f"{args.max_burn_rate}")
+            return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="crane-trace", description=__doc__)
+    parser.add_argument(
+        "--flight-dir",
+        default=os.environ.get("CRANE_FLIGHT_DIR", "/tmp/crane-flight"),
+        help="flight recorder directory (default: $CRANE_FLIGHT_DIR)",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_explain = sub.add_parser("explain", help="full hop timeline for a pod")
+    p_explain.add_argument("pod", help="pod key, e.g. default/pod-1")
+    p_explain.add_argument("--export", default=None,
+                           help="write the stitched Chrome trace JSON here")
+    p_explain.set_defaults(fn=cmd_explain)
+    p_slo = sub.add_parser("slo", help="p50/p99 per stage + burn rate")
+    p_slo.add_argument("--target", type=float, default=None,
+                       help="e2e latency target in seconds")
+    p_slo.add_argument("--objective", type=float, default=0.99)
+    p_slo.add_argument("--max-burn-rate", type=float, default=None,
+                       help="exit 1 when the burn rate exceeds this")
+    p_slo.set_defaults(fn=cmd_slo)
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

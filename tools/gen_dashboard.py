@@ -1,0 +1,217 @@
+"""Generate the Grafana placement-SLO dashboard from the live registry.
+
+The panel list is derived from the metric families a telemetry bundle
+actually registers (a ``Telemetry`` with the lifecycle tracker's
+families materialized), not hand-maintained — renaming a family in code
+regenerates the dashboard; CI regenerates and diffs against the
+committed JSON (``make dashboards``), so the two can never drift.
+
+Output is fully deterministic: families sort by name, panel ids are
+sequential, and the JSON is dumped with sorted keys.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# SLO defaults mirrored in doc/observability.md; override per deploy
+SLO_TARGET_SECONDS = 5.0
+SLO_OBJECTIVE = 0.99
+
+_GRID_W = 12
+_GRID_H = 8
+
+
+def registered_families() -> list[tuple[str, str, str, tuple]]:
+    """(name, kind, help, labelnames) for every family the telemetry
+    bundle registers, sorted by name."""
+    from crane_scheduler_tpu.telemetry import (
+        Counter,
+        Gauge,
+        Histogram,
+        Telemetry,
+    )
+
+    tel = Telemetry()
+    tel.lifecycle.ensure_metrics()
+    out = []
+    for name, fam in sorted(tel.registry._families.items()):
+        if isinstance(fam, Histogram):
+            kind = "histogram"
+        elif isinstance(fam, Counter):
+            kind = "counter"
+        elif isinstance(fam, Gauge):
+            kind = "gauge"
+        else:
+            kind = "unknown"
+        out.append((name, kind, fam.help, tuple(fam.labelnames)))
+    return out
+
+
+def _panel(panel_id: int, title: str, exprs: list[tuple[str, str]],
+           unit: str = "s", description: str = "") -> dict:
+    col = (panel_id - 1) % 2
+    row = (panel_id - 1) // 2
+    return {
+        "id": panel_id,
+        "title": title,
+        "description": description,
+        "type": "timeseries",
+        "datasource": {"type": "prometheus", "uid": "${datasource}"},
+        "fieldConfig": {"defaults": {"unit": unit}, "overrides": []},
+        "gridPos": {
+            "h": _GRID_H, "w": _GRID_W,
+            "x": col * _GRID_W, "y": row * _GRID_H,
+        },
+        "targets": [
+            {"expr": expr, "legendFormat": legend, "refId": chr(65 + i)}
+            for i, (expr, legend) in enumerate(exprs)
+        ],
+    }
+
+
+def _family_panels(families) -> list[dict]:
+    panels = []
+    names = {name for name, _, _, _ in families}
+
+    def add(title, exprs, unit="s", description=""):
+        panels.append(_panel(len(panels) + 1, title, exprs, unit, description))
+
+    # headline SLO panels first (only if the families exist)
+    if "crane_placement_e2e_seconds" in names:
+        add(
+            "Placement e2e latency (p50/p99)",
+            [
+                ("histogram_quantile(0.50, sum(rate("
+                 "crane_placement_e2e_seconds_bucket[5m])) by (le))", "p50"),
+                ("histogram_quantile(0.99, sum(rate("
+                 "crane_placement_e2e_seconds_bucket[5m])) by (le))", "p99"),
+            ],
+            description="Pod first-seen to watch-confirmed. Buckets carry "
+                        "trace_id exemplars; click through to crane-trace "
+                        "explain.",
+        )
+        add(
+            "SLO compliance (target "
+            f"{SLO_TARGET_SECONDS:g}s, objective {SLO_OBJECTIVE:g})",
+            [
+                (f"sum(rate(crane_placement_e2e_seconds_bucket"
+                 f"{{le=\"{SLO_TARGET_SECONDS:g}\"}}[5m])) / "
+                 "sum(rate(crane_placement_e2e_seconds_count[5m]))",
+                 "good fraction"),
+                (f"(1 - sum(rate(crane_placement_e2e_seconds_bucket"
+                 f"{{le=\"{SLO_TARGET_SECONDS:g}\"}}[5m])) / "
+                 "sum(rate(crane_placement_e2e_seconds_count[5m]))) / "
+                 f"{1 - SLO_OBJECTIVE:g}", "burn rate"),
+            ],
+            unit="none",
+            description="Burn rate 1.0 = consuming the error budget "
+                        "exactly; sustained > 1 pages.",
+        )
+    if "crane_placement_stage_seconds" in names:
+        add(
+            "Per-stage latency p99 (by stage)",
+            [
+                ("histogram_quantile(0.99, sum(rate("
+                 "crane_placement_stage_seconds_bucket[5m])) "
+                 "by (le, stage))", "{{stage}}"),
+            ],
+            description="Delta to the previous lifecycle stage: filtered, "
+                        "scored, bind_post, watch_confirm.",
+        )
+    # one generic panel per remaining family, derived from its kind
+    handled = {"crane_placement_e2e_seconds", "crane_placement_stage_seconds"}
+    for name, kind, help_text, labels in families:
+        if name in handled:
+            continue
+        by = ", ".join(l for l in labels if l != "le")
+        legend = "{{" + (by.split(", ")[0] if by else "job") + "}}"
+        if kind == "histogram":
+            expr = (f"histogram_quantile(0.99, sum(rate({name}_bucket[5m])) "
+                    f"by (le{', ' + by if by else ''}))")
+            add(f"{name} p99", [(expr, legend)], description=help_text)
+        elif kind == "counter":
+            grp = f" by ({by})" if by else ""
+            add(f"{name} rate", [(f"sum(rate({name}[5m])){grp}", legend)],
+                unit="ops", description=help_text)
+        elif kind == "gauge":
+            grp = f" by ({by})" if by else ""
+            add(name, [(f"sum({name}){grp}", legend)], unit="none",
+                description=help_text)
+    return panels
+
+
+def build_dashboard() -> dict:
+    families = registered_families()
+    return {
+        "__inputs": [
+            {
+                "name": "datasource",
+                "label": "Prometheus",
+                "type": "datasource",
+                "pluginId": "prometheus",
+            }
+        ],
+        "title": "Crane placement SLO",
+        "uid": "crane-placement-slo",
+        "tags": ["crane-scheduler-tpu", "slo", "generated"],
+        "timezone": "utc",
+        "schemaVersion": 39,
+        "version": 1,
+        "refresh": "30s",
+        "time": {"from": "now-6h", "to": "now"},
+        "templating": {
+            "list": [
+                {
+                    "name": "datasource",
+                    "type": "datasource",
+                    "query": "prometheus",
+                }
+            ]
+        },
+        "annotations": {"list": []},
+        "panels": _family_panels(families),
+        "description": (
+            "Generated by tools/gen_dashboard.py from the telemetry "
+            "registry's family list — edit the generator, not this file "
+            "(make dashboards)."
+        ),
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="gen-dashboard")
+    parser.add_argument("--out", default=None,
+                        help="write here (default: stdout)")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 if --out differs from regeneration")
+    args = parser.parse_args(argv)
+    text = json.dumps(build_dashboard(), indent=1, sort_keys=True) + "\n"
+    if args.out is None:
+        sys.stdout.write(text)
+        return 0
+    if args.check:
+        try:
+            with open(args.out) as f:
+                current = f.read()
+        except OSError:
+            current = ""
+        if current != text:
+            print(f"{args.out} is stale — run: make dashboards")
+            return 1
+        print(f"{args.out} up to date")
+        return 0
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        f.write(text)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -139,7 +139,48 @@ def main() -> int:
             any(e.get("ph") == "X" for e in trace.get("traceEvents", ())),
         )
 
-        # 5. kube read-path metrics: a telemetry-carrying client against
+        # 5. placement lifecycle families + exemplar, negotiated as
+        # OpenMetrics: drive one pod through the tracker on the serving
+        # registry, then scrape with the openmetrics Accept type — the
+        # e2e bucket must carry a trace_id exemplar and the payload must
+        # strict-parse (exemplars are only legal on histogram buckets)
+        lc = svc.telemetry.lifecycle
+        lc.seen("smoke/pod-0")
+        lc.stage("smoke/pod-0", "filtered")
+        lc.stage("smoke/pod-0", "scored", node="n0")
+        lc.posted("smoke/pod-0", node="n0")
+        lc.confirmed("smoke/pod-0", node="n0")
+        req = urllib.request.Request(
+            f"{base}/metrics",
+            headers={"Accept": "application/openmetrics-text"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            om_ctype = r.headers["Content-Type"]
+            om_text = r.read().decode()
+        check("openmetrics content-type",
+              om_ctype.startswith("application/openmetrics-text"), om_ctype)
+        check("openmetrics EOF terminator",
+              om_text.rstrip().endswith("# EOF"))
+        try:
+            om_families = parse_exposition(om_text)
+            check("openmetrics strict parse", True,
+                  f"{len(om_families)} families")
+        except ExpositionError as e:
+            om_families = {}
+            check("openmetrics strict parse", False, str(e))
+        for required in (
+            "crane_placement_stage_seconds",
+            "crane_placement_e2e_seconds",
+        ):
+            check(f"family {required}", required in om_families)
+        e2e_exemplars = om_families.get(
+            "crane_placement_e2e_seconds", {}
+        ).get("exemplars", [])
+        check("e2e bucket carries a trace_id exemplar",
+              any(dict(e[2]).get("trace_id") for e in e2e_exemplars),
+              f"{len(e2e_exemplars)} exemplars")
+
+        # 6. kube read-path metrics: a telemetry-carrying client against
         # an in-process stub apiserver must populate the round-7 decode
         # and coalesced-apply families, and the registry must still pass
         # the strict parser with them present

@@ -1,0 +1,176 @@
+"""Trace smoke gate (``make trace-smoke``): one pod traced end to end
+over a live stub apiserver, then the flight recorder replayed through
+``crane_trace.py``.
+
+The full reference loop runs in one process against a real HTTP
+boundary: the annotator merge-patches node annotations (its sync span
+stamps the shared annotation timestamp), the plugin scheduler reads the
+mirror and schedules the pod (lifecycle: seen -> filtered -> scored),
+the bind POSTs the binding subresource carrying the pod's W3C
+``traceparent`` header, and the apiserver's watch event confirms the
+placement — finalizing the lifecycle record into the on-disk flight
+ring.
+
+Checks, in order:
+- the binding POST carried the pod's ``traceparent`` on the wire (the
+  stub records it) and its trace ID matches the lifecycle record;
+- the lifecycle record finalized with every stage present;
+- ``crane_trace.py explain <pod>`` reconstructs the timeline from the
+  flight dir and exits 0;
+- ``crane_trace.py slo`` reports one confirmed placement;
+- the OpenMetrics exposition carries a ``crane_placement_e2e_seconds``
+  exemplar with that trace ID, and strict-parses.
+
+Exit 0 = every check passed; any violation prints the failure and exits
+nonzero. Runs in a few wall-clock seconds.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _wait_until(predicate, timeout=10.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def main() -> int:
+    from crane_scheduler_tpu import telemetry as telemetry_mod
+    from crane_scheduler_tpu.annotator import AnnotatorConfig, NodeAnnotator
+    from crane_scheduler_tpu.cluster.kube import KubeClusterClient
+    from crane_scheduler_tpu.framework.scheduler import Scheduler
+    from crane_scheduler_tpu.metrics import FakeMetricsSource
+    from crane_scheduler_tpu.plugins import DynamicPlugin
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+    from crane_scheduler_tpu.telemetry import Telemetry
+    from crane_scheduler_tpu.telemetry.expfmt import (
+        ExpositionError,
+        parse_exposition,
+    )
+
+    import crane_trace
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    stub_path = os.path.join(root, "tests", "kube_stub.py")
+    stub_spec = importlib.util.spec_from_file_location(
+        "kube_stub_trace_smoke", stub_path
+    )
+    kube_stub = importlib.util.module_from_spec(stub_spec)
+    stub_spec.loader.exec_module(kube_stub)
+
+    failures = 0
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        nonlocal failures
+        mark = "ok" if ok else "FAIL"
+        print(f"[trace-smoke] {name}: {mark}{' — ' + detail if detail else ''}")
+        if not ok:
+            failures += 1
+
+    flight_dir = tempfile.mkdtemp(prefix="crane-flight-smoke-")
+    tel = Telemetry(flight_dir=flight_dir)
+    telemetry_mod.enable(tel)
+    stub = kube_stub.KubeStubServer().start()
+    client = None
+    try:
+        stub.state.add_node("node-hot", "10.0.0.1")
+        stub.state.add_node("node-cool", "10.0.0.2")
+        client = KubeClusterClient(stub.url, telemetry=tel)
+        client.start()
+
+        # annotator sweep over the wire (merge-patch through the stub)
+        fake = FakeMetricsSource()
+        for metric in {sp.name for sp in DEFAULT_POLICY.spec.sync_period}:
+            fake.set(metric, "10.0.0.1", 0.9, by="ip")
+            fake.set(metric, "10.0.0.2", 0.1, by="ip")
+        ann = NodeAnnotator(
+            client, fake, DEFAULT_POLICY, AnnotatorConfig(), telemetry=tel
+        )
+        ann.event_ingestor.start()
+        now = time.time()
+        ann.sync_all_once_bulk(now)
+        check("annotator sweep patched the stub",
+              any("," in v
+                  for v in stub.state.nodes["node-hot"]["metadata"]
+                  .get("annotations", {}).values()))
+
+        # schedule one pod through the drip path
+        sched = Scheduler(client, telemetry=tel)
+        sched.register(DynamicPlugin(DEFAULT_POLICY), weight=3)
+        stub.state.add_pod("default", "traced-1")
+        check("pod mirrored",
+              _wait_until(lambda: client.get_pod("default/traced-1")
+                          is not None))
+        result = sched.schedule_one(client.get_pod("default/traced-1"))
+        check("pod placed", result.node is not None, str(result.node))
+
+        # the watch's Scheduled confirmation finalizes the record
+        check("lifecycle record finalized",
+              _wait_until(lambda: any(
+                  r.get("pod") == "default/traced-1"
+                  for r in tel.lifecycle.records())))
+        rec = [r for r in tel.lifecycle.records()
+               if r.get("pod") == "default/traced-1"][-1]
+        missing = [s for s in ("seen", "filtered", "scored", "bind_post",
+                               "watch_confirm") if s not in rec["stages"]]
+        check("every stage present", not missing, f"missing={missing}")
+
+        # wire-level propagation: the binding POST carried the header
+        binding_tps = [tp for m, p, tp in stub.state.trace_headers
+                       if p.endswith("/pods/traced-1/binding")]
+        check("binding POST carried traceparent", bool(binding_tps),
+              str(stub.state.trace_headers[-3:]))
+        check("header trace matches lifecycle record",
+              any(rec["trace_id"] in tp for tp in binding_tps))
+
+        tel.flush_flight()
+
+        # replay the flight dir through the CLI
+        rc = crane_trace.main(
+            ["--flight-dir", flight_dir, "explain", "default/traced-1"]
+        )
+        check("crane_trace explain exits 0", rc == 0, f"rc={rc}")
+        rc = crane_trace.main(
+            ["--flight-dir", flight_dir, "slo", "--target", "30"]
+        )
+        check("crane_trace slo exits 0", rc == 0, f"rc={rc}")
+
+        # exemplar on the e2e histogram, strict-parsed
+        text = tel.render_prometheus(openmetrics=True)
+        try:
+            families = parse_exposition(text)
+            exemplars = families.get(
+                "crane_placement_e2e_seconds", {}
+            ).get("exemplars", [])
+            check("e2e exemplar links the trace",
+                  any(dict(e[2]).get("trace_id") == rec["trace_id"]
+                      for e in exemplars),
+                  f"{len(exemplars)} exemplars")
+        except ExpositionError as e:
+            check("openmetrics strict parse", False, str(e))
+    finally:
+        if client is not None:
+            client.stop()
+        stub.stop()
+        telemetry_mod.disable()
+        shutil.rmtree(flight_dir, ignore_errors=True)
+
+    print(f"[trace-smoke] {'PASS' if not failures else 'FAIL'}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
