@@ -502,6 +502,22 @@ def main() -> int:
         f"(~{scalar_ms_per_node * N_NODES:.0f} ms for one 50k-node sweep)"
     )
 
+    # columnar drip: the same verdicts as one vectorized column rebuild —
+    # the drip path pays this once per store version, then schedules each
+    # pod as a masked argmax over the cached column
+    from crane_scheduler_tpu.scorer.columns import drip_filter_score_columns
+
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        drip_filter_score_columns(tensors, values, ts, hot_value, hot_ts, now)
+    drip_rebuild_ms = (time.perf_counter() - t0) * 1e3 / reps
+    log(
+        f"columnar drip: {drip_rebuild_ms:.1f} ms per {N_NODES // 1000}k-node "
+        f"column rebuild "
+        f"({scalar_ms_per_node * N_NODES / drip_rebuild_ms:.0f}x one scalar sweep)"
+    )
+
     # --- refresh path (annotation wire -> store -> device) -------------
     refresh_ms, r_ingest_ms, r_upload_ms, warm_ms, warm_rows = bench_refresh(
         step, tensors, now, values
@@ -549,6 +565,9 @@ def main() -> int:
                 # batched H2D upload incl. the hybrid risk scan; warm =
                 # host ms for a 1%-dirty incremental tick (r05 cold
                 # measurement was 2086 ms, upload alone)
+                # drip path: cost of one full column rebuild (amortized
+                # across every pod scheduled under the same store version)
+                "drip_column_rebuild_ms": round(drip_rebuild_ms, 2),
                 "refresh_ms": round(refresh_ms, 1),
                 "refresh_ingest_ms": round(r_ingest_ms, 1),
                 "refresh_upload_ms": round(r_upload_ms, 1),

@@ -24,6 +24,13 @@ full-loop configs, end to end.
      no-descheduler vs descheduler legs in the same process, >=2x
      max/mean utilization-imbalance reduction gated, stub eviction
      oracle (no daemonset/system victims, no duplicate POSTs)
+ 12. chaos soak: scripted Prometheus outage through the breaker +
+     degraded-mode controller, recovery time vs a no-resilience leg
+ 13. placement e2e latency over the wire stub, lifecycle-tracked
+     first-seen -> watch-confirm with traceparent on every bind POST
+ 14. columnar drip storm: 1k schedule_one+bind cycles at 5k/50k
+     nodes, scalar plugin loop vs version-cached columns; placement
+     prefix parity, stub bind oracle, >=100x per-pod gate at 50k
 
 Each config reports a JSON line to stdout with wall-clock timings.
 Configs 1-3 run the full loop (annotator sync through real annotation
@@ -1880,10 +1887,149 @@ def config13(dtype, rtt, n_nodes=6, n_pods=48, target_s=5.0):
         server.stop()
 
 
+def config14(dtype, rtt, node_scales=(5_000, 50_000), n_pods=1_000):
+    """Round-12 tentpole gate: the columnar drip path at scale, through
+    the wire stub — a 1k-pod drip storm (one ``schedule_one`` + one
+    binding POST per pod) against a mirror of ``n_nodes`` annotated
+    nodes, scalar plugin loop vs cached-column fast path.
+
+    Two legs per node scale, fresh stub subprocess each, identically
+    seeded (wire-shaped ``value,timestamp`` annotations, value keyed on
+    the node index so the cluster has distinct score classes AND real
+    tie sets), same ``tie_break_seed``:
+
+      scalar   — ``columnar=False``: the exact O(plugins x nodes) loop
+                 shipped through round 9, scheduling a K-pod prefix
+                 (the full storm would take ~45 min at 50k nodes);
+      columnar — the new default: version-cached Filter/Score columns,
+                 one masked argmax per pod, binds folded into the
+                 cached fit column.
+
+    In-run gates: the columnar leg's first K placements equal the
+    scalar leg's K placements node for node (the seeded-tiebreak RNG
+    must be consumed identically); every pod places; the stub asserts
+    zero duplicate binding POSTs on both legs; the columnar leg took
+    zero scalar fallbacks; and the 50k speedup is >= 100x per pod."""
+    from crane_scheduler_tpu.cluster import (
+        Container,
+        Pod,
+        ResourceRequirements,
+    )
+    from crane_scheduler_tpu.cluster.kube import KubeClusterClient
+    from crane_scheduler_tpu.fit import FitTracker, ResourceFitPlugin
+    from crane_scheduler_tpu.framework.scheduler import Scheduler
+    from crane_scheduler_tpu.plugins import DynamicPlugin
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+    from crane_scheduler_tpu.utils import parse_local_time
+
+    kube_stub = _load_kube_stub()
+    metric_names = [sp.name for sp in DEFAULT_POLICY.spec.sync_period]
+    # the stub seeds annotations stamped 2026-07-30T00:00:00Z; score 30s
+    # after that so every row is fresh for the 5m windows
+    now = parse_local_time("2026-07-30T00:00:00Z") + 30.0
+    seed = 14
+
+    def leg(n_nodes, columnar, count):
+        server = kube_stub.KubeStubSubprocess()
+        try:
+            server.seed(n_nodes, "node-", metrics=metric_names)
+            client = KubeClusterClient(server.url, list_page_limit=2000)
+            client.start()
+            assert len(client.list_nodes()) == n_nodes
+            sched = Scheduler(
+                client, clock=lambda: now, columnar=columnar,
+                tie_break_seed=seed,
+            )
+            sched.register(ResourceFitPlugin(FitTracker(client)), weight=1)
+            sched.register(
+                DynamicPlugin(DEFAULT_POLICY, clock=lambda: now), weight=3
+            )
+            placements = []
+            t0 = time.perf_counter()
+            for i in range(count):
+                pod = Pod(
+                    name=f"drip-{i:04d}", namespace="default",
+                    containers=(Container("c", ResourceRequirements(
+                        requests={"cpu": "100m", "memory": "128Mi"},
+                    )),),
+                )
+                client.add_pod(pod)
+                result = sched.schedule_one(pod)
+                assert result.node is not None, \
+                    f"pod {i} unplaced: {result.reason}"
+                placements.append(result.node)
+            wall_s = time.perf_counter() - t0
+            stats = server.stats()
+            assert stats["duplicate_binds"] == 0, "double-POSTed bind!"
+            assert stats["bind_posts"] == count, \
+                f"bind POSTs {stats['bind_posts']} != {count} pods"
+            drip = sched.drip_stats()
+            if columnar:
+                assert not drip["fallbacks"], \
+                    f"unexpected scalar fallbacks: {drip['fallbacks']}"
+            client.stop()
+            return {
+                "pods": count,
+                "wall_ms": round(wall_s * 1e3, 1),
+                "per_pod_ms": round(wall_s * 1e3 / count, 3),
+                "pods_per_sec": round(count / wall_s, 1),
+                "drip": drip,
+            }, placements
+        finally:
+            server.stop()
+
+    results = {}
+    for n_nodes in node_scales:
+        # the scalar prefix is sized so each leg stays ~O(10s) of wall
+        k = 40 if n_nodes <= 5_000 else 5
+        scalar, scalar_placed = leg(n_nodes, columnar=False, count=k)
+        columnar, col_placed = leg(n_nodes, columnar=True, count=n_pods)
+        # bit-identical placement prefix: same cluster, same seed, same
+        # RNG consumption -> the K scalar placements must match the
+        # columnar storm's first K node for node
+        assert col_placed[:k] == scalar_placed, \
+            f"placement divergence at {n_nodes} nodes: " \
+            f"{scalar_placed} != {col_placed[:k]}"
+        speedup = round(scalar["per_pod_ms"] / columnar["per_pod_ms"], 1)
+        results[n_nodes] = {
+            "scalar": scalar,
+            "columnar": columnar,
+            "speedup_per_pod": speedup,
+            "placement_prefix": "ok",
+        }
+        log(f"config14[{n_nodes}n]: scalar {scalar['per_pod_ms']:.1f} "
+            f"ms/pod (K={k}), columnar {columnar['per_pod_ms']:.2f} "
+            f"ms/pod x {n_pods} pods ({columnar['pods_per_sec']:,.0f} "
+            f"pods/s), speedup {speedup}x, "
+            f"drip {columnar['drip']}")
+    big = results[max(node_scales)]
+    emit({"config": 14,
+          "desc": f"columnar drip storm through the wire stub: {n_pods} "
+                  "schedule_one+bind cycles against "
+                  f"{'/'.join(str(n) for n in node_scales)}-node "
+                  "mirrors, scalar plugin loop (K-pod prefix) vs "
+                  "version-cached columns (same seed, fresh stub per "
+                  "leg)",
+          "pods": n_pods,
+          "per_pod_ms": big["columnar"]["per_pod_ms"],
+          "pods_per_sec": big["columnar"]["pods_per_sec"],
+          "per_pod_ms_scalar": big["scalar"]["per_pod_ms"],
+          "speedup_per_pod": big["speedup_per_pod"],
+          "drip_stats": big["columnar"]["drip"],
+          "scales": {str(n): v for n, v in results.items()},
+          "placement_prefix_parity": "ok",
+          "note": "gates: scalar-prefix placements bit-identical under "
+                  "the shared tie_break_seed, zero duplicate binding "
+                  "POSTs (stub oracle) on every leg, zero columnar->"
+                  "scalar fallbacks, >=100x per-pod speedup at 50k"})
+    assert big["speedup_per_pod"] >= 100.0, \
+        f"drip speedup gate: {big['speedup_per_pod']}x < 100x at 50k"
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--device", choices=["cpu", "default"], default="default")
-    parser.add_argument("--configs", default="1,2,3,4,5,6,7,7b,8,9,10,11,12,13")
+    parser.add_argument("--configs", default="1,2,3,4,5,6,7,7b,8,9,10,11,12,13,14")
     parser.add_argument("--f64", action="store_true")
     args = parser.parse_args(argv)
 
@@ -1927,6 +2073,8 @@ def main(argv=None) -> int:
         config12(dtype, rtt)
     if 13 in todo:
         config13(dtype, rtt)
+    if 14 in todo:
+        config14(dtype, rtt)
     return 0
 
 

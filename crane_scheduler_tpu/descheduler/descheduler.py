@@ -267,10 +267,10 @@ class LoadAwareDescheduler:
                 if not self._pod_evictable(pod, report.skipped):
                     continue
                 request = pod_fit_request(pod)
-                if not any(
-                    self.fit.fits(pod, target, request)[0]
-                    for target in landing
-                ):
+                # one vectorized verdict over the landing set (the same
+                # free columns the drip path caches) instead of a
+                # per-target fits() walk per victim
+                if not self.fit.fits_mask(landing, request).any():
                     self._skip(report.skipped, "no_fit")
                     continue
                 ev = Eviction(pod.key(), node_name, hot_now[node_name])

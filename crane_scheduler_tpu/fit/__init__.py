@@ -9,13 +9,21 @@ the ``ClusterState`` mirror, exposed two ways:
   gang solver in place of its ``1 << 30`` default.
 """
 
-from .tracker import UNBOUNDED, FitTracker, pod_fit_request
+from .tracker import (
+    UNBOUNDED,
+    FitTracker,
+    pod_fit_request,
+    request_vec,
+    row_fail_reason,
+)
 from .plugin import PLUGIN_NAME, ResourceFitPlugin
 
 __all__ = [
     "UNBOUNDED",
     "FitTracker",
     "pod_fit_request",
+    "request_vec",
+    "row_fail_reason",
     "ResourceFitPlugin",
     "PLUGIN_NAME",
 ]

@@ -76,13 +76,29 @@ def pod_fit_request(pod: Pod) -> Resource:
     return r
 
 
-def _request_vec(r: Resource) -> np.ndarray:
+def request_vec(r: Resource) -> np.ndarray:
+    """Dense int64 request row in columnar dim order."""
     vec = np.zeros((_N_DIMS,), dtype=np.int64)
     vec[_DIM_CPU] = r.milli_cpu
     vec[_DIM_MEM] = r.memory
     vec[_DIM_EPH] = r.ephemeral_storage
     vec[_DIM_PODS] = 1  # every pod consumes one slot
     return vec
+
+
+_request_vec = request_vec
+
+
+def row_fail_reason(free_row, vec) -> str:
+    """First failing dimension of a bounded free row against ``vec``,
+    in NodeResourcesFit's check order and wording (pods slot first,
+    then cpu/memory/ephemeral-storage). Empty string means it fits."""
+    if free_row[_DIM_PODS] < vec[_DIM_PODS]:
+        return "Too many pods"
+    for d in (_DIM_CPU, _DIM_MEM, _DIM_EPH):
+        if vec[d] > 0 and vec[d] > free_row[d]:
+            return f"Insufficient {_DIM_NAMES[d]}"
+    return ""
 
 
 class FitTracker:
@@ -112,6 +128,13 @@ class FitTracker:
         self._full_recounts = 0
         self._incremental_recounts = 0
         self._req_dirty = True  # requested columns not yet counted
+        # name->row gathers cached per (names list identity, index
+        # epoch): the drip column cache, the gang solver's capacity rows
+        # and the descheduler's landing mask all re-pass the SAME list
+        # object every call, so steady state is pure fancy indexing
+        self._index_ver = 0
+        self._aligned: list[tuple] = []  # (names_ref, index_ver, rows, known)
+        self.mask_builds = 0  # aligned-gather rebuilds (regression gate)
         self._telemetry = telemetry
         if telemetry is not None:
             reg = telemetry.registry
@@ -191,6 +214,8 @@ class FitTracker:
                 k: v for k, v in old_scalar_req.items() if k in self._index
             }
             self._alloc_maps = {}
+            self._index_ver += 1
+            self._aligned.clear()
             if not self._req_dirty:
                 for name, i in stale:
                     self._recount_node_locked(name, i)
@@ -282,13 +307,10 @@ class FitTracker:
             i = self._index.get(node_name)
             if i is None or not self._has_alloc[i]:
                 return True, ""
-            alloc, used = self._alloc[i], self._req[i]
-            if used[_DIM_PODS] + 1 > alloc[_DIM_PODS]:
-                return False, "Too many pods"
-            vec = _request_vec(request)
-            for d in (_DIM_CPU, _DIM_MEM, _DIM_EPH):
-                if vec[d] > 0 and vec[d] > alloc[d] - used[d]:
-                    return False, f"Insufficient {_DIM_NAMES[d]}"
+            vec = request_vec(request)
+            reason = row_fail_reason(self._alloc[i] - self._req[i], vec)
+            if reason:
+                return False, reason
             if request.scalar_resources:
                 salloc = self._scalar_alloc.get(node_name) or {}
                 sused = self._scalar_req.get(node_name) or {}
@@ -296,6 +318,77 @@ class FitTracker:
                     if v > 0 and v > salloc.get(k, 0) - sused.get(k, 0):
                         return False, f"Insufficient {k}"
             return True, ""
+
+    def _rows_for_locked(self, names) -> tuple[np.ndarray, np.ndarray]:
+        """``(rows[N], known[N])`` aligning ``names`` with the tracker's
+        columns, cached by list identity + index epoch (a caller that
+        re-passes the same list object pays the O(N) dict-get gather
+        once, not per call)."""
+        for ent in self._aligned:
+            if ent[0] is names and ent[1] == self._index_ver:
+                return ent[2], ent[3]
+        index = self._index
+        n = len(names)
+        rows = np.fromiter(
+            (index.get(nm, -1) for nm in names), dtype=np.int64, count=n
+        )
+        known = rows >= 0
+        self.mask_builds += 1
+        self._aligned.append((names, self._index_ver, rows, known))
+        if len(self._aligned) > 8:
+            del self._aligned[0]
+        return rows, known
+
+    def fits_mask(self, names, request: Resource) -> np.ndarray:
+        """Vectorized ``fits`` verdict over ``names`` — bit-identical
+        per node, one broadcast instead of a per-node Python walk.
+        Unknown/unreported nodes fail open (True)."""
+        with self._lock:
+            n = len(names)
+            ok = np.ones((n,), dtype=bool)
+            if not self._names or n == 0:
+                return ok
+            rows, known = self._rows_for_locked(names)
+            bounded = np.zeros((n,), dtype=bool)
+            bounded[known] = self._has_alloc[rows[known]]
+            bidx = np.flatnonzero(bounded)
+            if not bidx.size:
+                return ok
+            vec = request_vec(request)
+            br = rows[bidx]
+            free = self._alloc[br] - self._req[br]
+            fail = ((vec > 0) & (free < vec)).any(axis=1)
+            if request.scalar_resources:
+                # rare path: per-name dict walk, mirroring fits()
+                for j, i in enumerate(bidx):
+                    if fail[j]:
+                        continue
+                    nm = names[i]
+                    salloc = self._scalar_alloc.get(nm) or {}
+                    sused = self._scalar_req.get(nm) or {}
+                    for k, v in request.scalar_resources.items():
+                        if v > 0 and v > salloc.get(k, 0) - sused.get(k, 0):
+                            fail[j] = True
+                            break
+            ok[bidx] = ~fail
+            return ok
+
+    def free_matrix(self, names) -> tuple[np.ndarray, np.ndarray]:
+        """Aligned ``(bounded[N] bool, free[N,4] int64)`` COPIES for a
+        column cache: callers may fold their own binds into ``free``
+        (subtract a request row) without touching tracker state.
+        Unknown/unreported rows come back unbounded (False, zeros)."""
+        with self._lock:
+            n = len(names)
+            bounded = np.zeros((n,), dtype=bool)
+            free = np.zeros((n, _N_DIMS), dtype=np.int64)
+            if not self._names or n == 0:
+                return bounded, free
+            rows, known = self._rows_for_locked(names)
+            kr = rows[known]
+            bounded[known] = self._has_alloc[kr]
+            free[known] = self._alloc[kr] - self._req[kr]
+            return bounded, free
 
     def free_copy_counts(
         self, names: list, request: Resource
@@ -309,11 +402,7 @@ class FitTracker:
             out = np.full((n,), UNBOUNDED, dtype=np.int64)
             if not self._names:
                 return out
-            index = self._index
-            rows = np.fromiter(
-                (index.get(nm, -1) for nm in names), dtype=np.int64, count=n
-            )
-            known = rows >= 0
+            rows, known = self._rows_for_locked(names)
             if not known.any():
                 return out
             r = rows[known]
@@ -364,4 +453,5 @@ class FitTracker:
                 "bounded_nodes": int(self._has_alloc.sum()),
                 "full_recounts": self._full_recounts,
                 "incremental_recounts": self._incremental_recounts,
+                "mask_builds": self.mask_builds,
             }

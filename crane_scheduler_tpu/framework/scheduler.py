@@ -24,18 +24,22 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import Future
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 
 from ..cluster.state import ClusterState, Pod
-from ..framework.types import CycleState, NodeInfo, Status
+from ..framework.types import CycleState, NodeInfo
 from ..loadstore.store import NodeLoadStore
 from ..policy.compile import compile_policy
 from ..policy.types import DynamicSchedulerPolicy
 from ..telemetry import Telemetry, active as active_telemetry, maybe_span
 from ..telemetry import tracing
 from ..utils.logging import vlog, verbosity
+
+# infeasible-row sentinel for the columnar argmax (scores are bounded to
+# [0, 100] x weight, so the sentinel can never win)
+_I64_MIN = np.iinfo(np.int64).min
 
 
 def _submit_fetch(pool, dev, telemetry: Telemetry | None = None):
@@ -82,19 +86,104 @@ class _MirroredStats(dict):
         super().__setitem__(key, value)
 
 
-@dataclass
 class ScheduleResult:
-    pod_key: str
-    node: str | None
-    feasible: int
-    reason: str = ""
-    scores: dict = field(default_factory=dict)
+    """One drip placement outcome. ``scores`` materializes lazily: the
+    columnar path hands over closures instead of building a 50k-entry
+    dict per pod nobody may read — accessing ``.scores`` (or asking for
+    ``top_scores``) pays the cost only on demand."""
+
+    __slots__ = (
+        "pod_key", "node", "feasible", "reason",
+        "_scores", "_lazy_scores", "_lazy_topk", "_reasons_fn",
+    )
+
+    def __init__(
+        self,
+        pod_key: str,
+        node: str | None,
+        feasible: int,
+        reason: str = "",
+        scores: dict | None = None,
+        lazy_scores=None,
+        lazy_topk=None,
+    ):
+        self.pod_key = pod_key
+        self.node = node
+        self.feasible = feasible
+        self.reason = reason
+        self._scores = scores
+        self._lazy_scores = lazy_scores
+        self._lazy_topk = lazy_topk
+        self._reasons_fn = None  # lazy filter-reason histogram (columnar)
+
+    @property
+    def scores(self) -> dict:
+        if self._scores is None:
+            lazy = self._lazy_scores
+            self._scores = {} if lazy is None else lazy()
+        return self._scores
+
+    def top_scores(self, k: int = 5) -> list:
+        """Top-k ``(node, score)`` pairs, highest score first, name
+        ascending among ties — identical ordering to
+        ``sorted(scores.items(), key=(-score, name))[:k]`` without the
+        full sort (heap selection), and without materializing the score
+        dict at all on the columnar path."""
+        import heapq
+
+        if self._scores is None and self._lazy_topk is not None:
+            return self._lazy_topk(k)
+        return heapq.nsmallest(
+            k, self.scores.items(), key=lambda kv: (-kv[1], kv[0])
+        )
+
+    def __repr__(self) -> str:  # dataclass-era debugging convenience
+        return (
+            f"ScheduleResult(pod_key={self.pod_key!r}, node={self.node!r}, "
+            f"feasible={self.feasible}, reason={self.reason!r})"
+        )
 
 
 @dataclass
 class _WeightedPlugin:
     plugin: object
     weight: int = 1
+
+
+class _Hooks:
+    """Per-registration resolution of the plugin extension points: the
+    scalar loop previously paid a ``getattr`` per plugin *per node* for
+    Filter/Score — at 50k nodes that is pure interpreter overhead.
+    Rebuilt whenever ``register`` changes the plugin list."""
+
+    __slots__ = ("pre_filter", "filter", "score", "reserve", "pre_bind",
+                 "unreserve")
+
+    def __init__(self, plugins: list[_WeightedPlugin]):
+        self.pre_filter = [
+            h for wp in plugins
+            if (h := getattr(wp.plugin, "pre_filter", None)) is not None
+        ]
+        self.filter = [
+            h for wp in plugins
+            if (h := getattr(wp.plugin, "filter", None)) is not None
+        ]
+        self.score = [
+            (h, wp.weight) for wp in plugins
+            if (h := getattr(wp.plugin, "score", None)) is not None
+        ]
+        self.reserve = [
+            h for wp in plugins
+            if (h := getattr(wp.plugin, "reserve", None)) is not None
+        ]
+        self.pre_bind = [
+            h for wp in plugins
+            if (h := getattr(wp.plugin, "pre_bind", None)) is not None
+        ]
+        self.unreserve = [
+            h for wp in plugins
+            if (h := getattr(wp.plugin, "unreserve", None)) is not None
+        ]
 
 
 class _OverlappedRefresh:
@@ -385,6 +474,7 @@ class Scheduler:
         clock=time.time,
         telemetry: Telemetry | None = None,
         tie_break_seed: int | None = None,
+        columnar: bool = True,
     ):
         """``tie_break_seed``: opt-in reference-faithful host selection —
         the stock kube-scheduler samples RANDOMLY among equal-score
@@ -395,7 +485,14 @@ class Scheduler:
         load across identically-scored nodes instead of piling onto
         index order until hot-value feedback kicks in. Default off, so
         the parity suite and every existing caller see byte-identical
-        behavior."""
+        behavior.
+
+        ``columnar``: use the version-cached column fast path
+        (``framework.drip``) whenever the registered plugin set and the
+        pod allow it — placements are bit-identical to the scalar loop,
+        which remains the fallback (and the parity oracle) for
+        daemonset pods, degraded mode, scalar extended resources, and
+        any unrecognized plugin."""
         import random
 
         self.cluster = cluster
@@ -406,21 +503,90 @@ class Scheduler:
             if tie_break_seed is not None else None
         )
         self._cache: tuple[int, list[NodeInfo]] | None = None  # (version, snap)
+        self._columnar = bool(columnar)
+        self._hooks: _Hooks | None = None  # scalar-loop hook lists
+        self._drip = None  # DripColumns once plugins are recognized
+        # plugin recognition for the columnar path: False = not yet
+        # computed, None = unrecognized set (scalar forever)
+        self._recognized: tuple | None | bool = False
+        self._unrecognized_reason = "unknown_plugin"
+        self._fallbacks: dict[str, int] = {}
         self._telemetry = (
             telemetry if telemetry is not None else active_telemetry()
         )
         self._m_decisions = None
+        self._m_fallback = None
         if self._telemetry is not None:
-            self._m_decisions = self._telemetry.registry.counter(
+            reg = self._telemetry.registry
+            self._m_decisions = reg.counter(
                 "crane_drip_decisions_total",
                 "schedule_one outcomes",
                 ("outcome",),
+            )
+            self._m_fallback = reg.counter(
+                "crane_drip_fallback_total",
+                "schedule_one calls that took the scalar fallback",
+                ("reason",),
             )
 
     def register(self, plugin, weight: int = 1) -> None:
         """Order matters like the scheduler-config plugin list
         (deploy/manifests: Dynamic weight 3, NRT weight 2)."""
         self._plugins.append(_WeightedPlugin(plugin, weight))
+        # hook lists, plugin recognition, and the column cache all key
+        # off the registration list — rebuild lazily on next use
+        self._hooks = None
+        self._drip = None
+        self._recognized = False
+
+    def drip_stats(self) -> dict:
+        """Column-cache counters (hits/rebuilds/folds/drops) plus the
+        per-reason scalar-fallback histogram — the telemetry-less twin
+        of the ``crane_drip_*`` metric families."""
+        out = {"hits": 0, "rebuilds": 0, "folds": 0, "drops": 0}
+        if self._drip is not None:
+            out.update(self._drip.stats)
+        out["fallbacks"] = dict(self._fallbacks)
+        return out
+
+    def _recognition(self):
+        """Columnar eligibility of the registered plugin set: exactly one
+        ``DynamicPlugin`` plus at most one ``ResourceFitPlugin`` (order
+        free). Anything else — including subclasses, which may override
+        hooks — is unrecognized and pins the scalar loop."""
+        rec = self._recognized
+        if rec is not False:
+            return rec
+        from ..fit.plugin import ResourceFitPlugin
+        from ..plugins.dynamic import DynamicPlugin
+
+        dyn = None
+        dyn_weight = 1
+        tracker = None
+        order: list[str] = []
+        for wp in self._plugins:
+            p = wp.plugin
+            if type(p) is DynamicPlugin and dyn is None:
+                dyn, dyn_weight = p, wp.weight
+                order.append("dyn")
+            elif type(p) is ResourceFitPlugin and tracker is None:
+                tracker = p.tracker
+                order.append("fit")
+            else:
+                self._recognized = None
+                self._unrecognized_reason = "unknown_plugin"
+                return None
+        if dyn is None:
+            self._recognized = None
+            self._unrecognized_reason = "no_dynamic_plugin"
+            return None
+        self._recognized = (dyn, dyn_weight, tracker, tuple(order))
+        return self._recognized
+
+    def _count_fallback(self, reason: str) -> None:
+        self._fallbacks[reason] = self._fallbacks.get(reason, 0) + 1
+        if self._m_fallback is not None:
+            self._m_fallback.labels(reason=reason).inc()
 
     def snapshot(self) -> list[NodeInfo]:
         """Informer-style snapshot, cached on ``cluster.sched_version``:
@@ -488,47 +654,86 @@ class Scheduler:
         self._m_decisions.labels(
             outcome="scheduled" if result.node else "failed"
         ).inc()
-        top = sorted(result.scores.items(), key=lambda kv: (-kv[1], kv[0]))[:5]
-        tel.decisions.record(
-            pod=result.pod_key,
-            node=result.node,
-            reason=result.reason,
-            feasible=result.feasible,
-            top_scores=top,
-            staleness_seconds=-1.0,  # drip reads the live cluster mirror
-            source="drip",
-            filter_reasons=reasons,
-        )
+
+        def build():
+            # lazy: runs only when the sampling stride keeps the entry.
+            # top_scores is heap-selected (k log-ish, not a full sort);
+            # the columnar path supplies its reason histogram as a
+            # closure instead of the scalar loop's eager dict
+            fr = reasons if result._reasons_fn is None else result._reasons_fn()
+            return dict(
+                pod=result.pod_key,
+                node=result.node,
+                reason=result.reason,
+                feasible=result.feasible,
+                top_scores=result.top_scores(5),
+                staleness_seconds=-1.0,  # drip reads the live cluster mirror
+                source="drip",
+                filter_reasons=fr,
+            )
+
+        tel.decisions.offer(build)
         return result
 
     def _schedule_one(
         self, pod: Pod, reasons: dict | None, lc=None
     ) -> ScheduleResult:
+        """Dispatch: columnar fast path when the plugin set and the pod
+        qualify, scalar loop (the parity oracle) otherwise."""
+        if self._columnar:
+            rec = self._recognition()
+            if rec is None:
+                self._count_fallback(self._unrecognized_reason)
+            else:
+                fallback = self._columnar_ineligible(pod, rec)
+                if fallback is None:
+                    return self._schedule_one_columnar(pod, rec, lc=lc)
+                self._count_fallback(fallback)
+        return self._schedule_one_scalar(pod, reasons, lc=lc)
+
+    @staticmethod
+    def _columnar_ineligible(pod: Pod, rec) -> str | None:
+        """Per-pod reasons the cached columns cannot express (each one
+        maps to scalar-loop behavior the columns deliberately omit)."""
+        dyn, _w, tracker, _order = rec
+        if pod.is_daemonset_pod():
+            return "daemonset"  # Dynamic Filter bypass is per-pod
+        if dyn.degraded is not None and dyn.degraded.active:
+            return "degraded"  # spread scoring reads per-node pod lists
+        if tracker is not None:
+            from ..fit.tracker import pod_fit_request
+
+            if pod_fit_request(pod).scalar_resources:
+                return "scalar_request"  # extended resources: dict path
+        return None
+
+    def _schedule_one_scalar(
+        self, pod: Pod, reasons: dict | None, lc=None
+    ) -> ScheduleResult:
         state = CycleState()
         nodes = self.snapshot()
+        hooks = self._hooks
+        if hooks is None:
+            hooks = self._hooks = _Hooks(self._plugins)
 
         # PreFilter
-        for wp in self._plugins:
-            pre = getattr(wp.plugin, "pre_filter", None)
-            if pre is not None:
-                status = pre(state, pod)
-                if not status.ok():
-                    return ScheduleResult(pod.key(), None, 0, status.reason)
+        for pre in hooks.pre_filter:
+            status = pre(state, pod)
+            if not status.ok():
+                return ScheduleResult(pod.key(), None, 0, status.reason)
 
         # Filter
         feasible: list[NodeInfo] = []
         last_reason = ""
+        filters = hooks.filter
         for node_info in nodes:
-            verdict = Status.success()
-            for wp in self._plugins:
-                flt = getattr(wp.plugin, "filter", None)
-                if flt is None:
-                    continue
+            verdict = None
+            for flt in filters:
                 status = flt(state, pod, node_info)
                 if not status.ok():
                     verdict = status
                     break
-            if verdict.ok():
+            if verdict is None:
                 feasible.append(node_info)
             else:
                 last_reason = verdict.reason
@@ -543,17 +748,14 @@ class Scheduler:
         totals: dict[str, int] = {}
         for node_info in feasible:
             total = 0
-            for wp in self._plugins:
-                scr = getattr(wp.plugin, "score", None)
-                if scr is None:
-                    continue
+            for scr, weight in hooks.score:
                 try:
                     value, status = scr(state, pod, node_info)
                 except TypeError:
                     value, status = scr(state, pod, node_info.node.name)
                 if not status.ok():
                     value = 0
-                total += value * wp.weight
+                total += value * weight
             totals[node_info.node.name] = total
 
         # select host: max score, first (snapshot order) among ties —
@@ -568,22 +770,18 @@ class Scheduler:
         best_name = best.node.name
 
         # Reserve
-        for wp in self._plugins:
-            rsv = getattr(wp.plugin, "reserve", None)
-            if rsv is not None:
-                status = rsv(state, pod, best_name)
-                if not status.ok():
-                    self._unreserve(state, pod, best_name)
-                    return ScheduleResult(pod.key(), None, len(feasible), status.reason)
+        for rsv in hooks.reserve:
+            status = rsv(state, pod, best_name)
+            if not status.ok():
+                self._unreserve(state, pod, best_name)
+                return ScheduleResult(pod.key(), None, len(feasible), status.reason)
 
         # PreBind
-        for wp in self._plugins:
-            pb = getattr(wp.plugin, "pre_bind", None)
-            if pb is not None:
-                status = pb(state, pod, best_name)
-                if not status.ok():
-                    self._unreserve(state, pod, best_name)
-                    return ScheduleResult(pod.key(), None, len(feasible), status.reason)
+        for pb in hooks.pre_bind:
+            status = pb(state, pod, best_name)
+            if not status.ok():
+                self._unreserve(state, pod, best_name)
+                return ScheduleResult(pod.key(), None, len(feasible), status.reason)
 
         # per-pod decision line (the plugins.go:59,64 analogue): quiet
         # unless the operator raised verbosity to the per-pod level
@@ -610,10 +808,107 @@ class Scheduler:
         return ScheduleResult(pod.key(), best_name, len(feasible), scores=totals)
 
     def _unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
-        for wp in self._plugins:
-            un = getattr(wp.plugin, "unreserve", None)
-            if un is not None:
-                un(state, pod, node_name)
+        hooks = self._hooks
+        if hooks is None:
+            hooks = self._hooks = _Hooks(self._plugins)
+        for un in hooks.unreserve:
+            un(state, pod, node_name)
+
+    def _schedule_one_columnar(self, pod: Pod, rec, lc=None) -> ScheduleResult:
+        """Vectorized drip placement over the cached cluster columns —
+        mask AND + argmax instead of the O(plugins × nodes) loop, with
+        bit-identical host selection (argmax returns the FIRST maximum,
+        matching ``max`` over snapshot order; seeded tie-break consumes
+        the RNG exactly like the scalar path: one ``randrange`` per
+        actual tie)."""
+        from .drip import DripColumns
+
+        dyn, dyn_weight, tracker, order = rec
+        drip = self._drip
+        if drip is None:
+            drip = self._drip = DripColumns(
+                self.cluster,
+                dyn,
+                dyn_weight,
+                order,
+                fit_tracker=tracker,
+                telemetry=self._telemetry,
+            )
+        # the Dynamic plugin's own clock: the scalar oracle stamps
+        # freshness with dyn._clock(), and parity pins to that
+        now = dyn._clock()
+        drip.ensure(now)
+        names = drip.names
+        n = len(names)
+        vec = None
+        if tracker is not None:
+            from ..fit.tracker import pod_fit_request, request_vec
+
+            vec = request_vec(pod_fit_request(pod))
+        mask = drip.feasible_mask(vec)
+        # capture the column arrays this decision used: rebuilds REPLACE
+        # arrays (never resize in place), so the closures below stay
+        # consistent however many pods later the trace is read
+        weighted = drip.weighted
+        count = int(np.count_nonzero(mask))
+        key = pod.key()
+        if count == 0:
+            # scalar parity: the reported reason is the LAST infeasible
+            # node's verdict in snapshot order
+            reason = drip.reason_for(n - 1, vec) if n else ""
+            result = ScheduleResult(key, None, 0, reason or "no feasible nodes")
+            result._reasons_fn = lambda: drip.reason_counts(mask, vec)
+            return result
+        if lc is not None:
+            lc.stage(key, "filtered")
+
+        w = np.where(mask, weighted, _I64_MIN)
+        best_i = int(np.argmax(w))
+        if self._tie_rng is not None:
+            ties = np.flatnonzero(mask & (weighted == weighted[best_i]))
+            if ties.size > 1:
+                best_i = int(ties[self._tie_rng.randrange(ties.size)])
+        best_name = names[best_i]
+
+        if verbosity() >= 3:
+            vlog(3, f"schedule_one {key}: {count} feasible, "
+                    f"picked {best_name} score {int(weighted[best_i])}")
+
+        def lazy_scores():
+            return {
+                names[int(i)]: int(weighted[i]) for i in np.flatnonzero(mask)
+            }
+
+        def lazy_topk(k):
+            import heapq
+
+            return heapq.nsmallest(
+                k,
+                ((names[int(i)], int(weighted[i]))
+                 for i in np.flatnonzero(mask)),
+                key=lambda kv: (-kv[1], kv[0]),
+            )
+
+        if lc is not None:
+            lc.stage(key, "scored", node=best_name)
+        prev = self.cluster.get_pod(key)
+        was_bound = prev is not None and bool(prev.node_name)
+        pre_version = self.cluster.sched_version
+        pre_pod = self.cluster.pod_version
+        if not self.cluster.bind_pod(key, best_name, self._clock()):
+            # same contract as the scalar loop: no snapshot stamp, no
+            # column fold — a phantom pod would poison both caches
+            result = ScheduleResult(key, None, count, "bind failed")
+            result._reasons_fn = lambda: drip.reason_counts(mask, vec)
+            return result
+        self._note_bind(key, best_name, pre_version, was_bound)
+        drip.note_bind(best_i, vec, pre_pod, was_bound)
+        result = ScheduleResult(
+            key, best_name, count,
+            lazy_scores=lazy_scores, lazy_topk=lazy_topk,
+        )
+        result._reasons_fn = lambda: drip.reason_counts(mask, vec)
+        return result
 
 
 @dataclass
@@ -829,6 +1124,7 @@ class BatchScheduler:
 
             fit_tracker = FitTracker(cluster, telemetry=self._telemetry)
         self._fit = fit_tracker
+        self._fit_names: tuple | None = None  # (names_ref, n, list) reuse
 
     def refresh(self) -> None:
         """Bulk re-ingest node annotations (the store is a cache). A
@@ -1728,7 +2024,13 @@ class BatchScheduler:
         if tracker is None:
             return None
         tracker.refresh()
-        rows = tracker.free_copy_counts(list(names[:n]), pod_fit_request(template))
+        # reuse one names-list object per (names, n) so the tracker's
+        # identity-keyed row gather hits across cycles (and across the
+        # recover loop's repeated calls within one storm)
+        cached = self._fit_names
+        if cached is None or cached[0] is not names or cached[1] != n:
+            cached = self._fit_names = (names, n, list(names[:n]))
+        rows = tracker.free_copy_counts(cached[2], pod_fit_request(template))
         if not (rows < UNBOUNDED).any():
             return None
         return rows

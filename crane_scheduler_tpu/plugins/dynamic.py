@@ -65,7 +65,7 @@ class DynamicPlugin:
             # the overload predicate would be judging stale data; fail
             # open and let ResourceFit carry the safety check
             return Status.success()
-        anno = dict(node_info.node.annotations or {})
+        anno = node_info.node.annotations or {}
         ok, metric = oracle.filter_node(anno, self.policy.spec, self._clock())
         if not ok:
             return Status.unschedulable(
@@ -79,5 +79,5 @@ class DynamicPlugin:
             return 0, Status.error("node not found")
         if self._degraded_active():
             return spread_score(node_info), Status.success()
-        anno = dict(node_info.node.annotations or {})
+        anno = node_info.node.annotations or {}
         return oracle.score_node(anno, self.policy.spec, self._clock()), Status.success()

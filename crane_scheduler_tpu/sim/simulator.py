@@ -215,10 +215,15 @@ class Simulator:
         self.cluster.add_pod(pod)
         return pod
 
-    def build_scheduler(self) -> Scheduler:
+    def build_scheduler(self, columnar: bool = True, **kwargs) -> Scheduler:
+        """``columnar=False`` pins the scalar plugin loop — the parity
+        leg of the drip fuzz suite; extra kwargs (``tie_break_seed``,
+        ``telemetry``) pass through to ``Scheduler``."""
         from ..fit import FitTracker, ResourceFitPlugin
 
-        sched = Scheduler(self.cluster, clock=self.clock)
+        sched = Scheduler(
+            self.cluster, clock=self.clock, columnar=columnar, **kwargs
+        )
         # fit predicate first (cheap reject), then load-aware Dynamic —
         # sim nodes carry no allocatable unless a scenario sets it, so
         # the fit Filter fails open and existing runs are unchanged

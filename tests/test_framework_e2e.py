@@ -686,14 +686,14 @@ def test_pipelined_batches_match_sequential():
 
 
 def test_schedule_one_snapshot_cache_reuse_and_invalidation():
-    """Drip scheduling must not rebuild the O(nodes+pods) snapshot per
-    pod: one build serves consecutive schedule_one calls (our own binds
-    fold in incrementally), placements match a cold-cache scheduler
+    """Scalar drip scheduling must not rebuild the O(nodes+pods) snapshot
+    per pod: one build serves consecutive schedule_one calls (our own
+    binds fold in incrementally), placements match a cold-cache scheduler
     exactly, and an external cluster mutation invalidates the cache."""
     from crane_scheduler_tpu.loadstore import encode_annotation
 
     sim = make_sim(4, seed=34)
-    sched = sim.build_scheduler()
+    sched = sim.build_scheduler(columnar=False)
     builds = {"n": 0}
     real_list_pods = sim.cluster.list_pods
 
@@ -725,6 +725,31 @@ def test_schedule_one_snapshot_cache_reuse_and_invalidation():
     )
     sched.schedule_one(sim.make_pod())
     assert builds["n"] == 2
+
+
+def test_schedule_one_columnar_never_builds_pod_snapshot():
+    """The columnar fast path schedules from cached cluster columns: no
+    full list_pods() snapshot build at all, and placements stay identical
+    to the scalar loop's."""
+    sim = make_sim(4, seed=34)
+    sched = sim.build_scheduler()  # columnar default-on
+    builds = {"n": 0}
+    real_list_pods = sim.cluster.list_pods
+
+    def counting(node_name=None):
+        if node_name is None:
+            builds["n"] += 1
+        return real_list_pods(node_name)
+
+    sim.cluster.list_pods = counting
+    results = [sched.schedule_one(sim.make_pod()) for _ in range(6)]
+    assert all(r.node for r in results)
+    assert builds["n"] == 0
+
+    sim2 = make_sim(4, seed=34)
+    scalar = sim2.build_scheduler(columnar=False)
+    cold = [scalar.schedule_one(sim2.make_pod()) for _ in range(6)]
+    assert [r.node for r in results] == [r.node for r in cold]
 
 
 def test_numa_vectors_cache_reuse_and_invalidation(monkeypatch):

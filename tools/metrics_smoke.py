@@ -236,6 +236,42 @@ def main() -> int:
         finally:
             client.stop()
             stub.stop()
+
+        # 7. drip-path families: a columnar Scheduler over the sim
+        # cluster must emit column hit/rebuild counters, and forcing one
+        # scalar fallback must label crane_drip_fallback_total — all
+        # still strict-parseable
+        drip_tel = Telemetry()
+        sched = sim.build_scheduler(telemetry=drip_tel)
+        for _ in range(3):
+            sched.schedule_one(sim.make_pod())
+        drip_stats = sched.drip_stats()  # registering Noop resets these
+        sched.register(type("Noop", (), {"name": "noop"})(), weight=1)
+        sched.schedule_one(sim.make_pod())
+        try:
+            drip_families = parse_exposition(drip_tel.registry.render())
+            check("drip registry strict parse", True,
+                  f"{len(drip_families)} families")
+        except ExpositionError as e:
+            drip_families = {}
+            check("drip registry strict parse", False, str(e))
+        for required in (
+            "crane_drip_column_hits_total",
+            "crane_drip_column_rebuilds_total",
+            "crane_drip_fallback_total",
+        ):
+            check(f"family {required}", required in drip_families)
+        check("drip columns hit", drip_stats["hits"] >= 2,
+              str(drip_stats))
+        fallback_reasons = {
+            dict(s[1]).get("reason"): s[2]
+            for s in drip_families.get(
+                "crane_drip_fallback_total", {}
+            ).get("samples", ())
+        }
+        check("fallback reason label",
+              fallback_reasons.get("unknown_plugin", 0) >= 1,
+              str(fallback_reasons))
     finally:
         server.stop()
 
