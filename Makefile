@@ -9,8 +9,8 @@ IMAGE_ANNOTATOR := $(REGISTRY)/crane-annotator-tpu:$(GIT_VERSION)
 IMAGE_SCHEDULER := $(REGISTRY)/crane-scheduler-tpu:$(GIT_VERSION)
 
 .PHONY: all native test test-fast bench sim e2e metrics-smoke \
-	desched-smoke chaos-smoke trace-smoke dashboards clean images \
-	image-annotator image-scheduler push-images
+	desched-smoke chaos-smoke trace-smoke drip-smoke dashboards \
+	clean images image-annotator image-scheduler push-images
 
 all: native test
 
@@ -41,6 +41,13 @@ metrics-smoke:
 # the controller /metrics for the crane_desched_* families
 desched-smoke:
 	$(PYTHON) tools/metrics_smoke.py --desched
+
+# a tiny pod queue through the jitted batch kernel on CPU JAX: batch
+# placements must equal the per-pod columnar path AND the scalar
+# oracle, folds must be accounted, and the crane_drip_batch_pods /
+# crane_drip_kernel_seconds families must strict-parse
+drip-smoke:
+	$(PYTHON) tools/drip_smoke.py
 
 # scripted prometheus outage through the breaker + degraded-mode
 # controller + health registry; strict-parses the resilience families

@@ -2026,10 +2026,249 @@ def config14(dtype, rtt, node_scales=(5_000, 50_000), n_pods=1_000):
         f"drip speedup gate: {big['speedup_per_pod']}x < 100x at 50k"
 
 
+def config15(dtype, rtt, node_scales=(5_000, 50_000)):
+    """Round-13 tentpole gate: the device-resident drip batch engine
+    through the wire stub — pending pods coalesced into dispatch
+    windows, one jitted mask+argmax+fold program per window (later pods
+    see earlier folds in-program), one D2H transfer, one bulk binding
+    POST batch.
+
+    Per node scale, fresh stub subprocess per leg, identically seeded
+    annotations (same generator as config14, so real score classes and
+    real tie sets exist):
+
+      scalar — ``columnar=False`` schedule_one over a K-pod prefix
+               (the full storm would take minutes at 50k nodes);
+      batch  — ``schedule_queue`` over the full storm, window=128
+               (larger windows amortize the per-window bulk-bind
+               pipeline overhead; the kernel itself is ~flat per pod).
+
+    The timed legs run WITHOUT a tie-break seed: first-max selection is
+    deterministic, so batch placements must equal the scalar prefix
+    node for node with no RNG involved. The seeded slow path — any
+    window whose kernel reports a real tie replays per-pod, consuming
+    the RNG exactly like the scalar loop — is asserted separately on
+    the small scale (three seeded legs, placements AND replay counter
+    checked) so the optimistic split is exercised in-run without
+    polluting the timing.
+
+    Gates: batch <0.5 ms/pod at 50k nodes (columnar baseline 3.87),
+    >=5k binds/s sustained at 5k nodes, placement-prefix parity at both
+    scales, seeded-replay parity, zero duplicate binding POSTs (stub
+    oracle) and bind_posts == pods on every leg, zero scalar fallbacks,
+    and every accepted bind folded exactly once."""
+    from crane_scheduler_tpu.cluster import (
+        Container,
+        Pod,
+        ResourceRequirements,
+    )
+    from crane_scheduler_tpu.cluster.kube import KubeClusterClient
+    from crane_scheduler_tpu.fit import FitTracker, ResourceFitPlugin
+    from crane_scheduler_tpu.framework.scheduler import Scheduler
+    from crane_scheduler_tpu.plugins import DynamicPlugin
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+    from crane_scheduler_tpu.utils import parse_local_time
+
+    kube_stub = _load_kube_stub()
+    metric_names = [sp.name for sp in DEFAULT_POLICY.spec.sync_period]
+    now = parse_local_time("2026-07-30T00:00:00Z") + 30.0
+
+    def make_pod(i):
+        return Pod(
+            name=f"drip-{i:04d}", namespace="default",
+            containers=(Container("c", ResourceRequirements(
+                requests={"cpu": "100m", "memory": "128Mi"},
+            )),),
+        )
+
+    def leg(n_nodes, count, mode, seed=None, window=128):
+        """mode: scalar | perpod | queue."""
+        server = kube_stub.KubeStubSubprocess()
+        try:
+            # real allocatable so the bounded fit path runs (folds have
+            # consequences: a filled node stops winning) and the warm-up
+            # pods below can be made genuinely infeasible
+            server.seed(
+                n_nodes, "node-", metrics=metric_names,
+                allocatable={"cpu": "16", "memory": "64Gi",
+                             "ephemeral-storage": "100Gi", "pods": "110"},
+            )
+            client = KubeClusterClient(server.url, list_page_limit=2000)
+            client.start()
+            assert len(client.list_nodes()) == n_nodes
+            sched = Scheduler(
+                client, clock=lambda: now, columnar=(mode != "scalar"),
+                tie_break_seed=seed,
+            )
+            sched.register(ResourceFitPlugin(FitTracker(client)), weight=1)
+            sched.register(
+                DynamicPlugin(DEFAULT_POLICY, clock=lambda: now), weight=3
+            )
+            pods = [make_pod(i) for i in range(count)]
+            for pod in pods:
+                client.add_pod(pod)
+            pre_disp = 0
+            if mode == "queue":
+                # Warm the one-time costs outside the timed storm: the
+                # first ensure() builds the O(n) drip columns and the
+                # first dispatch jit-compiles this shape bucket. The
+                # warm pods request more CPU than any node offers, so
+                # every verdict is "infeasible" — no binds, no folds,
+                # no cluster-state change: the storm below starts from
+                # exactly the seeded cluster, which is what keeps the
+                # scalar placement-prefix parity valid. ("Sustained"
+                # throughput is the steady state; the one-time costs
+                # are real but amortize over a scheduler's lifetime.)
+                warm = [
+                    Pod(
+                        name=f"warm-{i:03d}", namespace="default",
+                        containers=(Container("c", ResourceRequirements(
+                            requests={"cpu": "100000", "memory": "128Mi"},
+                        )),),
+                    )
+                    for i in range(window)
+                ]
+                for pod in warm:
+                    client.add_pod(pod)
+                warm_res = sched.schedule_queue(warm, window=window)
+                assert all(r.node is None for r in warm_res), \
+                    "warm-up pod unexpectedly placed (would break parity)"
+                pre_disp = sched.drip_stats()["batch"]["dispatches"]
+            t0 = time.perf_counter()
+            if mode == "queue":
+                results = sched.schedule_queue(pods, window=window)
+            else:
+                results = [sched.schedule_one(p) for p in pods]
+            wall_s = time.perf_counter() - t0
+            placements = []
+            for i, r in enumerate(results):
+                assert r.node is not None, f"pod {i} unplaced: {r.reason}"
+                placements.append(r.node)
+            stats = server.stats()
+            assert stats["duplicate_binds"] == 0, "double-POSTed bind!"
+            assert stats["bind_posts"] == count, \
+                f"bind POSTs {stats['bind_posts']} != {count} pods"
+            drip = sched.drip_stats()
+            if mode != "scalar":
+                assert not drip["fallbacks"], \
+                    f"unexpected scalar fallbacks: {drip['fallbacks']}"
+                assert drip["folds"] == count, \
+                    f"folds {drip['folds']} != {count} accepted binds"
+            if mode == "queue":
+                assert drip["batch"]["dispatches"] > pre_disp, \
+                    "kernel never ran on the storm"
+            client.stop()
+            b = drip.get("batch", {})
+            # drop the warm-up dispatches: storm numbers only
+            ks = list(b.get("kernel_seconds", ()))[pre_disp:]
+            # steady-state wall: the first dispatch per shape bucket
+            # carries the one-time jit compile; "sustained" throughput
+            # replaces it with the mean warm dispatch
+            steady_s = wall_s
+            if len(ks) > 1:
+                warm_mean = sum(ks[1:]) / len(ks[1:])
+                steady_s = wall_s - (ks[0] - warm_mean)
+            return {
+                "pods": count,
+                "wall_ms": round(wall_s * 1e3, 1),
+                "per_pod_ms": round(wall_s * 1e3 / count, 3),
+                "per_pod_ms_steady": round(steady_s * 1e3 / count, 3),
+                "pods_per_sec": round(count / wall_s, 1),
+                "pods_per_sec_steady": round(count / steady_s, 1),
+                "dispatches": b.get("dispatches", 0) - pre_disp,
+                "replays": b.get("replays", 0),
+                "kernel_ms_mean": round(
+                    sum(ks) * 1e3 / max(1, len(ks)), 2),
+                "kernel_ms_warm": round(
+                    sum(ks[1:]) * 1e3 / len(ks[1:]), 2) if len(ks) > 1
+                else None,
+                "folds": drip.get("folds", 0),
+            }, placements, drip
+        finally:
+            server.stop()
+
+    results = {}
+    for n_nodes in node_scales:
+        k = 40 if n_nodes <= 5_000 else 5
+        n_pods = 2_000 if n_nodes <= 5_000 else 1_000
+        scalar, scalar_placed, _ = leg(n_nodes, k, "scalar")
+        batch, batch_placed, drip = leg(n_nodes, n_pods, "queue")
+        assert batch_placed[:k] == scalar_placed, \
+            f"placement divergence at {n_nodes} nodes: " \
+            f"{scalar_placed} != {batch_placed[:k]}"
+        assert batch["replays"] == 0, \
+            "unseeded leg must never take the replay slow path"
+        speedup = round(3.87 / batch["per_pod_ms_steady"], 1) \
+            if n_nodes == 50_000 else None
+        results[n_nodes] = {
+            "scalar": scalar,
+            "batch": batch,
+            "placement_prefix": "ok",
+            "vs_columnar_baseline": speedup,
+        }
+        log(f"config15[{n_nodes}n]: scalar {scalar['per_pod_ms']:.1f} "
+            f"ms/pod (K={k}), batch {batch['per_pod_ms_steady']:.3f} "
+            f"ms/pod steady ({batch['per_pod_ms']:.3f} incl. compile) "
+            f"x {n_pods} pods ({batch['pods_per_sec_steady']:,.0f} "
+            f"binds/s, {batch['dispatches']} windows, kernel "
+            f"{batch['kernel_ms_warm']} ms warm), folds "
+            f"{batch['folds']}")
+
+    # seeded slow path: three legs over the same 5k mirror, identical
+    # tie_break_seed — placements must match call for call, and the
+    # queue leg must actually have hit the replay path (the seeded
+    # cluster has real tie sets)
+    seed = 15
+    small = min(node_scales)
+    _, sca_placed, _ = leg(small, 40, "scalar", seed=seed)
+    _, col_placed, _ = leg(small, 40, "perpod", seed=seed)
+    q, q_placed, q_drip = leg(small, 40, "queue", seed=seed, window=8)
+    assert sca_placed == col_placed == q_placed, \
+        "seeded placement divergence between scalar/per-pod/queue legs"
+    assert q["replays"] > 0, \
+        "seeded leg never exercised the tie replay slow path"
+    log(f"config15[seeded]: 40 pods x 3 legs bit-identical, "
+        f"{q['replays']} window replays")
+
+    big = results[max(node_scales)]
+    small_r = results[min(node_scales)]
+    emit({"config": 15,
+          "desc": "device-resident drip batch engine through the wire "
+                  "stub: schedule_queue dispatch windows (jitted "
+                  "mask+argmax+fold, one D2H per window, bulk binding "
+                  "POSTs) vs scalar plugin-loop prefix, per node scale "
+                  f"{'/'.join(str(n) for n in node_scales)}",
+          "per_pod_ms": big["batch"]["per_pod_ms_steady"],
+          "per_pod_ms_incl_compile": big["batch"]["per_pod_ms"],
+          "pods_per_sec_5k": small_r["batch"]["pods_per_sec_steady"],
+          "pods_per_sec_50k": big["batch"]["pods_per_sec_steady"],
+          "kernel_ms_warm_50k": big["batch"]["kernel_ms_warm"],
+          "dispatch_windows_50k": big["batch"]["dispatches"],
+          "vs_columnar_baseline_ms": 3.87,
+          "speedup_vs_columnar": big["vs_columnar_baseline"],
+          "scales": {str(n): v for n, v in results.items()},
+          "placement_prefix_parity": "ok",
+          "seeded_replay_parity": "ok",
+          "note": "gates: batch <0.5 ms/pod sustained at 50k (columnar "
+                  "baseline 3.87; one-time jit compile accounted "
+                  "separately as per_pod_ms_incl_compile), >=5000 "
+                  "binds/s sustained at 5k, placement prefixes "
+                  "bit-identical to the scalar oracle, seeded tie "
+                  "windows replay per-pod with identical RNG "
+                  "consumption, zero duplicate binding POSTs, every "
+                  "accepted bind folded exactly once"})
+    assert big["batch"]["per_pod_ms_steady"] < 0.5, \
+        f"drip batch gate: {big['batch']['per_pod_ms_steady']} ms/pod " \
+        f">= 0.5 sustained at 50k"
+    assert small_r["batch"]["pods_per_sec_steady"] >= 5_000, \
+        f"bind throughput gate: " \
+        f"{small_r['batch']['pods_per_sec_steady']} < 5000/s"
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--device", choices=["cpu", "default"], default="default")
-    parser.add_argument("--configs", default="1,2,3,4,5,6,7,7b,8,9,10,11,12,13,14")
+    parser.add_argument("--configs", default="1,2,3,4,5,6,7,7b,8,9,10,11,12,13,14,15")
     parser.add_argument("--f64", action="store_true")
     args = parser.parse_args(argv)
 
@@ -2075,6 +2314,8 @@ def main(argv=None) -> int:
         config13(dtype, rtt)
     if 14 in todo:
         config14(dtype, rtt)
+    if 15 in todo:
+        config15(dtype, rtt)
     return 0
 
 

@@ -928,6 +928,18 @@ class KubeClusterClient:
         self._event_rv_trusted = True
         self._event_expect_replay = True  # initial list = a replay window
         self._seen_lock = threading.Lock()
+        # bulk-bind echo suppression: pod_key -> node_name registered
+        # BEFORE the binding POSTs go out. The stub/apiserver echoes the
+        # bound pod on the watch within ~1 ms — often before
+        # ``bind_pods`` reaches its own optimistic mirror apply — and
+        # applying that echo as a change would bump pod_version a second
+        # time per bind, tearing the scheduler's incremental fit-fold
+        # discipline (each dispatch window would drop + rebuild the fit
+        # column). An echo that matches the expected (key, node) IS the
+        # optimistic apply, so it is confirmed (lifecycle) but not
+        # re-applied. Entries are removed in the same bind_pods call.
+        self._expected_binds: dict = {}
+        self._expected_lock = threading.Lock()
         # write pool: --concurrent-syncs keep-alive workers, spawned on
         # first write (read-only clients never pay the threads)
         self._write_workers = max(1, int(concurrent_syncs))
@@ -2030,7 +2042,9 @@ class KubeClusterClient:
             if self._m_watch_batch_pods is not None:
                 self._m_watch_batch_pods.observe(len(batch))
             self._confirm_placements(batch)
-            self._mirror.apply_pod_changes(batch)
+            batch = self._drop_expected_echoes(batch)
+            if batch:
+                self._mirror.apply_pod_changes(batch)
 
     def _invalidate_node_rvs(self, names) -> None:
         """Drop rv-reuse entries for nodes touched outside the relist
@@ -2071,20 +2085,43 @@ class KubeClusterClient:
             if t != "DELETED" and pod.node_name
         )
 
+    def _drop_expected_echoes(self, decoded: list) -> list:
+        """Filter watch pod changes that are echoes of an in-flight
+        ``bind_pods`` batch (same pod, same node as the registered
+        expectation): the optimistic mirror apply IS that change, so
+        applying the echo too would double-bump pod_version per bind.
+        Lifecycle confirmation must still run on the full list —
+        callers confirm BEFORE filtering."""
+        if not self._expected_binds:
+            return decoded
+        with self._expected_lock:
+            expected = dict(self._expected_binds)
+        return [
+            (t, pod) for t, pod in decoded
+            if t == "DELETED"
+            or not pod.node_name
+            or expected.get(pod.key()) != pod.node_name
+        ]
+
     def _apply_pod(self, change_type: str, obj: dict) -> None:
         pod = pod_from_json(obj)
         if change_type == "DELETED":
             self._mirror.delete_pod(pod.key())
         else:
-            self._mirror.add_pod(pod)
             self._confirm_placements(((change_type, pod),))
+            for _t, p in self._drop_expected_echoes(
+                [(change_type, pod)]
+            ):
+                self._mirror.add_pod(p)
 
     def _apply_pod_batch(self, changes: list) -> None:
         if self._m_watch_batch_pods is not None:
             self._m_watch_batch_pods.observe(len(changes))
         decoded = [(t, pod_from_json(o)) for t, o in changes]
         self._confirm_placements(decoded)
-        self._mirror.apply_pod_changes(decoded)
+        decoded = self._drop_expected_echoes(decoded)
+        if decoded:
+            self._mirror.apply_pod_changes(decoded)
 
     def _apply_nrt(self, change_type: str, obj: dict) -> None:
         nrt = nrt_from_json(obj)
@@ -2782,13 +2819,24 @@ class KubeClusterClient:
                 f"/api/v1/namespaces/{namespace}/pods/{name}/binding",
                 self._render_binding_body(namespace, name, node_name),
             ))
-        ok = self._post_batch(items)
-        bound = []
-        bound_pairs = []
-        for (pod_key, node_name), good in zip(pairs, ok):
-            if good:
-                bound.append(pod_key)
-                bound_pairs.append((pod_key, node_name))
-        if bound_pairs:
-            self._mirror.bind_pods(bound_pairs, now, notify=False)
+        # register expectations BEFORE the POSTs: the apiserver echoes
+        # each bound pod on the watch within ~1 ms — usually before this
+        # thread reaches the optimistic apply below — and that echo must
+        # not count as a second pod change (see _drop_expected_echoes)
+        with self._expected_lock:
+            self._expected_binds.update(pairs)
+        try:
+            ok = self._post_batch(items)
+            bound = []
+            bound_pairs = []
+            for (pod_key, node_name), good in zip(pairs, ok):
+                if good:
+                    bound.append(pod_key)
+                    bound_pairs.append((pod_key, node_name))
+            if bound_pairs:
+                self._mirror.bind_pods(bound_pairs, now, notify=False)
+        finally:
+            with self._expected_lock:
+                for pod_key, _node in pairs:
+                    self._expected_binds.pop(pod_key, None)
         return bound

@@ -101,6 +101,38 @@ def row_fail_reason(free_row, vec) -> str:
     return ""
 
 
+def rows_fail_codes(free: np.ndarray, vec: np.ndarray) -> np.ndarray:
+    """Vectorized ``row_fail_reason``: int8[N] of first-failing dims
+    (-1 = fits) in the same check order — pods slot first, then
+    cpu/memory/ephemeral-storage. One pass over the free matrix instead
+    of a Python loop per row; ``fail_code_reason`` maps codes back to
+    the exact scalar wording."""
+    codes = np.full((free.shape[0],), -1, dtype=np.int8)
+    # reverse priority order, later writes win
+    for d in (_DIM_EPH, _DIM_MEM, _DIM_CPU):
+        if vec[d] > 0:
+            codes[vec[d] > free[:, d]] = d
+    codes[free[:, _DIM_PODS] < vec[_DIM_PODS]] = _DIM_PODS
+    return codes
+
+
+def fail_code_reason(code: int) -> str:
+    """``row_fail_reason`` wording for a ``rows_fail_codes`` entry."""
+    if code == _DIM_PODS:
+        return "Too many pods"
+    return f"Insufficient {_DIM_NAMES[code]}"
+
+
+def request_matrix(requests) -> np.ndarray:
+    """Stacked ``request_vec`` rows, int64[K, 4] — the drip batch
+    kernel's per-window pod queue."""
+    reqs = list(requests)
+    mat = np.zeros((len(reqs), _N_DIMS), dtype=np.int64)
+    for i, r in enumerate(reqs):
+        mat[i] = request_vec(r)
+    return mat
+
+
 class FitTracker:
     """Columnar free-allocatable accounting over a cluster mirror.
 

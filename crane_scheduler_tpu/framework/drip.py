@@ -38,13 +38,29 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..fit.tracker import request_vec, row_fail_reason
+from ..fit.tracker import (
+    fail_code_reason,
+    request_vec,
+    row_fail_reason,
+    rows_fail_codes,
+)
 from ..loadstore.store import NodeLoadStore
 from ..policy.compile import compile_policy
-from ..scorer.columns import drip_filter_score_columns, fail_metric_name
+from ..scorer.columns import (
+    drip_filter_score_columns,
+    fail_metric_name,
+    fail_metric_names,
+)
+from ..scorer.topk import SegMaxTree
 from ..telemetry import maybe_span
 
 __all__ = ["DripColumns"]
+
+_I64_MIN = np.int64(np.iinfo(np.int64).min)
+
+# distinct request shapes worth keeping incremental trees for; beyond
+# this the per-fold maintenance would outweigh the argmax it replaces
+_MAX_TREES = 8
 
 
 class DripColumns:
@@ -99,7 +115,17 @@ class DripColumns:
         self.bounded: np.ndarray | None = None  # bool [N]
         self.free: np.ndarray | None = None  # int64 [N, 4]
 
-        self.stats = {"hits": 0, "rebuilds": 0, "folds": 0, "drops": 0}
+        # incremental first-argmax trees, one per distinct request vec
+        # (scorer.topk.SegMaxTree): valid only for the exact column
+        # arrays they were built over — identity-keyed like the device
+        # column cache, since rebuilds always replace arrays
+        self._trees: dict[bytes, tuple] = {}
+        self._trees_cols: tuple | None = None
+
+        self.stats = {
+            "hits": 0, "rebuilds": 0, "folds": 0, "drops": 0,
+            "topk_builds": 0, "topk_updates": 0,
+        }
         self._m_hits = self._m_rebuilds = None
         if telemetry is not None:
             reg = telemetry.registry
@@ -212,14 +238,50 @@ class DripColumns:
             or self._fit_pod_ver != pre_pod
             or self.cluster.pod_version != pre_pod + 1
         ):
-            self.free = None
-            self.bounded = None
-            self._fit_pod_ver = -1
-            self.stats["drops"] += 1
+            self.drop_fit()
             return
-        self.free[best_i] -= vec
+        self.fold_row(best_i, vec)
         self._fit_pod_ver = pre_pod + 1
+
+    def fold_row(self, best_i: int, vec: np.ndarray) -> None:
+        """Unchecked single fold. ``note_bind`` validates the version
+        stamp per pod; the batch dispatch window validates pre ->
+        pre+n_bound ONCE and then replays the kernel's sequential folds
+        row by row (so infeasible-pod reasons later in the window read
+        the same free state the per-pod path would have)."""
+        self.free[best_i] -= vec
         self.stats["folds"] += 1
+        if self._trees:
+            self._update_trees(best_i)
+
+    def commit_folds(self, pod_ver: int) -> None:
+        """Stamp the fit column after a batch window's folds."""
+        self._fit_pod_ver = int(pod_ver)
+
+    def drop_fit(self) -> None:
+        """Invalidate the fit column (interleaved writer / re-placement
+        / partial window bind) — next ``ensure`` rebuilds from the
+        tracker."""
+        self.free = None
+        self.bounded = None
+        self._fit_pod_ver = -1
+        self.stats["drops"] += 1
+        self._trees.clear()
+
+    def _update_trees(self, best_i: int) -> None:
+        """O(log n) per cached tree: re-mask only the folded row."""
+        sched_i = bool(self.schedulable[best_i])
+        bnd_i = bool(self.bounded[best_i]) if self.bounded is not None else False
+        w_i = int(self.weighted[best_i])
+        free_i = self.free[best_i]
+        for tree, tvec in self._trees.values():
+            if tvec is None:
+                continue  # no fit dimension in this tree's mask
+            feas = sched_i and not (
+                bnd_i and bool(((tvec > 0) & (free_i < tvec)).any())
+            )
+            tree.update(best_i, w_i, feas)
+            self.stats["topk_updates"] += 1
 
     # -- per-pod reads -----------------------------------------------------
 
@@ -232,6 +294,51 @@ class DripColumns:
             )
             mask = mask & ~fit_fail
         return mask
+
+    def mask_closure(self, vec: np.ndarray | None):
+        """Lazy ``feasible_mask`` capturing the CURRENT column arrays:
+        decision-trace closures may run after later folds or drops, and
+        rebuilds replace arrays (never resize), so the captured objects
+        always stay mutually aligned. The O(n) mask is paid only when a
+        sampled trace is actually materialized."""
+        schedulable = self.schedulable
+        bounded = self.bounded
+        free = self.free
+        has_fit = self._tracker is not None and vec is not None
+
+        def _mask():
+            m = schedulable
+            if has_fit and bounded is not None and free is not None:
+                m = m & ~(bounded & ((vec > 0) & (free < vec)).any(axis=1))
+            return m
+
+        return _mask
+
+    def topk_for(self, vec: np.ndarray | None) -> SegMaxTree:
+        """Incremental first-argmax tree for request row ``vec`` —
+        O(n) vectorized build on first sight of a (columns, vec) pair,
+        then O(log n) maintenance per fold, so a storm of same-shaped
+        pods pays one build instead of a fresh O(n) argmax each. The
+        tree reproduces every selection read bit-identically: first-max
+        argmax, feasible count, tie count, r-th tie."""
+        cols = (id(self.weighted), id(self.free))
+        if self._trees_cols != cols:
+            self._trees.clear()
+            self._trees_cols = cols
+        key = b"" if vec is None else vec.tobytes()
+        ent = self._trees.get(key)
+        if ent is not None:
+            return ent[0]
+        mask = self.feasible_mask(vec)
+        values = np.where(mask, self.weighted, _I64_MIN)
+        tree = SegMaxTree(values, mask)
+        if len(self._trees) >= _MAX_TREES:
+            self._trees.pop(next(iter(self._trees)))
+        self._trees[key] = (
+            tree, None if vec is None or self._tracker is None else vec.copy()
+        )
+        self.stats["topk_builds"] += 1
+        return tree
 
     def reason_for(self, i: int, vec: np.ndarray) -> str:
         """The scalar loop's Filter failure message for node row ``i`` —
@@ -252,7 +359,59 @@ class DripColumns:
 
     def reason_counts(self, mask: np.ndarray, vec: np.ndarray) -> dict:
         """Filter-reason histogram over infeasible nodes (the decision
-        trace's ``filter_reasons``), materialized lazily by callers."""
+        trace's ``filter_reasons``), materialized lazily by callers.
+
+        Vectorized: one ``rows_fail_codes`` pass over the infeasible fit
+        rows plus the cached ``fail_entry`` column give each node's
+        first-failing (plugin, code) pair with no per-row Python (the
+        bincount-able representation); the only remaining loop is the
+        final message formatting, in node-index order so dict insertion
+        order matches the scalar loop. ``reason_counts_loop`` is the
+        retained per-row oracle the parity test pins this to."""
+        idx = np.flatnonzero(~mask)
+        if idx.size == 0:
+            return {}
+        entries = self.fail_entry[idx]
+        has_fit = (
+            "fit" in self._order
+            and self.bounded is not None
+            and vec is not None
+        )
+        if has_fit:
+            fit_codes = rows_fail_codes(self.free[idx], vec)
+            fit_codes[~self.bounded[idx]] = -1
+        else:
+            fit_codes = np.full((idx.size,), -1, dtype=np.int8)
+        # first failing plugin in registration order, per node
+        if self._order and self._order[0] == "fit":
+            use_fit = fit_codes >= 0
+            use_dyn = ~use_fit & (entries >= 0)
+        else:
+            use_dyn = entries >= 0
+            use_fit = ~use_dyn & (fit_codes >= 0)
+        kinds = np.where(use_dyn, 1, np.where(use_fit, 2, 0))
+        metric_table = fail_metric_names(self._tensors)
+        fit_table = [fail_code_reason(c) for c in range(4)]
+        names = self.names
+        counts: dict[str, int] = {}
+        for p in np.flatnonzero(kinds):
+            i = int(idx[p])
+            if kinds[p] == 1:
+                reason = (
+                    f"Load[{metric_table[int(entries[p])]}] of "
+                    f"node[{names[i]}] is too high"
+                )
+            else:
+                reason = (
+                    f"Node {names[i]} fit failure: "
+                    f"{fit_table[int(fit_codes[p])]}"
+                )
+            counts[reason] = counts.get(reason, 0) + 1
+        return counts
+
+    def reason_counts_loop(self, mask: np.ndarray, vec: np.ndarray) -> dict:
+        """Per-row oracle for ``reason_counts`` (kept for the parity
+        test): the original ``reason_for`` loop over infeasible rows."""
         counts: dict[str, int] = {}
         for i in np.flatnonzero(~mask):
             reason = self.reason_for(int(i), vec)

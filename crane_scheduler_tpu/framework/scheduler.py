@@ -514,8 +514,18 @@ class Scheduler:
         self._telemetry = (
             telemetry if telemetry is not None else active_telemetry()
         )
+        # device-resident batch engine (scorer.drip_batch), lazy like
+        # the columns; _batch holds the dispatch-window distributions
+        # drip_stats() exposes
+        self._batch_kernel = None
+        self._batch = {
+            "dispatches": 0, "pods": 0, "replays": 0,
+            "batch_sizes": [], "kernel_seconds": [],
+        }
         self._m_decisions = None
         self._m_fallback = None
+        self._m_batch_pods = None
+        self._m_kernel_s = None
         if self._telemetry is not None:
             reg = self._telemetry.registry
             self._m_decisions = reg.counter(
@@ -527,6 +537,17 @@ class Scheduler:
                 "crane_drip_fallback_total",
                 "schedule_one calls that took the scalar fallback",
                 ("reason",),
+            )
+            self._m_batch_pods = reg.histogram(
+                "crane_drip_batch_pods",
+                "Pods per drip dispatch window",
+                buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+            )
+            self._m_kernel_s = reg.histogram(
+                "crane_drip_kernel_seconds",
+                "Drip batch-kernel wall seconds per dispatch",
+                buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01,
+                         0.025, 0.05, 0.1, 0.25, 1.0),
             )
 
     def register(self, plugin, weight: int = 1) -> None:
@@ -540,13 +561,27 @@ class Scheduler:
         self._recognized = False
 
     def drip_stats(self) -> dict:
-        """Column-cache counters (hits/rebuilds/folds/drops) plus the
-        per-reason scalar-fallback histogram — the telemetry-less twin
-        of the ``crane_drip_*`` metric families."""
-        out = {"hits": 0, "rebuilds": 0, "folds": 0, "drops": 0}
+        """Column-cache counters (hits/rebuilds/folds/drops/topk_*) plus
+        the per-reason scalar-fallback histogram and the batch engine's
+        per-dispatch distributions — the telemetry-less twin of the
+        ``crane_drip_*`` metric families (``batch_sizes`` /
+        ``kernel_seconds`` mirror ``crane_drip_batch_pods`` /
+        ``crane_drip_kernel_seconds``)."""
+        out = {
+            "hits": 0, "rebuilds": 0, "folds": 0, "drops": 0,
+            "topk_builds": 0, "topk_updates": 0,
+        }
         if self._drip is not None:
             out.update(self._drip.stats)
         out["fallbacks"] = dict(self._fallbacks)
+        b = self._batch
+        out["batch"] = {
+            "dispatches": b["dispatches"],
+            "pods": b["pods"],
+            "replays": b["replays"],
+            "batch_sizes": list(b["batch_sizes"]),
+            "kernel_seconds": list(b["kernel_seconds"]),
+        }
         return out
 
     def _recognition(self):
@@ -814,26 +849,63 @@ class Scheduler:
         for un in hooks.unreserve:
             un(state, pod, node_name)
 
-    def _schedule_one_columnar(self, pod: Pod, rec, lc=None) -> ScheduleResult:
-        """Vectorized drip placement over the cached cluster columns —
-        mask AND + argmax instead of the O(plugins × nodes) loop, with
-        bit-identical host selection (argmax returns the FIRST maximum,
-        matching ``max`` over snapshot order; seeded tie-break consumes
-        the RNG exactly like the scalar path: one ``randrange`` per
-        actual tie)."""
+    def _ensure_drip(self, rec):
         from .drip import DripColumns
 
-        dyn, dyn_weight, tracker, order = rec
         drip = self._drip
         if drip is None:
+            dyn, dyn_weight, _tracker, order = rec
             drip = self._drip = DripColumns(
                 self.cluster,
                 dyn,
                 dyn_weight,
                 order,
-                fit_tracker=tracker,
+                fit_tracker=rec[2],
                 telemetry=self._telemetry,
             )
+        return drip
+
+    @staticmethod
+    def _lazy_views(drip, vec):
+        """Decision-trace closures over the current columns: a lazy mask
+        (only materialized when a sampled trace is read) feeding the
+        score dict / top-k / filter-reason builders."""
+        names = drip.names
+        weighted = drip.weighted
+        mask_fn = drip.mask_closure(vec)
+
+        def lazy_scores():
+            return {
+                names[int(i)]: int(weighted[i])
+                for i in np.flatnonzero(mask_fn())
+            }
+
+        def lazy_topk(k):
+            import heapq
+
+            return heapq.nsmallest(
+                k,
+                ((names[int(i)], int(weighted[i]))
+                 for i in np.flatnonzero(mask_fn())),
+                key=lambda kv: (-kv[1], kv[0]),
+            )
+
+        def reasons():
+            return drip.reason_counts(mask_fn(), vec)
+
+        return lazy_scores, lazy_topk, reasons
+
+    def _schedule_one_columnar(self, pod: Pod, rec, lc=None) -> ScheduleResult:
+        """Vectorized drip placement over the cached cluster columns —
+        an incremental segment-max read (O(log n) once the tree for this
+        request shape is built; the previous fresh O(n) argmax survives
+        only as the tree's build pass) with bit-identical host selection:
+        the tree's first-max descent matches ``np.argmax``'s first
+        maximum, and the seeded tie-break consumes the RNG exactly like
+        the scalar path — one ``randrange`` per actual tie, selecting
+        the r-th tie in snapshot order."""
+        dyn, dyn_weight, tracker, order = rec
+        drip = self._ensure_drip(rec)
         # the Dynamic plugin's own clock: the scalar oracle stamps
         # freshness with dyn._clock(), and parity pins to that
         now = dyn._clock()
@@ -845,49 +917,31 @@ class Scheduler:
             from ..fit.tracker import pod_fit_request, request_vec
 
             vec = request_vec(pod_fit_request(pod))
-        mask = drip.feasible_mask(vec)
-        # capture the column arrays this decision used: rebuilds REPLACE
-        # arrays (never resize in place), so the closures below stay
-        # consistent however many pods later the trace is read
+        tree = drip.topk_for(vec)
         weighted = drip.weighted
-        count = int(np.count_nonzero(mask))
+        count = tree.feasible_count
         key = pod.key()
+        lazy_scores, lazy_topk, reasons_fn = self._lazy_views(drip, vec)
         if count == 0:
             # scalar parity: the reported reason is the LAST infeasible
             # node's verdict in snapshot order
             reason = drip.reason_for(n - 1, vec) if n else ""
             result = ScheduleResult(key, None, 0, reason or "no feasible nodes")
-            result._reasons_fn = lambda: drip.reason_counts(mask, vec)
+            result._reasons_fn = reasons_fn
             return result
         if lc is not None:
             lc.stage(key, "filtered")
 
-        w = np.where(mask, weighted, _I64_MIN)
-        best_i = int(np.argmax(w))
+        best_i = tree.argmax_first()
         if self._tie_rng is not None:
-            ties = np.flatnonzero(mask & (weighted == weighted[best_i]))
-            if ties.size > 1:
-                best_i = int(ties[self._tie_rng.randrange(ties.size)])
+            n_ties = tree.tie_count
+            if n_ties > 1:
+                best_i = tree.select_tie(self._tie_rng.randrange(n_ties))
         best_name = names[best_i]
 
         if verbosity() >= 3:
             vlog(3, f"schedule_one {key}: {count} feasible, "
                     f"picked {best_name} score {int(weighted[best_i])}")
-
-        def lazy_scores():
-            return {
-                names[int(i)]: int(weighted[i]) for i in np.flatnonzero(mask)
-            }
-
-        def lazy_topk(k):
-            import heapq
-
-            return heapq.nsmallest(
-                k,
-                ((names[int(i)], int(weighted[i]))
-                 for i in np.flatnonzero(mask)),
-                key=lambda kv: (-kv[1], kv[0]),
-            )
 
         if lc is not None:
             lc.stage(key, "scored", node=best_name)
@@ -899,7 +953,7 @@ class Scheduler:
             # same contract as the scalar loop: no snapshot stamp, no
             # column fold — a phantom pod would poison both caches
             result = ScheduleResult(key, None, count, "bind failed")
-            result._reasons_fn = lambda: drip.reason_counts(mask, vec)
+            result._reasons_fn = reasons_fn
             return result
         self._note_bind(key, best_name, pre_version, was_bound)
         drip.note_bind(best_i, vec, pre_pod, was_bound)
@@ -907,8 +961,232 @@ class Scheduler:
             key, best_name, count,
             lazy_scores=lazy_scores, lazy_topk=lazy_topk,
         )
-        result._reasons_fn = lambda: drip.reason_counts(mask, vec)
+        result._reasons_fn = reasons_fn
         return result
+
+    # -- device-resident batch engine ------------------------------------
+
+    def schedule_queue(
+        self, pods, window: int = 32
+    ) -> list[ScheduleResult]:
+        """Batched drip: coalesce pending pods into dispatch windows for
+        the device-resident batch kernel (``scorer.drip_batch``) — one
+        jitted mask+argmax+fold program per window, one D2H transfer,
+        one bulk ``bind_pods`` — and route everything the columns can't
+        express (daemonset / degraded / scalar-request / unrecognized
+        plugin set / pod re-placement) through ``schedule_one`` at its
+        queue position, preserving the fallback-counter discipline.
+
+        Placements are bit-identical to calling ``schedule_one`` per pod
+        in order: a window only spans pods that observed identical
+        cluster versions (any interleaved write flushes first, so every
+        decision uses columns valid at its enqueue point, exactly like
+        the per-pod path); the kernel folds sequentially in-program so
+        later pods see earlier binds; and under a seeded tie-break any
+        window whose kernel reports a real tie (per-pod tie counts come
+        back with the placements) is replayed through the per-pod
+        columnar path, consuming the RNG call for call — the optimistic
+        fast-path / slow-path split."""
+        results: list[ScheduleResult] = []
+        if not self._columnar or window <= 1:
+            for pod in pods:
+                results.append(self.schedule_one(pod))
+            return results
+        rec = self._recognition()
+        if rec is None:
+            for pod in pods:
+                results.append(self.schedule_one(pod))
+            return results
+        from ..fit.tracker import pod_fit_request, request_vec
+
+        _dyn, _w, tracker, _order = rec
+        cluster = self.cluster
+        buf: list = []  # (pod, request vec) rows of the open window
+        fence = None  # cluster versions the open window observed
+        for pod in pods:
+            fallback = self._columnar_ineligible(pod, rec)
+            if fallback is None:
+                prev = cluster.get_pod(pod.key())
+                if prev is not None and prev.node_name:
+                    # re-placement moves load OFF a node mid-window; the
+                    # per-pod path handles it (and drops the fit column)
+                    fallback = "rebind"
+            cur = (
+                cluster.sched_version,
+                cluster.pod_version,
+                cluster.node_version,
+            )
+            if buf and (fallback is not None or cur != fence):
+                self._dispatch_window(buf, rec, results)
+                buf = []
+            if fallback is not None:
+                # schedule_one re-derives and counts the fallback reason
+                # itself (rebinds stay columnar there)
+                results.append(self.schedule_one(pod))
+                continue
+            if not buf:
+                fence = (
+                    cluster.sched_version,
+                    cluster.pod_version,
+                    cluster.node_version,
+                )
+            vec = (
+                request_vec(pod_fit_request(pod))
+                if tracker is not None else None
+            )
+            buf.append((pod, vec))
+            if len(buf) >= window:
+                self._dispatch_window(buf, rec, results)
+                buf = []
+        if buf:
+            self._dispatch_window(buf, rec, results)
+        return results
+
+    def _dispatch_window(self, buf, rec, results) -> None:
+        """One coalesced window through the jitted kernel: dispatch,
+        then either accept (bulk bind + sequential host folds under the
+        pre -> pre+n_bound stamp discipline) or replay per-pod (seeded
+        tie in the window). The kernel is pure w.r.t. the host columns,
+        so rejecting a window costs only the kernel time."""
+        dyn, _dyn_weight, tracker, _order = rec
+        k = len(buf)
+        drip = self._ensure_drip(rec)
+        tel = self._telemetry
+        lc = getattr(tel, "lifecycle", None) if tel is not None else None
+        now = dyn._clock()
+        with maybe_span(tel, "drip_dispatch", pods=k):
+            drip.ensure(now)
+            names = drip.names
+            n = len(names)
+            vecs = np.zeros((k, 4), dtype=np.int64)
+            if tracker is not None:
+                for i, (_pod, vec) in enumerate(buf):
+                    vecs[i] = vec
+            kern = self._batch_kernel
+            if kern is None:
+                from ..scorer.drip_batch import DripBatchKernel
+
+                kern = self._batch_kernel = DripBatchKernel()
+            chosen, feasible, ties = kern.dispatch(
+                drip.schedulable, drip.weighted,
+                drip.bounded, drip.free, vecs,
+                want_ties=self._tie_rng is not None,
+            )
+        dt = kern.last_kernel_seconds
+        b = self._batch
+        b["dispatches"] += 1
+        b["pods"] += k
+        if len(b["batch_sizes"]) < 4096:
+            b["batch_sizes"].append(k)
+            b["kernel_seconds"].append(dt)
+        if self._m_batch_pods is not None:
+            self._m_batch_pods.observe(k)
+            self._m_kernel_s.observe(dt)
+
+        if self._tie_rng is not None and bool((ties > 1).any()):
+            # a real tie consumes seeded RNG the kernel cannot replay —
+            # re-run the whole window per-pod against the untouched host
+            # columns: bit-identical placements AND RNG consumption
+            kern.mark_desynced()
+            b["replays"] += 1
+            for pod, _vec in buf:
+                results.append(self.schedule_one(pod))
+            return
+
+        if lc is not None:
+            # stage marks must precede the bind POSTs (same rule as the
+            # per-pod path: the confirming watch event may finalize the
+            # record the instant a POST is accepted)
+            for i, (pod, _vec) in enumerate(buf):
+                key = pod.key()
+                lc.seen(key, source="drip")
+                if chosen[i] >= 0:
+                    lc.stage(key, "filtered")
+                    lc.stage(key, "scored", node=names[int(chosen[i])])
+        pairs = [
+            (pod.key(), names[int(chosen[i])])
+            for i, (pod, _vec) in enumerate(buf)
+            if chosen[i] >= 0
+        ]
+        pre_pod = cluster_pre = self.cluster.pod_version
+        bound = (
+            self.cluster.bind_pods(pairs, self._clock()) if pairs else []
+        )
+        bound_set = set(bound)
+        n_bound = len(bound)
+        # fold discipline, checked ONCE for the window: the fit column
+        # must still be at the pre-bind stamp and pod_version must have
+        # moved exactly by our own n_bound binds — then the kernel's
+        # sequential folds replay row by row on the host copy (so an
+        # infeasible pod's reason later in the window reads the same
+        # free state the per-pod path would have seen)
+        ok_folds = (
+            tracker is not None
+            and drip.free is not None
+            and drip._fit_pod_ver == pre_pod
+            and self.cluster.pod_version == cluster_pre + n_bound
+            and n_bound == len(pairs)
+        )
+        for i, (pod, vec) in enumerate(buf):
+            key = pod.key()
+            ci = int(chosen[i])
+            if ci < 0:
+                reason = drip.reason_for(n - 1, vec) if n else ""
+                result = ScheduleResult(
+                    key, None, 0, reason or "no feasible nodes"
+                )
+                _ls, _lt, result._reasons_fn = self._lazy_views(drip, vec)
+            elif key in bound_set:
+                if ok_folds:
+                    drip.fold_row(ci, vec)
+                best_name = names[ci]
+                if lc is not None:
+                    lc.posted(key, node=best_name)
+                lazy_scores, lazy_topk, reasons_fn = self._lazy_views(
+                    drip, vec
+                )
+                result = ScheduleResult(
+                    key, best_name, int(feasible[i]),
+                    lazy_scores=lazy_scores, lazy_topk=lazy_topk,
+                )
+                result._reasons_fn = reasons_fn
+            else:
+                result = ScheduleResult(
+                    key, None, int(feasible[i]), "bind failed"
+                )
+                _ls, _lt, result._reasons_fn = self._lazy_views(drip, vec)
+            if self._m_decisions is not None:
+                self._m_decisions.labels(
+                    outcome="scheduled" if result.node else "failed"
+                ).inc()
+            if tel is not None:
+                def build(result=result):
+                    fr = (
+                        result._reasons_fn()
+                        if result._reasons_fn is not None else {}
+                    )
+                    return dict(
+                        pod=result.pod_key,
+                        node=result.node,
+                        reason=result.reason,
+                        feasible=result.feasible,
+                        top_scores=result.top_scores(5),
+                        staleness_seconds=-1.0,
+                        source="drip",
+                        filter_reasons=fr,
+                    )
+
+                tel.decisions.offer(build)
+            results.append(result)
+        if tracker is not None:
+            if ok_folds:
+                drip.commit_folds(pre_pod + n_bound)
+                # host replayed the kernel's exact integer folds, so the
+                # device fold carry mirrors the host column bit-for-bit
+                kern.mark_synced(drip.free)
+            else:
+                drip.drop_fit()
+                kern.mark_desynced()
 
 
 @dataclass

@@ -781,3 +781,43 @@ class ShardedScheduleStep:
         unassigned = int(packed_host[3 * npad])
         waterline = int(packed_host[3 * npad + 1])
         return schedulable, scores, counts, unassigned, waterline
+
+
+class DeviceColumnCache:
+    """Identity-keyed device mirrors of host numpy columns.
+
+    The drip batch kernel (``scorer.drip_batch``) re-dispatches against
+    the same cluster columns for many windows in a row; re-uploading
+    50k-node columns per window would cost more than the kernel. Column
+    rebuilds always REPLACE the host arrays (``framework.drip`` never
+    resizes in place), so object identity plus an optional caller
+    version is a sound cache key. The slot pins the host array, so an
+    ``id()`` can never be recycled while its key is live.
+
+    ``prepare`` (e.g. pad-to-bucket) runs only on upload, never on a
+    hit.
+    """
+
+    def __init__(self, device=None):
+        self._device = device
+        self._slots: dict[str, tuple] = {}
+        self.uploads = 0
+
+    def put(self, name: str, arr, version=0, prepare=None):
+        """Device array for ``arr``, uploading only when the
+        ``(identity, shape, version)`` key changed since the last call."""
+        key = (id(arr), arr.shape, version)
+        slot = self._slots.get(name)
+        if slot is not None and slot[0] == key:
+            return slot[1]
+        host = arr if prepare is None else prepare(arr)
+        dev = jax.device_put(host, self._device)
+        self._slots[name] = (key, dev, arr)
+        self.uploads += 1
+        return dev
+
+    def drop(self, name: str | None = None) -> None:
+        if name is None:
+            self._slots.clear()
+        else:
+            self._slots.pop(name, None)

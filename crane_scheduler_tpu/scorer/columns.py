@@ -63,3 +63,12 @@ def drip_filter_score_columns(
 def fail_metric_name(tensors: PolicyTensors, entry: int) -> str:
     """Metric name the scalar Filter reports for ``fail_entry`` value."""
     return tensors.metric_names[int(tensors.pred_idx[int(entry)])]
+
+
+def fail_metric_names(tensors: PolicyTensors) -> list[str]:
+    """All ``fail_entry -> metric name`` resolutions at once — the
+    vectorized ``reason_counts`` path does one table build per policy
+    instead of a per-node ``fail_metric_name`` call."""
+    return [
+        tensors.metric_names[int(col)] for col in tensors.pred_idx
+    ]

@@ -1,0 +1,216 @@
+"""Device-resident drip batch kernel: mask + argmax + fold for K pods.
+
+The columnar drip path (PR 10) reduced ``schedule_one`` to a host-side
+mask AND + ``np.argmax`` — but still one Python round-trip per pod. This
+module moves the whole per-pod loop device-side: one jitted program
+takes the cluster columns plus a *queue* of K heterogeneous pending pods
+(padded/bucketed request vectors, per-pod active flags) and runs
+
+    for each pod k (sequentially, ``lax.scan``):
+        fit_fail = bounded & any(vec_k > 0 & free < vec_k)
+        mask     = schedulable & ~fit_fail
+        best     = argmax(where(mask, weighted, INT64_MIN))
+        free[best] -= vec_k                       # the fold
+        emit (best, feasible_count, tie_count)
+
+so later pods in the window see earlier folds exactly like the
+sequential host loop, and the host gets all K verdicts in ONE
+device-to-host transfer (a packed ``[K, 3]`` int64 array). The kernel is
+*pure*: the host columns stay authoritative and untouched until the
+scheduler accepts the window, which is what makes the optimistic
+tie-break replay (see ``framework.scheduler.Scheduler.schedule_queue``)
+free — per-pod ``tie_count`` comes back with the placements, and any
+window containing a real tie under a seeded RNG is simply re-run through
+the per-pod columnar path, consuming the RNG bit-identically.
+
+Shapes are bucketed (nodes and window size each round up to a power of
+two) so the jit cache stays small, and the fold carry can stay
+device-resident across windows: after a fully-accepted window the host
+applies the same integer folds to its own ``free`` copy, so the device
+carry equals the host column exactly and the next dispatch skips the
+``[N, 4]`` upload.
+
+int64 is mandatory (memory bytes exceed int32) but the process-wide
+``jax_enable_x64`` default stays untouched: every trace/call runs inside
+the scoped ``jax.experimental.enable_x64`` context.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import enable_x64
+
+__all__ = ["DripBatchKernel", "drip_batch_dispatch"]
+
+_I64_MIN = np.int64(np.iinfo(np.int64).min)
+
+# shape buckets: small node counts round up to pow2 >= 256; past 4096
+# they round to the next multiple of 4096 instead (pow2 would pad a 50k
+# cluster to 65536 — 31% wasted bandwidth in every scan step — while
+# 4096-multiples cap waste at ~8% and the jit cache at 16 entries per
+# 64k nodes). Windows round to pow2 >= 8.
+_MIN_N_BUCKET = 256
+_N_BUCKET_STEP = 4096
+_MIN_K_BUCKET = 8
+
+
+def _bucket(n: int, floor: int) -> int:
+    m = max(int(n), floor)
+    return 1 << (m - 1).bit_length()
+
+
+def _bucket_nodes(n: int) -> int:
+    if n <= _N_BUCKET_STEP:
+        return _bucket(n, _MIN_N_BUCKET)
+    return -(-int(n) // _N_BUCKET_STEP) * _N_BUCKET_STEP
+
+
+def _pad(arr: np.ndarray, npad: int, fill) -> np.ndarray:
+    if arr.shape[0] == npad:
+        return arr
+    out = np.full((npad,) + arr.shape[1:], fill, dtype=arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("want_ties",))
+def _drip_batch(schedulable, weighted, bounded, free, vecs, active,
+                want_ties=True):
+    """One dispatch window. Padded nodes are ``schedulable=False`` (never
+    selected); padded pods are ``active=False`` (never fold — their
+    emitted rows are garbage the host slices off). ``want_ties`` is
+    static: without a seeded tie-break RNG the per-pod tie count is
+    never read, so the unseeded program drops that whole O(n) reduction
+    per scan step and reports a constant 1."""
+
+    def step(free, xs):
+        vec, act = xs
+        fit_fail = bounded & ((vec > 0) & (free < vec)).any(axis=1)
+        mask = schedulable & ~fit_fail
+        w = jnp.where(mask, weighted, _I64_MIN)
+        best = jnp.argmax(w)  # first maximum, like np.argmax
+        feasible = jnp.sum(mask, dtype=jnp.int64)
+        if want_ties:
+            ties = jnp.sum(mask & (weighted == w[best]), dtype=jnp.int64)
+        else:
+            ties = jnp.ones((), dtype=jnp.int64)
+        # fold only for real, feasible pods; computing the scatter-add
+        # unconditionally with a zeroed delta keeps the trace branch-free
+        delta = jnp.where(act & (feasible > 0), vec, jnp.zeros_like(vec))
+        free = free.at[best].add(-delta)
+        out = jnp.stack(
+            [jnp.where(feasible > 0, best, -1).astype(jnp.int64),
+             feasible, ties]
+        )
+        return free, out
+
+    free, outs = jax.lax.scan(step, free, (vecs, active))
+    return outs, free
+
+
+class DripBatchKernel:
+    """Host wrapper: bucketing, device column placement, fold-carry reuse.
+
+    One instance per ``Scheduler`` (single scheduling loop, like
+    ``DripColumns``). The dynamic/fit columns are cached device-side by
+    identity (``parallel.sharded.DeviceColumnCache`` — rebuilds replace
+    host arrays, so identity is the version). The ``free`` carry is the
+    only column the kernel itself advances: ``mark_synced`` tells the
+    wrapper the host applied the very same folds (exact int64
+    subtraction, so device == host bit-for-bit) and the carry may be
+    reused; anything else — replay, partial bind, column drop — calls
+    ``mark_desynced`` and the next dispatch re-uploads from the host.
+    """
+
+    def __init__(self, device=None):
+        from ..parallel.sharded import DeviceColumnCache
+
+        self._cols = DeviceColumnCache(device)
+        self._free_dev = None  # device fold carry [npad, 4]
+        self._free_src = None  # host free array the carry mirrors
+        self._free_synced = False
+        self.dispatches = 0
+        self.free_uploads = 0
+        self.last_kernel_seconds = 0.0
+
+    def mark_synced(self, host_free) -> None:
+        """Host applied exactly the kernel's folds — carry is reusable."""
+        self._free_src = host_free
+        self._free_synced = True
+
+    def mark_desynced(self) -> None:
+        self._free_synced = False
+        self._free_dev = None
+        self._free_src = None
+
+    def dispatch(
+        self,
+        schedulable: np.ndarray,
+        weighted: np.ndarray,
+        bounded: np.ndarray | None,
+        free: np.ndarray | None,
+        vecs: np.ndarray,
+        want_ties: bool = True,
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Run one window; returns ``(chosen, feasible, ties)`` int64[K]
+        (chosen = -1 where no feasible node; ties is a constant 1 when
+        ``want_ties`` is False). Pure w.r.t. the host columns; the
+        device fold carry advances and is kept for reuse."""
+        n = int(schedulable.shape[0])
+        k = int(vecs.shape[0])
+        npad = _bucket_nodes(n)
+        kpad = _bucket(k, _MIN_K_BUCKET)
+        no_fit = bounded is None or free is None
+        t0 = time.perf_counter()
+        with enable_x64():
+            sched_d = self._cols.put(
+                "schedulable", schedulable,
+                prepare=lambda a: _pad(a, npad, False),
+            )
+            w_d = self._cols.put(
+                "weighted", weighted,
+                prepare=lambda a: _pad(a.astype(np.int64), npad, _I64_MIN),
+            )
+            if no_fit:
+                # tracker-less plugin set: fit never fails
+                bounded = np.zeros((n,), dtype=bool)
+                free = np.zeros((n, 4), dtype=np.int64)
+            bnd_d = self._cols.put(
+                "bounded", bounded, prepare=lambda a: _pad(a, npad, False)
+            )
+            free_d = self._free_dev
+            if (
+                not self._free_synced
+                or free_d is None
+                or self._free_src is not free
+                or free_d.shape[0] != npad
+            ):
+                free_d = jax.device_put(_pad(free, npad, 0))
+                self._free_src = free
+                self.free_uploads += 1
+            vecs_p = _pad(np.ascontiguousarray(vecs, dtype=np.int64), kpad, 0)
+            active = np.zeros((kpad,), dtype=bool)
+            active[:k] = True
+            outs, free_out = _drip_batch(
+                sched_d, w_d, bnd_d, free_d, vecs_p, active,
+                want_ties=want_ties,
+            )
+            outs = np.asarray(outs)  # the single D2H transfer
+        self._free_dev = free_out
+        self._free_synced = True  # provisional; caller desyncs on reject
+        self.last_kernel_seconds = time.perf_counter() - t0
+        self.dispatches += 1
+        return outs[:k, 0], outs[:k, 1], outs[:k, 2]
+
+
+def drip_batch_dispatch(schedulable, weighted, bounded, free, vecs):
+    """One-shot functional entry (bench/tests): no carry reuse."""
+    kern = DripBatchKernel()
+    return kern.dispatch(schedulable, weighted, bounded, free, vecs)

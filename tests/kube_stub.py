@@ -841,6 +841,11 @@ def _make_handler(state: KubeStubState):
                     # per name on every node (read-path benches need
                     # LIST bodies that look like a synced cluster's)
                     metrics = body.get("metrics") or []
+                    # optional uniform status.allocatable (quantity
+                    # strings, e.g. {"cpu": "16", "pods": "110"}) so a
+                    # bench can exercise the bounded fit path; absent =
+                    # historical behavior, nodes stay UNBOUNDED
+                    alloc = body.get("allocatable")
                     with state.lock:
                         for i in range(n):
                             ip = (
@@ -854,14 +859,17 @@ def _make_handler(state: KubeStubState):
                             }
                             # direct insert, no per-node notify: seeding
                             # happens before any client lists/watches
+                            status = {"addresses": [
+                                {"type": "InternalIP", "address": ip}
+                            ]}
+                            if alloc:
+                                status["allocatable"] = dict(alloc)
                             state.nodes[f"{prefix}{i:05d}"] = state._stamp({
                                 "metadata": {
                                     "name": f"{prefix}{i:05d}",
                                     "annotations": anno,
                                 },
-                                "status": {"addresses": [
-                                    {"type": "InternalIP", "address": ip}
-                                ]},
+                                "status": status,
                             })
                         # warm the rendered-LIST cache so a bench's
                         # first bootstrap measures the CLIENT, not this
@@ -1161,12 +1169,14 @@ class KubeStubSubprocess:
         return [self._control(path, body, base=u) for u in self.control_urls]
 
     def seed(self, nodes: int, prefix: str = "node-",
-             metrics: list | None = None) -> dict:
+             metrics: list | None = None,
+             allocatable: dict | None = None) -> dict:
         # every shard holds the full node set (a patch routed to any
         # shard must find its node)
         return self._control_all(
             "/__stub/seed",
-            {"nodes": nodes, "prefix": prefix, "metrics": metrics or []},
+            {"nodes": nodes, "prefix": prefix, "metrics": metrics or [],
+             "allocatable": allocatable},
         )[0]
 
     def stats(self) -> dict:

@@ -1,0 +1,369 @@
+"""Device-resident drip batch engine (scorer.drip_batch +
+Scheduler.schedule_queue): irregular-batch parity fuzz against the
+per-pod columnar path AND the scalar oracle, seeded tie-break replay
+(RNG stream equality), mid-queue concurrent-writer invalidation, the
+SegMaxTree incremental top-k structure, the kernel-vs-host oracle, the
+vectorized reason_counts path, and the batch telemetry families."""
+
+import random
+
+import numpy as np
+import pytest
+
+from crane_scheduler_tpu.framework.scheduler import Scheduler
+from crane_scheduler_tpu.scorer.drip_batch import (
+    DripBatchKernel,
+    drip_batch_dispatch,
+)
+from crane_scheduler_tpu.scorer.topk import SegMaxTree
+from crane_scheduler_tpu.telemetry import Telemetry
+from test_drip_columnar import (
+    METRICS,
+    NOW,
+    _anno,
+    build_cluster,
+    build_scheduler,
+    fuzz_node_specs,
+    fuzz_pod_specs,
+    make_pod,
+    run_leg,
+)
+
+I64_MIN = np.int64(np.iinfo(np.int64).min)
+
+
+def run_queue_leg(cluster, sched, pod_specs, window=32):
+    """Batch leg: pods exist before the queue drains (their creation is
+    the watch event that enqueued them), then one schedule_queue call."""
+    pods = []
+    for spec in pod_specs:
+        pod = make_pod(*spec)
+        cluster.add_pod(pod)
+        pods.append(pod)
+    results = sched.schedule_queue(pods, window=window)
+    return [(r.node, r.feasible, r.reason) for r in results]
+
+
+# -- SegMaxTree --------------------------------------------------------------
+
+
+def test_segmax_tree_matches_argmax_oracle():
+    rng = random.Random(11)
+    for _ in range(60):
+        n = rng.randrange(1, 70)
+        vals = np.array(
+            [rng.choice([0, 1, 5, 5, 9, -3]) for _ in range(n)],
+            dtype=np.int64,
+        )
+        feas = np.array([rng.random() < 0.7 for _ in range(n)])
+        masked = np.where(feas, vals, I64_MIN)
+        tree = SegMaxTree(masked, feas)
+        assert tree.feasible_count == int(feas.sum())
+        if feas.any():
+            ties = np.flatnonzero(masked == masked.max())
+            assert tree.argmax_first() == int(np.argmax(masked))
+            assert tree.tie_count == len(ties)
+            for r in range(len(ties)):
+                assert tree.select_tie(r) == int(ties[r])
+
+
+def test_segmax_tree_update_tracks_folds():
+    rng = random.Random(4)
+    n = 33
+    vals = np.array([rng.randrange(0, 8) for _ in range(n)], dtype=np.int64)
+    feas = np.ones(n, dtype=bool)
+    masked = np.where(feas, vals, I64_MIN)
+    tree = SegMaxTree(masked, feas)
+    for _ in range(200):
+        i = rng.randrange(n)
+        if rng.random() < 0.25:
+            feas[i] = not feas[i]
+        else:
+            vals[i] = rng.randrange(0, 8)
+        masked = np.where(feas, vals, I64_MIN)
+        tree.update(i, masked[i], bool(feas[i]))
+        assert tree.feasible_count == int(feas.sum())
+        if feas.any():
+            assert tree.argmax_first() == int(np.argmax(masked))
+            assert tree.tie_count == int((masked == masked.max()).sum())
+
+
+# -- kernel vs sequential host oracle ----------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_kernel_matches_sequential_host_fold(seed):
+    """The jitted scan's (chosen, feasible, ties) per pod equals the
+    per-pod host loop with explicit folds — including later pods seeing
+    earlier pods' free decrements."""
+    rng = random.Random(seed)
+    n, k = rng.choice([(17, 5), (40, 12)])
+    schedulable = np.array([rng.random() < 0.8 for _ in range(n)])
+    weighted = np.array(
+        [rng.randrange(-(2**33), 2**33) for _ in range(n)], dtype=np.int64
+    )
+    bounded = np.array([rng.random() < 0.7 for _ in range(n)])
+    free = np.array(
+        [[rng.randrange(0, 4000), rng.randrange(0, 2 << 30),
+          rng.randrange(0, 1 << 20), rng.randrange(0, 20)]
+         for _ in range(n)],
+        dtype=np.int64,
+    )
+    vecs = np.array(
+        [[rng.randrange(0, 3000), rng.randrange(0, 1 << 30), 0, 1]
+         for _ in range(k)],
+        dtype=np.int64,
+    )
+
+    chosen, feasible, ties = drip_batch_dispatch(
+        schedulable, weighted, bounded, free.copy(), vecs
+    )
+
+    free_h = free.copy()
+    for i in range(k):
+        vec = vecs[i]
+        fit_fail = bounded & ((vec > 0) & (free_h < vec)).any(axis=1)
+        mask = schedulable & ~fit_fail
+        w = np.where(mask, weighted, I64_MIN)
+        feas = int(mask.sum())
+        assert int(feasible[i]) == feas
+        if feas == 0:
+            assert int(chosen[i]) == -1
+            continue
+        best = int(np.argmax(w))
+        assert int(chosen[i]) == best
+        assert int(ties[i]) == int((mask & (weighted == w[best])).sum())
+        free_h[best] -= vec
+    # device carry equals the host fold replay bit-for-bit
+    kern = DripBatchKernel()
+    kern.dispatch(schedulable, weighted, bounded, free.copy(), vecs)
+    dev_free = np.asarray(kern._free_dev)[: n]
+    assert (dev_free == free_h).all()
+
+
+# -- irregular-batch parity fuzz ---------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 5, 8])
+@pytest.mark.parametrize("window", [4, 32])
+def test_queue_parity_three_legs(seed, window):
+    """schedule_queue placements/feasible/reasons are bit-identical to
+    per-pod columnar AND the scalar oracle across mixed request shapes
+    and interleaved daemonsets (which flush windows and take the scalar
+    fallback at their queue position)."""
+    rng = random.Random(seed)
+    node_specs = fuzz_node_specs(rng, rng.choice([13, 37]))
+    pod_specs = fuzz_pod_specs(rng, 40)
+
+    cq = build_cluster(node_specs)
+    sq = build_scheduler(cq, columnar=True)
+    got = run_queue_leg(cq, sq, pod_specs, window=window)
+
+    cc = build_cluster(node_specs)
+    col = run_leg(cc, build_scheduler(cc, columnar=True), pod_specs)
+
+    cs = build_cluster(node_specs)
+    sca = run_leg(cs, build_scheduler(cs, columnar=False), pod_specs)
+
+    assert got == col == sca
+    st = sq.drip_stats()
+    assert st["batch"]["dispatches"] > 0
+    assert st["batch"]["pods"] == sum(st["batch"]["batch_sizes"])
+    if any(ds for *_x, ds in pod_specs):
+        assert st["fallbacks"].get("daemonset", 0) > 0
+
+
+@pytest.mark.parametrize("seed", [7, 21])
+def test_queue_seeded_tiebreak_replays_and_consumes_rng_identically(seed):
+    """A seeded tie inside a window triggers the optimistic replay: the
+    window re-runs per-pod, so placements AND the RNG stream match both
+    per-pod paths call for call."""
+    specs = [
+        (f"node-{i:02d}", {m: _anno(0.30, 30.0) for m in METRICS}, None)
+        for i in range(10)
+    ]
+    pods = [(f"p{i:03d}", 0, 0, False) for i in range(100)]
+
+    cq = build_cluster(specs)
+    sq = build_scheduler(cq, columnar=True, seed=seed)
+    got = run_queue_leg(cq, sq, pods, window=16)
+
+    cc = build_cluster(specs)
+    sc = build_scheduler(cc, columnar=True, seed=seed)
+    col = run_leg(cc, sc, pods)
+
+    cs = build_cluster(specs)
+    ss = build_scheduler(cs, columnar=False, seed=seed)
+    sca = run_leg(cs, ss, pods)
+
+    assert got == col == sca
+    assert len({node for node, _, _ in got}) > 1
+    assert sq.drip_stats()["batch"]["replays"] > 0
+    assert (
+        sq._tie_rng.getstate()
+        == sc._tie_rng.getstate()
+        == ss._tie_rng.getstate()
+    )
+
+
+def test_queue_concurrent_writer_mid_stream_flushes_and_stays_parity():
+    """A cluster write between queue items (annotation sweep from the
+    watcher thread) moves node_version: the open window flushes first,
+    so every decision still uses columns valid at its enqueue point."""
+    rng = random.Random(13)
+    node_specs = fuzz_node_specs(rng, 17)
+    pod_specs = fuzz_pod_specs(rng, 24)
+    mutate_at = {6: (0, 0.95), 13: (1, 0.05)}  # idx -> (metric, value)
+
+    def leg(columnar, queued):
+        cluster = build_cluster(node_specs)
+        sched = build_scheduler(cluster, columnar=columnar)
+        pods = []
+        for spec in pod_specs:
+            pod = make_pod(*spec)
+            cluster.add_pod(pod)
+            pods.append(pod)
+        if queued:
+            def feed():
+                for i, pod in enumerate(pods):
+                    if i in mutate_at:
+                        m, v = mutate_at[i]
+                        cluster.patch_node_annotation(
+                            node_specs[0][0], METRICS[m], _anno(v, 1.0)
+                        )
+                    yield pod
+
+            rs = sched.schedule_queue(feed(), window=32)
+        else:
+            rs = []
+            for i, pod in enumerate(pods):
+                if i in mutate_at:
+                    m, v = mutate_at[i]
+                    cluster.patch_node_annotation(
+                        node_specs[0][0], METRICS[m], _anno(v, 1.0)
+                    )
+                rs.append(sched.schedule_one(pod))
+        return [(r.node, r.feasible, r.reason) for r in rs], sched
+
+    got, sq = leg(True, True)
+    col, _ = leg(True, False)
+    sca, _ = leg(False, False)
+    assert got == col == sca
+    # the writes really did split the stream into extra windows
+    assert sq.drip_stats()["batch"]["dispatches"] >= 3
+
+
+def test_queue_routes_rebind_through_per_pod_path():
+    """An already-bound pod in the queue (descheduler re-placement) is
+    window-ineligible: it goes through schedule_one, which drops the fit
+    fold, and the rest of the queue still schedules correctly."""
+    specs = [
+        (f"n{i:02d}", {m: _anno(0.1 + 0.05 * i, 30.0) for m in METRICS},
+         {"cpu": "64", "memory": "256Gi", "pods": "500"})
+        for i in range(6)
+    ]
+    cluster = build_cluster(specs)
+    sched = build_scheduler(cluster, columnar=True)
+    mover = make_pod("mover", 500, 1 << 20)
+    cluster.add_pod(mover)
+    assert sched.schedule_one(mover).node is not None
+
+    rest = []
+    for i in range(5):
+        p = make_pod(f"p{i}", 100, 1 << 20)
+        cluster.add_pod(p)
+        rest.append(p)
+    queue = rest[:2] + [cluster.get_pod(mover.key())] + rest[2:]
+    results = sched.schedule_queue(queue, window=8)
+    assert all(r.node for r in results)
+    assert sched.drip_stats()["drops"] == 1  # the rebind dropped the fold
+
+
+# -- fold accounting + device carry reuse ------------------------------------
+
+
+def test_queue_folds_accounted_and_free_carry_reused():
+    """Every accepted bind folds exactly once (batch + per-pod paths
+    share the counter), and on a quiet cluster the device fold carry is
+    uploaded once — later windows reuse the post-fold device state."""
+    specs = [
+        (f"n{i:02d}", {m: _anno(0.1 + 0.02 * i, 30.0) for m in METRICS},
+         {"cpu": "64", "memory": "256Gi", "pods": "500"})
+        for i in range(8)
+    ]
+    cluster = build_cluster(specs)
+    sched = build_scheduler(cluster, columnar=True)
+    pod_specs = [(f"p{i:03d}", 100, 1 << 20, False) for i in range(64)]
+    results = run_queue_leg(cluster, sched, pod_specs, window=16)
+    assert all(node for node, _, _ in results)
+    st = sched.drip_stats()
+    assert st["folds"] == 64
+    assert st["batch"]["dispatches"] == 4
+    assert st["batch"]["pods"] == 64
+    kern = sched._batch_kernel
+    assert kern.dispatches == 4
+    assert kern.free_uploads == 1  # carry reused across windows 2..4
+    # device carry still mirrors the host column bit-for-bit
+    n = len(specs)
+    assert (np.asarray(kern._free_dev)[:n] == sched._drip.free).all()
+
+
+def test_queue_batch_telemetry_families():
+    specs = fuzz_node_specs(random.Random(2), 9)
+    tel = Telemetry()
+    cluster = build_cluster(specs)
+    sched = build_scheduler(cluster, columnar=True, telemetry=tel)
+    run_queue_leg(cluster, sched, fuzz_pod_specs(random.Random(2), 12),
+                  window=4)
+    text = tel.registry.render()
+    assert "crane_drip_batch_pods_bucket" in text
+    assert "crane_drip_kernel_seconds_bucket" in text
+    flat = tel.registry.snapshot()
+    assert flat["crane_drip_batch_pods_count"] >= 1
+    st = sched.drip_stats()
+    assert len(st["batch"]["kernel_seconds"]) == st["batch"]["dispatches"]
+
+
+def test_queue_non_columnar_and_tiny_window_degrade_to_per_pod():
+    specs = fuzz_node_specs(random.Random(6), 7)
+    pod_specs = fuzz_pod_specs(random.Random(6), 8)
+
+    cs = build_cluster(specs)
+    ss = build_scheduler(cs, columnar=False)
+    got = run_queue_leg(cs, ss, pod_specs, window=32)
+    cr = build_cluster(specs)
+    want = run_leg(cr, build_scheduler(cr, columnar=False), pod_specs)
+    assert got == want
+
+    cw = build_cluster(specs)
+    sw = build_scheduler(cw, columnar=True)
+    one = run_queue_leg(cw, sw, pod_specs, window=1)
+    cc = build_cluster(specs)
+    col = run_leg(cc, build_scheduler(cc, columnar=True), pod_specs)
+    assert one == col
+    assert sw.drip_stats()["batch"]["dispatches"] == 0
+
+
+# -- vectorized reason_counts ------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 4, 9])
+def test_reason_counts_vectorized_matches_loop(seed):
+    """The bincount-style reason_counts equals the original per-node
+    loop — same reason strings, same counts, same dict order — across
+    dynamic failures, fit failures, and both plugin orders."""
+    rng = random.Random(seed)
+    node_specs = fuzz_node_specs(rng, 41)
+    cluster = build_cluster(node_specs)
+    sched = build_scheduler(cluster, columnar=True)
+    # tight request so fit failures coexist with dynamic overloads
+    run_leg(cluster, sched, [("probe", 1500, 1 << 30, False)])
+    drip = sched._drip
+    for cpu, mem in ((1500, 1 << 30), (64_000, 0), (0, 0)):
+        # columnar dim order: [milli_cpu, memory, ephemeral, pods]
+        vec = np.array([cpu, mem, 0, 1], dtype=np.int64)
+        mask = drip.mask_closure(vec)()
+        want = drip.reason_counts_loop(mask, vec)
+        got = drip.reason_counts(mask, vec)
+        assert got == want
+        assert list(got) == list(want)  # insertion order too

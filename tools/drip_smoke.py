@@ -1,0 +1,108 @@
+"""Drip-engine smoke gate (``make drip-smoke``): push a tiny pod queue
+through the device-resident batch kernel on CPU JAX and fail CI unless
+
+  * the jitted mask+argmax+fold program actually dispatched (no silent
+    per-pod degradation),
+  * the batched placements are bit-identical to the per-pod columnar
+    path AND the scalar plugin loop over the same queue,
+  * every accepted bind folded exactly once and the device fold carry
+    was reused across windows (one upload), and
+  * the new batch families — ``crane_drip_batch_pods`` and
+    ``crane_drip_kernel_seconds`` — survive the strict exposition
+    parser with at least one observation each.
+
+Exit 0 = every check passed; any violation prints the failure and exits
+nonzero.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from crane_scheduler_tpu.sim.simulator import SimConfig, Simulator
+    from crane_scheduler_tpu.telemetry import Telemetry
+    from crane_scheduler_tpu.telemetry.expfmt import (
+        ExpositionError,
+        parse_exposition,
+    )
+
+    failures = 0
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        nonlocal failures
+        mark = "ok" if ok else "FAIL"
+        print(f"[drip-smoke] {name}: {mark}{' — ' + detail if detail else ''}")
+        if not ok:
+            failures += 1
+
+    def leg(mode: str):
+        """One scheduling leg over an identically-seeded sim cluster;
+        returns (placements, scheduler). mode: queue|perpod|scalar."""
+        sim = Simulator(SimConfig(n_nodes=12, seed=7))
+        sim.sync_metrics()
+        tel = Telemetry() if mode == "queue" else None
+        sched = sim.build_scheduler(
+            columnar=(mode != "scalar"), telemetry=tel
+        )
+        pods = [
+            sim.make_pod(cpu_milli=50 + 25 * i, mem=(16 + i) << 20)
+            for i in range(12)
+        ]
+        if mode == "queue":
+            results = sched.schedule_queue(pods, window=4)
+        else:
+            results = [sched.schedule_one(p) for p in pods]
+        return [(r.node, r.feasible, r.reason) for r in results], sched, tel
+
+    got, sq, tel = leg("queue")
+    col, _, _ = leg("perpod")
+    sca, _, _ = leg("scalar")
+
+    st = sq.drip_stats()
+    batch = st.get("batch", {})
+    check("kernel dispatched", batch.get("dispatches", 0) >= 3,
+          f"dispatches={batch.get('dispatches')}")
+    check("batch parity vs per-pod columnar", got == col)
+    check("batch parity vs scalar oracle", got == sca)
+    check("all pods placed", all(node for node, _, _ in got),
+          f"{sum(1 for n, _, _ in got if n)}/{len(got)}")
+    check("folds accounted", st.get("folds") == len(got),
+          f"folds={st.get('folds')} pods={len(got)}")
+    kern = sq._batch_kernel
+    check("fold carry reused", kern is not None and kern.free_uploads == 1,
+          f"uploads={getattr(kern, 'free_uploads', None)}")
+
+    try:
+        families = parse_exposition(tel.registry.render())
+        check("registry strict parse", True, f"{len(families)} families")
+    except ExpositionError as e:
+        families = {}
+        check("registry strict parse", False, str(e))
+    for required in ("crane_drip_batch_pods", "crane_drip_kernel_seconds"):
+        check(f"family {required}", required in families)
+
+    def hist_count(name: str) -> float:
+        for sample in families.get(name, {}).get("samples", ()):
+            if sample[0].endswith("_count"):
+                return sample[2]
+        return 0.0
+
+    check("batch_pods observations",
+          hist_count("crane_drip_batch_pods") >= 3,
+          f"count={hist_count('crane_drip_batch_pods')}")
+    check("kernel_seconds observations",
+          hist_count("crane_drip_kernel_seconds") >= 3,
+          f"count={hist_count('crane_drip_kernel_seconds')}")
+
+    print(f"[drip-smoke] {'PASS' if not failures else 'FAIL'}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -245,6 +245,9 @@ def main() -> int:
         sched = sim.build_scheduler(telemetry=drip_tel)
         for _ in range(3):
             sched.schedule_one(sim.make_pod())
+        # one batched dispatch window through the device-resident kernel
+        # so the batch histograms have observations
+        sched.schedule_queue([sim.make_pod() for _ in range(4)], window=4)
         drip_stats = sched.drip_stats()  # registering Noop resets these
         sched.register(type("Noop", (), {"name": "noop"})(), weight=1)
         sched.schedule_one(sim.make_pod())
@@ -259,10 +262,15 @@ def main() -> int:
             "crane_drip_column_hits_total",
             "crane_drip_column_rebuilds_total",
             "crane_drip_fallback_total",
+            "crane_drip_batch_pods",
+            "crane_drip_kernel_seconds",
         ):
             check(f"family {required}", required in drip_families)
         check("drip columns hit", drip_stats["hits"] >= 2,
               str(drip_stats))
+        check("drip batch dispatched",
+              drip_stats.get("batch", {}).get("dispatches", 0) >= 1,
+              str(drip_stats.get("batch")))
         fallback_reasons = {
             dict(s[1]).get("reason"): s[2]
             for s in drip_families.get(
