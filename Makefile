@@ -9,7 +9,8 @@ IMAGE_ANNOTATOR := $(REGISTRY)/crane-annotator-tpu:$(GIT_VERSION)
 IMAGE_SCHEDULER := $(REGISTRY)/crane-scheduler-tpu:$(GIT_VERSION)
 
 .PHONY: all native test test-fast bench sim e2e metrics-smoke \
-	desched-smoke chaos-smoke trace-smoke drip-smoke dashboards \
+	desched-smoke chaos-smoke recovery-smoke trace-smoke drip-smoke \
+	dashboards \
 	clean images image-annotator image-scheduler push-images
 
 all: native test
@@ -53,6 +54,12 @@ drip-smoke:
 # controller + health registry; strict-parses the resilience families
 chaos-smoke:
 	$(PYTHON) tools/chaos_smoke.py
+
+# seeded SIGKILL mid bind batch → restart reconciliation against the
+# stub (zero duplicate/lost binds), indeterminate-eviction re-arm,
+# warm-standby failover; strict-parses the crane_recovery_* families
+recovery-smoke:
+	$(PYTHON) tools/recovery_smoke.py
 
 # one pod traced end to end over a live stub apiserver (traceparent on
 # the bind POST, lifecycle record in the flight ring), then replayed

@@ -2265,10 +2265,315 @@ def config15(dtype, rtt, node_scales=(5_000, 50_000)):
         f"{small_r['batch']['pods_per_sec_steady']} < 5000/s"
 
 
+def config16(dtype, rtt, n_nodes=64, kills=8):
+    """Round-14 tentpole gate: the crash-safe placement plane through
+    the wire stub — a kill-recover soak driven by a seeded ChaosPlan of
+    ``kill_process``/``restart_process`` events.
+
+    Three kill sites per soak, each a SIGKILL at a journal byte offset
+    (the KillSwitch tears the in-flight line exactly where a real kill
+    would):
+
+      mid-pipeline-fill — the kill lands inside a bind batch's intent/
+                          outcome journal stream; the restarted process
+                          reconciles unresolved intents against live
+                          GETs and re-POSTs only the provably-unbound;
+      mid-window        — the kill abandons a half-filled DripQueue
+                          window (nothing journaled, nothing POSTed);
+                          the restart's pending sweep re-offers;
+      mid-eviction      — the eviction response is lost in transport
+                          (stub reads the request, never answers); the
+                          restart re-arms the cooldown, never re-POSTs.
+
+    Plus a warm-standby leg: two electors on one lease, the leader
+    dies, the standby reconciles the shared journal directory and lands
+    its first bind.
+
+    Gates: zero duplicate AND zero lost binds across every kill (the
+    stub's per-pod ``bind_posts`` oracle), zero duplicate evictions,
+    failover-to-first-bind <= 5 s on the wire stub, and deterministic
+    replay — the same seed produces the same kill/recover timeline."""
+    import os
+    import shutil
+    import tempfile
+
+    from crane_scheduler_tpu.cluster import (
+        Container,
+        Pod,
+        ResourceRequirements,
+    )
+    from crane_scheduler_tpu.cluster.kube import KubeClusterClient
+    from crane_scheduler_tpu.fit import FitTracker, ResourceFitPlugin
+    from crane_scheduler_tpu.framework.scheduler import Scheduler
+    from crane_scheduler_tpu.plugins import DynamicPlugin
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+    from crane_scheduler_tpu.resilience import ChaosPlan
+    from crane_scheduler_tpu.resilience.recovery import (
+        IntentJournal,
+        KillSwitch,
+        Reconciler,
+        SimulatedCrash,
+        WarmStandby,
+    )
+    from crane_scheduler_tpu.utils import parse_local_time
+
+    kube_stub = _load_kube_stub()
+    metric_names = [sp.name for sp in DEFAULT_POLICY.spec.sync_period]
+    now = parse_local_time("2026-07-30T00:00:00Z") + 30.0
+
+    def die():
+        raise SimulatedCrash("config16 kill")
+
+    def make_pods(ns, count):
+        return [
+            Pod(
+                name=f"soak-{i:04d}", namespace=ns,
+                containers=(Container("c", ResourceRequirements(
+                    requests={"cpu": "100m", "memory": "128Mi"},
+                )),),
+            )
+            for i in range(count)
+        ]
+
+    def build_sched(client):
+        sched = Scheduler(client, clock=lambda: now, columnar=True)
+        sched.register(ResourceFitPlugin(FitTracker(client)), weight=1)
+        sched.register(
+            DynamicPlugin(DEFAULT_POLICY, clock=lambda: now), weight=3
+        )
+        return sched
+
+    def soak(seed, root):
+        """One full kill-recover soak; returns its (pure-data) timeline
+        for the deterministic-replay gate."""
+        plan = ChaosPlan.generate(
+            seed, steps=kills * 4, n_faults=kills,
+            kinds=("kill_process",),
+        )
+        timeline = []
+        server = kube_stub.KubeStubServer().start()
+        try:
+            for i in range(n_nodes):
+                anno = {
+                    m: f"{(i % 97) / 97:.5f},2026-07-30T00:00:00Z"
+                    for m in metric_names
+                }
+                server.state.add_node(
+                    f"node-{i}", f"10.0.0.{i % 250}", annotations=anno,
+                    allocatable={"cpu": "16", "memory": "64Gi",
+                                 "ephemeral-storage": "100Gi",
+                                 "pods": "110"},
+                )
+
+            # -- mid-pipeline-fill: one life per kill_process event ----
+            batch = 8
+            for li, ev in enumerate(
+                e for e in plan.events if e.kind == "kill_process"
+            ):
+                ns = f"kill{li}"
+                jdir = os.path.join(root, ns)
+                for p in make_pods(ns, batch):
+                    server.state.add_pod(ns, p.name)
+                pairs = [(f"{ns}/soak-{i:04d}", f"node-{i % n_nodes}")
+                         for i in range(batch)]
+                # fold the plan's 1..4096 offset into the ~1.1 KB this
+                # batch actually journals, so most kills land mid-stream
+                # (intent phase AND outcome phase) instead of past EOF
+                off = 1 + ev.param("offset") % 1100
+                journal = IntentJournal(jdir)
+                journal.kill_switch = KillSwitch(off, action=die)
+                client = KubeClusterClient(server.url)
+                client.attach_intent_journal(journal)
+                crashed = False
+                try:
+                    client.bind_pods(pairs)
+                except SimulatedCrash:
+                    crashed = True
+                client.stop()
+                journal.close()
+                # restart_process: reconcile BEFORE scheduling reopens
+                journal2 = IntentJournal(jdir)
+                client2 = KubeClusterClient(server.url)
+                client2.attach_intent_journal(journal2)
+                report = Reconciler(
+                    journal2, client2.get_pod_live
+                ).reconcile()
+                redo = {k: n for k, n, _t, _a in report.reschedule}
+                if redo:
+                    client2.bind_pods(list(redo.items()))
+                pending = [
+                    (k, n) for k, n in pairs
+                    if k not in redo
+                    and not client2.get_pod_live(k).node_name
+                ]
+                if pending:
+                    client2.bind_pods(pending)
+                client2.stop()
+                journal2.close()
+                for k, n in pairs:
+                    posts = server.state.bind_posts.get(k, 0)
+                    assert posts == 1, \
+                        f"{k}: {posts} binding POSTs after kill at " \
+                        f"offset {ev.param('offset')}"
+                timeline.append({
+                    "leg": "pipeline", "offset": off,
+                    "crashed": crashed,
+                    "outcomes": dict(sorted(report.outcomes.items())),
+                    "reposted": len(redo), "swept": len(pending),
+                })
+
+            # -- mid-window: SIGKILL with a half-filled drip window ----
+            ns = "window"
+            win_pods = make_pods(ns, 5)
+            for p in win_pods:
+                server.state.add_pod(ns, p.name)
+            client = KubeClusterClient(server.url)
+            client.start()
+            sched = build_sched(client)
+            queue = sched.open_queue(window=64)
+            for p in client.list_pods():
+                if p.namespace == ns:
+                    queue.offer(p)
+            held = len(queue)
+            assert held == 5 and not queue.results, \
+                "window leg: pods dispatched before the kill"
+            # the kill: the queue dies undrained — nothing reached the
+            # wire, so the restart's pending sweep owns all five
+            client.stop()
+            client2 = KubeClusterClient(server.url)
+            client2.start()
+            sched2 = build_sched(client2)
+            queue2 = sched2.open_queue(window=64)
+            for p in client2.list_pods():
+                if p.namespace == ns and not p.node_name:
+                    queue2.offer(p)
+            drained = queue2.drain()
+            bound = [r for r in queue2.take_results() if r.node]
+            client2.stop()
+            assert drained == held == len(bound), \
+                f"window leg: {held} held, {drained} drained, " \
+                f"{len(bound)} bound"
+            for p in win_pods:
+                assert server.state.bind_posts.get(p.key(), 0) == 1
+            timeline.append({"leg": "window", "held": held,
+                             "rebound": len(bound)})
+
+            # -- mid-eviction: response lost in transport --------------
+            ns = "evict"
+            server.state.add_pod(ns, "victim", spec={"nodeName": "node-0"})
+            server.state.inject_write_faults((0, {}))
+            jdir = os.path.join(root, "evict")
+            journal = IntentJournal(jdir)
+            client = KubeClusterClient(server.url)
+            client.attach_intent_journal(journal)
+            assert client.evict_pod(f"{ns}/victim") is False
+            client.stop()
+            journal.close()
+            journal2 = IntentJournal(jdir)
+            client2 = KubeClusterClient(server.url)
+            report = Reconciler(journal2, client2.get_pod_live).reconcile()
+            client2.stop()
+            journal2.close()
+            assert report.rearm_cooldowns == ["node-0"], \
+                f"eviction leg: cooldowns {report.rearm_cooldowns}"
+            assert sum(server.state.evict_posts.values()) == 0, \
+                "eviction leg: a second eviction POST went out"
+            timeline.append({
+                "leg": "eviction",
+                "outcomes": dict(sorted(report.outcomes.items())),
+            })
+
+            dups = server.state.duplicate_binds()
+            dup_ev = server.state.duplicate_evictions()
+            assert dups == 0, f"{dups} duplicate binding POSTs"
+            assert dup_ev == 0, f"{dup_ev} duplicate evictions"
+
+            # -- warm standby: leader dies, standby lands a bind -------
+            server.state.add_pod("failover", "first")
+            lock = os.path.join(root, "leader.lock")
+            jdir = os.path.join(root, "standby-intents")
+            fo_client = KubeClusterClient(server.url)
+            first_bind = []
+
+            def promote(rep):
+                fo_client.attach_intent_journal(standby_b.journal)
+                okb = fo_client.bind_pods([("failover/first", "node-1")])
+                first_bind.append(time.perf_counter())
+                assert okb == ["failover/first"]
+
+            standby_a = WarmStandby(
+                lock, "sched-a", jdir, fo_client.get_pod_live,
+                lease_duration=1.0, renew_deadline=0.6, retry_period=0.1,
+            ).start()
+            assert standby_a.wait_ready(10.0), "leader never led"
+            standby_b = WarmStandby(
+                lock, "sched-b", jdir, fo_client.get_pod_live,
+                on_promote=promote,
+                lease_duration=1.0, renew_deadline=0.6, retry_period=0.1,
+            ).start()
+            t_kill = time.perf_counter()
+            standby_a.stop()  # the leader dies
+            assert standby_b.wait_ready(10.0), "standby never took over"
+            failover_s = first_bind[0] - t_kill
+            standby_b.stop()
+            fo_client.stop()
+            assert server.state.bind_posts.get("failover/first", 0) == 1
+            assert failover_s <= 5.0, \
+                f"failover-to-first-bind {failover_s:.2f}s > 5s"
+            timeline.append({"leg": "failover", "first_bind": "ok"})
+            return timeline, failover_s
+        finally:
+            server.stop()
+
+    seed = 16
+    t0 = time.perf_counter()
+    root1 = tempfile.mkdtemp(prefix="crane-c16a-")
+    root2 = tempfile.mkdtemp(prefix="crane-c16b-")
+    try:
+        timeline1, failover_s = soak(seed, root1)
+        wall_s = time.perf_counter() - t0
+        timeline2, _ = soak(seed, root2)
+        assert timeline1 == timeline2, \
+            "same seed produced different kill/recover timelines"
+    finally:
+        shutil.rmtree(root1, ignore_errors=True)
+        shutil.rmtree(root2, ignore_errors=True)
+
+    pipeline_legs = [t for t in timeline1 if t["leg"] == "pipeline"]
+    reposted = sum(t["reposted"] for t in pipeline_legs)
+    swept = sum(t["swept"] for t in pipeline_legs)
+    crashes = sum(1 for t in pipeline_legs if t["crashed"])
+    log(f"config16: {len(pipeline_legs)} seeded kills ({crashes} landed "
+        f"mid-stream), {reposted} reconciler re-POSTs + {swept} sweep "
+        f"binds, 0 duplicate / 0 lost; failover-to-first-bind "
+        f"{failover_s * 1e3:.0f} ms; timeline deterministic")
+    emit({"config": 16,
+          "desc": "kill-recover soak: seeded kill_process/"
+                  "restart_process plan over the intent journal "
+                  "(mid-pipeline-fill, mid-window, mid-eviction) plus "
+                  "warm-standby failover, through the wire stub",
+          "seed": seed,
+          "kills": len(pipeline_legs),
+          "kills_landed": crashes,
+          "reconciler_reposts": reposted,
+          "sweep_binds": swept,
+          "duplicate_binds": 0,
+          "lost_binds": 0,
+          "duplicate_evictions": 0,
+          "failover_to_first_bind_s": round(failover_s, 3),
+          "soak_wall_s": round(wall_s, 2),
+          "deterministic_replay": "ok",
+          "note": "gates: every pod exactly one binding POST across a "
+                  "SIGKILL at any seeded journal offset, eviction "
+                  "never re-POSTed (cooldown re-armed instead), "
+                  "failover-to-first-bind <= 5 s, same seed => same "
+                  "timeline"})
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--device", choices=["cpu", "default"], default="default")
-    parser.add_argument("--configs", default="1,2,3,4,5,6,7,7b,8,9,10,11,12,13,14,15")
+    parser.add_argument("--configs", default="1,2,3,4,5,6,7,7b,8,9,10,11,12,13,14,15,16")
     parser.add_argument("--f64", action="store_true")
     args = parser.parse_args(argv)
 
@@ -2316,6 +2621,8 @@ def main(argv=None) -> int:
         config14(dtype, rtt)
     if 15 in todo:
         config15(dtype, rtt)
+    if 16 in todo:
+        config16(dtype, rtt)
     return 0
 
 

@@ -66,12 +66,23 @@ def main(argv=None) -> int:
                         help="directory for the crash-safe flight recorder "
                              "(lifecycle records + spans as a bounded JSONL "
                              "ring); implies telemetry")
+    parser.add_argument("--flight-fsync", action="store_true",
+                        help="fsync every flight-recorder and intent-"
+                             "journal line")
+    parser.add_argument("--journal-dir", default=None,
+                        help="master mode: crash-safe eviction-intent "
+                             "journal. Startup reconciles unresolved "
+                             "evictions against the live apiserver (pod "
+                             "gone → done, pod present → cooldown "
+                             "re-armed — never a second eviction POST)")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
     if args.flight_dir:
         os.environ["CRANE_FLIGHT_DIR"] = args.flight_dir
         os.environ.setdefault("CRANE_TELEMETRY", "1")
+    if args.flight_fsync:
+        os.environ["CRANE_FLIGHT_FSYNC"] = "1"
 
     from ..utils.logging import set_verbosity
 
@@ -162,6 +173,26 @@ def main(argv=None) -> int:
         cluster, policy, config, telemetry=telemetry, degraded=degraded
     )
 
+    journal = None
+    recovery = None
+    if args.journal_dir and args.master:
+        from ..resilience.recovery import IntentJournal, Reconciler
+
+        journal = IntentJournal(
+            args.journal_dir, fsync=args.flight_fsync, telemetry=telemetry
+        )
+        # reconcile crash-orphaned eviction intents BEFORE the sweep
+        # loop starts: a pod still present re-arms its node's cooldown
+        # (the one safe answer to "did my eviction land?")
+        recovery = Reconciler(
+            journal, cluster.get_pod_live,
+            lifecycle=telemetry.lifecycle, telemetry=telemetry,
+        ).reconcile()
+        for node_name in recovery.rearm_cooldowns:
+            descheduler.rearm_cooldown(node_name)
+        cluster.attach_intent_journal(journal)
+        print(f"recovery: {json.dumps(recovery.as_dict())}", flush=True)
+
     health = HealthServer(port=args.health_port, telemetry=telemetry,
                           health=health_reg)
     health.start()
@@ -171,6 +202,9 @@ def main(argv=None) -> int:
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
+    # chained after the stop handler: SIGTERM flushes flight spans
+    # first, then stops (atexit alone misses signal deaths)
+    telemetry_mod.flush_on_signal(telemetry)
 
     def run_descheduler(stop_event):
         descheduler.start()
@@ -219,7 +253,12 @@ def main(argv=None) -> int:
     health.stop()
     if args.master:
         cluster.stop()
-    print(json.dumps(descheduler.stats()), flush=True)
+    if journal is not None:
+        journal.close()
+    stats = descheduler.stats()
+    if recovery is not None:
+        stats["recovery"] = recovery.as_dict()
+    print(json.dumps(stats), flush=True)
     return 0
 
 

@@ -29,6 +29,118 @@ import os
 import time
 
 
+def _serve(args, cluster, config, policy, journal, recovery,
+           telemetry) -> int:
+    """Long-running drip serving (master mode): pending pods stream into
+    an incremental dispatch window (``Scheduler.open_queue``). SIGTERM /
+    SIGINT drains the open — possibly half-filled — window BEFORE client
+    teardown, so an orderly kill never evaporates buffered pods; with
+    ``--lock-file`` the process is a warm standby that reconciles the
+    journal directory the moment it wins the lease, before its first
+    bind."""
+    import signal
+    import threading
+
+    from ..config import build_scheduler_from_config
+
+    stop = threading.Event()
+
+    def _on_signal(*_a):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    if telemetry is not None:
+        from .. import telemetry as _tel
+
+        # chained AFTER the stop handler: a SIGTERM flushes flight
+        # spans, then sets stop (satellite: atexit alone misses signals)
+        _tel.flush_on_signal(telemetry)
+
+    standby = None
+    if args.lock_file:
+        from ..resilience.recovery import WarmStandby
+
+        journal_dir = args.journal_dir or os.path.join(
+            os.path.dirname(os.path.abspath(args.lock_file)), "intents"
+        )
+        standby = WarmStandby(
+            args.lock_file,
+            identity=f"scheduler-{os.getpid()}",
+            journal_dir=journal_dir,
+            lookup=cluster.get_pod_live,
+            lifecycle=(
+                telemetry.lifecycle if telemetry is not None else None
+            ),
+            telemetry=telemetry,
+            journal=journal,
+        ).start()
+        # warm standby: the mirror watch-follows the live cluster while
+        # we wait; binding opens only once the lease is ours AND the
+        # dead leader's journal is reconciled
+        while not standby.wait_ready(0.2):
+            if stop.is_set():
+                standby.stop()
+                return 0
+        recovery = standby.report
+        journal = standby.journal
+        cluster.attach_intent_journal(journal)
+
+    sched = build_scheduler_from_config(
+        cluster, config, nrt_lister=cluster.nrt_lister, policy=policy,
+        tie_break_seed=args.tie_break_seed,
+    )
+    queue = sched.open_queue(window=args.window)
+    deadline = (
+        time.monotonic() + args.run_seconds
+        if args.run_seconds > 0 else None
+    )
+    offered: set = set()
+    stats = {"scheduled": 0, "unschedulable": 0}
+
+    def _harvest():
+        for r in queue.take_results():
+            stats["scheduled" if r.node else "unschedulable"] += 1
+
+    t0 = time.perf_counter()
+    while not stop.is_set():
+        if deadline is not None and time.monotonic() >= deadline:
+            break
+        live = cluster.list_pods()
+        offered &= {p.key() for p in live}  # deleted pods may return
+        progressed = 0
+        for pod in live:
+            if pod.node_name or pod.key() in offered:
+                continue
+            offered.add(pod.key())
+            queue.offer(pod)
+            progressed += 1
+        _harvest()
+        if not progressed:
+            stop.wait(0.05)
+    # the drain: dispatch-or-flush whatever the signal interrupted
+    drained = queue.drain()
+    _harvest()
+    out = {
+        "config": args.config,
+        "master": args.master,
+        "mode": "serve",
+        **stats,
+        "drained_at_exit": drained,
+        "wall_seconds": round(time.perf_counter() - t0, 3),
+    }
+    if recovery is not None:
+        out["recovery"] = recovery.as_dict()
+    if standby is not None and standby.failover_seconds is not None:
+        out["failover_seconds"] = round(standby.failover_seconds, 4)
+    print(json.dumps(out), flush=True)
+    if standby is not None:
+        standby.stop()
+    elif journal is not None:
+        journal.close()
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="crane-scheduler")
     parser.add_argument("--config", default="deploy/dynamic/scheduler-config.yaml")
@@ -56,6 +168,34 @@ def main(argv=None) -> int:
                         help="directory for the crash-safe flight recorder "
                              "(lifecycle records + spans as a bounded JSONL "
                              "ring); implies telemetry")
+    parser.add_argument("--flight-fsync", action="store_true",
+                        help="fsync every flight-recorder and intent-"
+                             "journal line (durable across power loss, "
+                             "not just process death)")
+    parser.add_argument("--journal-dir", default=None,
+                        help="master mode: crash-safe placement-intent "
+                             "journal directory. Startup replays the "
+                             "journal and reconciles every unresolved "
+                             "bind/eviction against the live apiserver "
+                             "BEFORE scheduling opens; every bind POST "
+                             "then journals intent-before-wire")
+    parser.add_argument("--serve", action="store_true",
+                        help="master mode: long-running drip serving loop "
+                             "(incremental dispatch windows) instead of "
+                             "one-shot; SIGTERM drains the open window "
+                             "before teardown")
+    parser.add_argument("--run-seconds", type=float, default=0.0,
+                        help="--serve: exit after this long (0 = until "
+                             "SIGTERM/SIGINT)")
+    parser.add_argument("--window", type=int, default=32,
+                        help="--serve: drip dispatch window size")
+    parser.add_argument("--lock-file", default=None,
+                        help="--serve: leader-election lock path. The "
+                             "process runs as a warm standby (mirror "
+                             "watch-following) until it holds the lease, "
+                             "reconciles the journal dir, then serves — "
+                             "a second process on the same lock is the "
+                             "failover standby")
     parser.add_argument("-v", "--verbose", action="count", default=0,
                         help="raise [crane] log verbosity (-v sweeps/"
                              "windows, -vv cycles, -vvv per-pod); "
@@ -65,6 +205,8 @@ def main(argv=None) -> int:
     if args.flight_dir:
         os.environ["CRANE_FLIGHT_DIR"] = args.flight_dir
         os.environ.setdefault("CRANE_TELEMETRY", "1")
+    if args.flight_fsync:
+        os.environ["CRANE_FLIGHT_FSYNC"] = "1"
 
     from ..utils.logging import set_verbosity
 
@@ -96,6 +238,46 @@ def main(argv=None) -> int:
         )
         cluster.start()
         policy = policy or DEFAULT_POLICY
+
+        telemetry = None
+        if os.environ.get("CRANE_TELEMETRY"):
+            from .. import telemetry as _tel
+
+            telemetry = _tel.active()
+
+        journal = None
+        recovery = None
+        if args.journal_dir:
+            from ..resilience.recovery import IntentJournal, Reconciler
+
+            journal = IntentJournal(
+                args.journal_dir, fsync=args.flight_fsync,
+                telemetry=telemetry,
+            )
+            if not args.lock_file:
+                # crash recovery: replay + reconcile the journal tail
+                # against the LIVE apiserver before any scheduling (a
+                # lock-file serve defers this to lease acquisition)
+                recovery = Reconciler(
+                    journal, cluster.get_pod_live,
+                    lifecycle=(
+                        telemetry.lifecycle
+                        if telemetry is not None else None
+                    ),
+                    telemetry=telemetry,
+                ).reconcile()
+            cluster.attach_intent_journal(journal)
+
+        if args.serve:
+            rc = _serve(
+                args, cluster, config, policy, journal, recovery,
+                telemetry,
+            )
+            cluster.stop()
+            return rc
+        if telemetry is not None:
+            _tel.flush_on_signal(telemetry)
+
         pending = [p for p in cluster.list_pods() if not p.node_name]
         if args.pods is not None:  # unset means ALL pending, never 50
             pending = pending[: args.pods]
@@ -132,13 +314,18 @@ def main(argv=None) -> int:
             for pod in pending:
                 result = sched.schedule_one(pod)
                 stats["scheduled" if result.node else "unschedulable"] += 1
-        print(json.dumps({
+        out = {
             "config": args.config,
             "master": args.master,
             "nodes": len(cluster.list_nodes()),
             **stats,
             "wall_seconds": round(time.perf_counter() - t0, 3),
-        }))
+        }
+        if recovery is not None:
+            out["recovery"] = recovery.as_dict()
+        print(json.dumps(out))
+        if journal is not None:
+            journal.close()
         cluster.stop()
         return 0
 

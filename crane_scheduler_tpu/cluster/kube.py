@@ -940,6 +940,12 @@ class KubeClusterClient:
         # re-applied. Entries are removed in the same bind_pods call.
         self._expected_binds: dict = {}
         self._expected_lock = threading.Lock()
+        # crash-safe placement-intent journal (resilience.recovery):
+        # when attached, every bind/eviction POST journals an intent
+        # line BEFORE reaching the wire, an ack/nack/unresolved after,
+        # and a tombstone when the watch confirms — the substrate
+        # restart reconciliation replays. None = zero-cost.
+        self._intent_journal = None
         # write pool: --concurrent-syncs keep-alive workers, spawned on
         # first write (read-only clients never pay the threads)
         self._write_workers = max(1, int(concurrent_syncs))
@@ -2073,17 +2079,28 @@ class KubeClusterClient:
         self._mirror.apply_node_changes(decoded)
 
     def _confirm_placements(self, decoded: list) -> None:
-        """Watch-CONFIRMED lifecycle hook: a non-DELETED pod event
-        carrying a nodeName is the authoritative end of a placement.
-        Untracked keys cost one dict miss inside one lock."""
+        """Watch-CONFIRMED hook: a non-DELETED pod event carrying a
+        nodeName is the authoritative end of a placement (lifecycle
+        confirmation + intent-journal tombstone); a DELETED event
+        tombstones any open eviction intent. Untracked keys cost one
+        dict miss inside one lock."""
         lc = self._lifecycle
-        if lc is None:
+        journal = self._intent_journal
+        if lc is None and journal is None:
             return
-        lc.confirmed_batch(
+        placed = [
             (pod.key(), pod.node_name)
             for t, pod in decoded
             if t != "DELETED" and pod.node_name
-        )
+        ]
+        if lc is not None and placed:
+            lc.confirmed_batch(placed)
+        if journal is not None:
+            if placed:
+                journal.tombstone_batch(placed)
+            for t, pod in decoded:
+                if t == "DELETED":
+                    journal.tombstone_deleted(pod.key())
 
     def _drop_expected_echoes(self, decoded: list) -> list:
         """Filter watch pod changes that are echoes of an in-flight
@@ -2106,6 +2123,8 @@ class KubeClusterClient:
     def _apply_pod(self, change_type: str, obj: dict) -> None:
         pod = pod_from_json(obj)
         if change_type == "DELETED":
+            if self._intent_journal is not None:
+                self._confirm_placements(((change_type, pod),))
             self._mirror.delete_pod(pod.key())
         else:
             self._confirm_placements(((change_type, pod),))
@@ -2262,6 +2281,30 @@ class KubeClusterClient:
 
     def get_pod(self, key: str):
         return self._mirror.get_pod(key)
+
+    def get_pod_live(self, key: str):
+        """GET the pod from the apiserver, bypassing the mirror — the
+        restart reconciler's read: a just-restarted process's mirror is
+        cold, and classifying a crash-orphaned intent against stale
+        state could re-POST a bind that already landed. 404 → None (pod
+        gone); transport errors RAISE — reconciliation must fail loudly
+        rather than misclassify an unreachable pod as deleted."""
+        namespace, name = key.split("/", 1)
+        try:
+            obj = self._get_json(
+                f"/api/v1/namespaces/{namespace}/pods/{name}"
+            )
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+        return pod_from_json(obj)
+
+    def attach_intent_journal(self, journal) -> None:
+        """Install the crash-safety journal (resilience.recovery
+        ``IntentJournal``). From this point every bind/eviction POST is
+        journaled intent-before-wire; watch confirmations tombstone."""
+        self._intent_journal = journal
 
     def list_events(self):
         return self._mirror.list_events()
@@ -2472,13 +2515,20 @@ class KubeClusterClient:
             "kind": "Eviction",
             "metadata": {"name": name, "namespace": namespace},
         }
-        if not self._write(
+        headers = self._trace_header(key)
+        pod = self._mirror.get_pod(key)
+        iid = self._journal_single(
+            "evict", key, pod.node_name if pod is not None else None, headers
+        )
+        res = self._write(
             key,
             "POST",
             f"/api/v1/namespaces/{namespace}/pods/{name}/eviction",
             body,
-            headers=self._trace_header(key),
-        ):
+            headers=headers,
+        )
+        self._journal_single_outcome(iid, res)
+        if not res:
             return False
         # optimistic mirror apply; the watch's authoritative DELETED
         # event confirms (re-deleting an absent pod is a no-op)
@@ -2561,6 +2611,71 @@ class KubeClusterClient:
         tp = lc.traceparent(key)
         return {"traceparent": tp} if tp else None
 
+    @staticmethod
+    def _intent_op(path: str) -> str | None:
+        """Which journal op a POST path is — None for idempotent-enough
+        creations (a duplicate create is a 409, not a double bind)."""
+        if path.endswith("/binding"):
+            return "bind"
+        if path.endswith("/eviction"):
+            return "evict"
+        return None
+
+    def _journal_intents(self, items, tp) -> list:
+        """One intent line per bind/eviction item, all under one fresh
+        window id, before any wire traffic. Returns per-item intent ids
+        (None for non-journaled items)."""
+        journal = self._intent_journal
+        window = journal.begin_window()
+        ids: list = [None] * len(items)
+        for i, (key, path, body) in enumerate(items):
+            op = self._intent_op(path)
+            if op is None:
+                continue
+            if op == "bind":
+                # bodies arrive pre-rendered (the bind-burst template)
+                doc = json.loads(body) if isinstance(body, (bytes, str)) else body
+                node = doc.get("target", {}).get("name")
+            else:
+                pod = self._mirror.get_pod(key)
+                node = pod.node_name if pod is not None else None
+            ids[i] = journal.intent(
+                op, key, node, trace=tp.get(key), window=window
+            )
+        return ids
+
+    def _journal_outcomes(self, intent_ids, ok, final_status) -> None:
+        """Resolve each journaled intent: 2xx → ack (applied), a real
+        server status → nack (answered, not applied — re-drivable), 0 →
+        unresolved (transport loss / pipelined indeterminate; only
+        restart reconciliation may decide it)."""
+        journal = self._intent_journal
+        for iid, good, status in zip(intent_ids, ok, final_status):
+            if iid is None:
+                continue
+            if good:
+                journal.ack(iid)
+            elif status > 0:
+                journal.nack(iid, status)
+            else:
+                journal.unresolved(iid)
+
+    def _journal_single(self, op: str, key: str, node, headers):
+        """Intent line for a single-POST path (bind_pod / evict_pod)."""
+        journal = self._intent_journal
+        if journal is None:
+            return None
+        trace = headers.get("traceparent") if headers else None
+        return journal.intent(op, key, node, trace=trace)
+
+    def _journal_single_outcome(self, intent_id, result) -> None:
+        if intent_id is None:
+            return
+        self._journal_outcomes(
+            [intent_id], [bool(result)],
+            [int(getattr(result, "status", 0) or 0)],
+        )
+
     def _post_batch_impl(self, items: list[tuple[str, str, dict]]) -> list[bool]:
         n = len(items)
         ok = [False] * n
@@ -2576,6 +2691,16 @@ class KubeClusterClient:
         def _hdr(key):
             v = tp.get(key)
             return {"traceparent": v} if v else None
+
+        # crash-safety: journal every bind/eviction intent BEFORE any
+        # route puts bytes on the wire (a kill after this point leaves
+        # a replayable record; a kill before it leaves nothing in
+        # flight). final_status resolves each intent after the batch.
+        journal = self._intent_journal
+        intent_ids = (
+            self._journal_intents(items, tp) if journal is not None else None
+        )
+        final_status = [0] * n  # 0 = indeterminate unless a route reports
 
         flusher = self._get_native_flusher()
         if flusher is not None and n >= _NATIVE_FLUSH_MIN:
@@ -2604,6 +2729,7 @@ class KubeClusterClient:
             retry = list(range(n))
         else:
             for i, status in enumerate(statuses):
+                final_status[i] = int(status)
                 if 200 <= status < 300:
                     ok[i] = True
                 else:
@@ -2622,7 +2748,11 @@ class KubeClusterClient:
                 for i in retry
             ]
             for i, fut in futs:
-                ok[i] = bool(fut.result())
+                res = fut.result()
+                ok[i] = bool(res)
+                final_status[i] = int(getattr(res, "status", 0) or 0)
+        if intent_ids is not None:
+            self._journal_outcomes(intent_ids, ok, final_status)
         if lc is not None and tp:
             posted = [
                 (items[i][0], None) for i in range(n)
@@ -2792,8 +2922,11 @@ class KubeClusterClient:
         The apiserver emits the Scheduled event; it reaches subscribers
         through the event watch (the closed loop of SURVEY §3.4)."""
         path, body = self._binding_request(pod_key, node_name)
-        if not self._write(pod_key, "POST", path, body,
-                           headers=self._trace_header(pod_key)):
+        headers = self._trace_header(pod_key)
+        iid = self._journal_single("bind", pod_key, node_name, headers)
+        res = self._write(pod_key, "POST", path, body, headers=headers)
+        self._journal_single_outcome(iid, res)
+        if not res:
             return False
         if self._lifecycle is not None:
             self._lifecycle.posted(pod_key, node=node_name)

@@ -302,6 +302,14 @@ class LoadAwareDescheduler:
                         f"{node_name} ({ev.reason})")
         return report
 
+    def rearm_cooldown(self, node_name: str, now: float | None = None) -> None:
+        """Restart-reconciliation hook: an eviction intent left
+        unresolved by a crash (pod still present) re-arms the node's
+        cooldown — the next sweep re-evaluates the node from scratch
+        instead of racing a possibly-in-flight eviction POST with a
+        second one."""
+        self._last_evict[node_name] = self._clock() if now is None else now
+
     # -- control loop ------------------------------------------------------
 
     def start(self) -> None:

@@ -1188,6 +1188,107 @@ class Scheduler:
                 drip.drop_fit()
                 kern.mark_desynced()
 
+    def open_queue(self, window: int = 32) -> "DripQueue":
+        """An incremental front end to ``schedule_queue`` for
+        long-running serving: pods arrive one at a time (``offer``),
+        dispatch windows fire under exactly the batched path's
+        fence/fallback discipline, and ``drain()`` flushes a half-filled
+        window on demand — the SIGTERM hook that keeps an orderly kill
+        from evaporating an open drip window."""
+        return DripQueue(self, window)
+
+
+class DripQueue:
+    """Incremental drip window over a ``Scheduler`` (``open_queue``).
+
+    ``offer(pod)`` buffers columnar-eligible pods and dispatches a
+    window when it fills, when the cluster version fence moves, or when
+    a fallback pod interleaves — the same window semantics as one
+    ``schedule_queue`` call spread across arrivals, so placements stay
+    bit-identical to the batched path over the same pod sequence.
+    ``drain()`` dispatches whatever is buffered (the half-filled
+    window); the scheduler CLI calls it from its SIGTERM path before
+    client teardown. Not thread-safe — one serving loop owns it."""
+
+    def __init__(self, scheduler: "Scheduler", window: int = 32):
+        self._s = scheduler
+        self.window = max(1, int(window))
+        self.results: list[ScheduleResult] = []
+        self._buf: list = []  # (pod, request vec) rows of the open window
+        self._fence = None
+        self._rec = None  # recognition tuple the open window captured
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    @property
+    def pending(self) -> list:
+        """Keys buffered in the open window (oldest first)."""
+        return [pod.key() for pod, _vec in self._buf]
+
+    def offer(self, pod) -> None:
+        s = self._s
+        rec = (
+            s._recognition()
+            if s._columnar and self.window > 1 else None
+        )
+        if rec is None:
+            # scalar-pinned plugin set: nothing may sit buffered behind
+            # a per-pod decision (ordering), so flush then go scalar
+            self.drain()
+            self.results.append(s.schedule_one(pod))
+            return
+        from ..fit.tracker import pod_fit_request, request_vec
+
+        _dyn, _w, tracker, _order = rec
+        cluster = s.cluster
+        fallback = s._columnar_ineligible(pod, rec)
+        if fallback is None:
+            prev = cluster.get_pod(pod.key())
+            if prev is not None and prev.node_name:
+                fallback = "rebind"
+        cur = (
+            cluster.sched_version,
+            cluster.pod_version,
+            cluster.node_version,
+        )
+        if self._buf and (
+            fallback is not None or cur != self._fence
+            or rec is not self._rec
+        ):
+            self.drain()
+        if fallback is not None:
+            # schedule_one re-derives and counts the fallback itself
+            self.results.append(s.schedule_one(pod))
+            return
+        if not self._buf:
+            self._fence = (
+                cluster.sched_version,
+                cluster.pod_version,
+                cluster.node_version,
+            )
+            self._rec = rec
+        vec = (
+            request_vec(pod_fit_request(pod))
+            if tracker is not None else None
+        )
+        self._buf.append((pod, vec))
+        if len(self._buf) >= self.window:
+            self.drain()
+
+    def drain(self) -> int:
+        """Dispatch the open window (no-op when empty). Returns how many
+        buffered pods were dispatched."""
+        if not self._buf:
+            return 0
+        buf, self._buf = self._buf, []
+        self._s._dispatch_window(buf, self._rec, self.results)
+        return len(buf)
+
+    def take_results(self) -> list[ScheduleResult]:
+        out, self.results = self.results, []
+        return out
+
 
 @dataclass
 class BatchResult:

@@ -27,12 +27,28 @@ Four pieces, shared by every component:
 ``chaos`` holds the deterministic seeded ``ChaosPlan`` harness that
 drives the kube/prometheus stubs to prove the above under injected
 faults (tests/test_chaos.py, tools/chaos_smoke.py, bench config 12).
+
+``recovery`` (ISSUE 12) extends resilience from remote faults to the
+process's own death: the crash-safe placement-intent journal
+(``IntentJournal``), restart reconciliation (``Reconciler``), and the
+warm-standby failover coordinator (``WarmStandby``), with
+``KillSwitch`` as the deterministic SIGKILL-at-offset injector.
 """
 
 from .breaker import BreakerOpenError, BreakerState, CircuitBreaker
 from .chaos import ChaosEvent, ChaosPlan
 from .degraded import DegradedModeController
 from .health import HealthRegistry, HealthState
+from .recovery import (
+    IntentJournal,
+    JournalReplay,
+    KillSwitch,
+    ReconcileReport,
+    Reconciler,
+    SimulatedCrash,
+    WarmStandby,
+    replay_journal,
+)
 from .retry import RetryBudgetExceeded, RetryPolicy
 
 __all__ = [
@@ -44,6 +60,14 @@ __all__ = [
     "DegradedModeController",
     "HealthRegistry",
     "HealthState",
+    "IntentJournal",
+    "JournalReplay",
+    "KillSwitch",
+    "ReconcileReport",
+    "Reconciler",
     "RetryBudgetExceeded",
     "RetryPolicy",
+    "SimulatedCrash",
+    "WarmStandby",
+    "replay_journal",
 ]

@@ -64,7 +64,16 @@ _FAULT_KINDS: Tuple[Tuple[str, object], ...] = (
     ("close_watches", None),
     ("watch_410", None),
     ("skew_annotations", None),
+    # crash-safety (PR 12): kill the scheduler process mid-write — the
+    # param is a placement-intent-journal byte offset for the recovery
+    # harness's KillSwitch (SIGKILL-at-offset), restart_process is the
+    # paired heal (reconcile-then-reopen). Process-level, not a wire
+    # fault: only emitted when the caller opts in via kinds=, so plans
+    # generated for wire-stub drivers never require a kill applier.
+    ("kill_process", "restart_process"),
 )
+
+_OPT_IN_KINDS = frozenset({"kill_process"})
 
 
 @dataclass
@@ -123,11 +132,11 @@ class ChaosPlan:
         rng = random.Random(seed)
         plan = ChaosPlan(seed=seed, steps=steps)
         fault_horizon = max(1, steps - quiet_tail)
-        pool = [
-            (k, heal)
-            for k, heal in _FAULT_KINDS
-            if kinds is None or k in kinds
-        ]
+        if kinds is not None:
+            wanted = set(kinds)
+        else:
+            wanted = {k for k, _ in _FAULT_KINDS} - _OPT_IN_KINDS
+        pool = [(k, heal) for k, heal in _FAULT_KINDS if k in wanted]
         if not pool:
             raise ValueError(f"no chaos kinds match {kinds!r}")
         for _ in range(n_faults):
@@ -146,6 +155,11 @@ class ChaosPlan:
             elif kind == "skew_annotations":
                 # skew far enough that stamps look expired to the oracle
                 params["offset_s"] = rng.choice((-3600.0, -7200.0))
+            elif kind == "kill_process":
+                # absolute journal byte offset for the KillSwitch: any
+                # offset is legal (the crash-safety contract is "kill at
+                # ANY byte"), so sample widely across a small journal
+                params["offset"] = rng.randrange(1, 4096)
             plan.add(at, kind, **params)
             if heal is not None:
                 heal_at = rng.randrange(at + 1, fault_horizon + 1)

@@ -73,6 +73,7 @@ class Telemetry:
         decision_sample_every: int = 1,
         lifecycle_capacity: int = 8192,
         flight_dir: str | None = None,
+        flight_fsync: bool | None = None,
     ):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.spans = (
@@ -88,7 +89,13 @@ class Telemetry:
         )
         if flight_dir is None:
             flight_dir = os.environ.get("CRANE_FLIGHT_DIR") or None
-        self.flight = FlightRecorder(flight_dir) if flight_dir else None
+        if flight_fsync is None:
+            env = os.environ.get("CRANE_FLIGHT_FSYNC", "").strip().lower()
+            flight_fsync = bool(env) and env not in ("0", "false", "no")
+        self.flight = (
+            FlightRecorder(flight_dir, fsync=flight_fsync)
+            if flight_dir else None
+        )
         self.lifecycle = (
             lifecycle
             if lifecycle is not None
@@ -191,3 +198,33 @@ def maybe_span(telemetry: Telemetry | None, name: str, **args):
     if telemetry is None:
         return _NULL_CTX
     return telemetry.spans.span(name, **args)
+
+
+def flush_on_signal(telemetry: Telemetry, signum=None) -> None:
+    """Install a SIGTERM handler that drains the flight recorder before
+    the process dies. atexit only fires on orderly interpreter exit;
+    SIGTERM's default action skips it entirely, so the last second of
+    spans from an orderly kill was lost. Chains any previously-installed
+    handler, and re-raises with the default disposition when there was
+    none so exit status still reports the signal. Main-thread only (the
+    CLIs qualify)."""
+    import signal as _signal
+
+    signum = _signal.SIGTERM if signum is None else signum
+    prev = _signal.getsignal(signum)
+
+    def _handler(num, frame):
+        try:
+            telemetry.flush_flight()
+        except Exception:
+            pass
+        if callable(prev):
+            prev(num, frame)
+        elif prev == _signal.SIG_DFL:
+            _signal.signal(num, _signal.SIG_DFL)
+            os.kill(os.getpid(), num)
+
+    _signal.signal(signum, _handler)
+
+
+__all__.append("flush_on_signal")

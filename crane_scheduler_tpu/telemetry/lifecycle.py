@@ -274,6 +274,16 @@ class PodLifecycleTracker:
         e2e endpoint. Tolerates arriving before the POST ack."""
         return self.stage(key, "watch_confirm", node=node)
 
+    def rearm(self, key: str, trace_id: str, attempt: int = 1) -> None:
+        """Restart reconciliation hook: a pod whose bind intent was lost
+        in a crash re-enters scheduling on the SAME trace id at
+        ``attempt + 1`` — its next ``seen()`` continues the story the
+        dead process started."""
+        with self._lock:
+            self._evicted_traces[key] = (trace_id, int(attempt))
+            while len(self._evicted_traces) > self.capacity:
+                self._evicted_traces.popitem(last=False)
+
     def evicted(self, key: str, reason: str = "") -> None:
         """Descheduler hook: finalize the current attempt as evicted and
         remember the trace so a re-placement continues it."""
@@ -392,13 +402,16 @@ class FlightRecorder:
     segment beyond ``max_segments`` is deleted. Every record is one
     ``write()`` of a full line followed by a flush, and the reader skips
     unparseable lines — a crash can lose at most the torn tail, never
-    corrupt the ring."""
+    corrupt the ring. ``fsync=True`` additionally fsyncs each line so
+    the tail survives power loss, not just process death — the intent
+    journal's durability mode (``--flight-fsync``)."""
 
     def __init__(self, directory: str, max_segment_bytes: int = 4 << 20,
-                 max_segments: int = 8):
+                 max_segments: int = 8, fsync: bool = False):
         self.directory = directory
         self.max_segment_bytes = int(max_segment_bytes)
         self.max_segments = int(max_segments)
+        self.fsync = bool(fsync)
         os.makedirs(directory, exist_ok=True)
         self._lock = threading.Lock()
         indices = self._segment_indices()
@@ -426,6 +439,8 @@ class FlightRecorder:
         with self._lock:
             self._file.write(line + "\n")
             self._file.flush()
+            if self.fsync:
+                os.fsync(self._file.fileno())
             self._size += len(line) + 1
             if self._size >= self.max_segment_bytes:
                 self._rotate_locked()

@@ -742,6 +742,18 @@ def _make_handler(state: KubeStubState):
                     if lease is None:
                         return self._json(404, {"message": "lease not found"})
                     return self._json(200, lease)
+            if path.startswith("/api/v1/namespaces/") and "/pods/" in path:
+                # single-object GET — the restart reconciler's live read
+                parts = path.strip("/").split("/")
+                if len(parts) == 6 and parts[4] == "pods":
+                    key = f"{parts[3]}/{parts[5]}"
+                    with state.lock:
+                        pod = state.pods.get(key)
+                        if pod is None:
+                            return self._json(
+                                404, {"message": "pod not found"}
+                            )
+                        return self._json(200, pod)
             if path == "/api/v1/events":
                 filtered = "fieldSelector=" in query
                 if watching:

@@ -454,6 +454,30 @@ def test_generated_plans_are_deterministic_and_converge():
     assert c.events != a.events
 
 
+def test_generate_kill_process_kind_carries_journal_offset():
+    plan = ChaosPlan.generate(
+        seed=3, steps=32, n_faults=6, kinds=("kill_process",)
+    )
+    kills = [e for e in plan.events if e.kind == "kill_process"]
+    assert len(kills) == 6
+    # every kill carries a KillSwitch byte offset in the documented range
+    assert all(1 <= e.param("offset") < 4096 for e in kills)
+    # restart_process is kill's heal pair — one per kill, strictly after
+    restarts = sorted(
+        e.at_step for e in plan.events if e.kind == "restart_process"
+    )
+    assert len(restarts) == len(kills)
+    again = ChaosPlan.generate(
+        seed=3, steps=32, n_faults=6, kinds=("kill_process",)
+    )
+    assert again.events == plan.events
+
+
+def test_generate_rejects_unknown_kind_filter():
+    with pytest.raises(ValueError):
+        ChaosPlan.generate(seed=0, kinds=("quantum_flap",))
+
+
 def test_unregistered_chaos_kind_fails_loudly():
     plan = ChaosPlan(seed=0, steps=2).add(1, "quantum_flap")
     with pytest.raises(KeyError):

@@ -1,0 +1,414 @@
+"""Crash-safe placement plane, integration layer (ISSUE 12): SIGKILL-
+at-any-byte-offset sweeps against the wire stub's ``bind_posts`` oracle
+(zero duplicate AND zero lost binds across restart reconciliation),
+eviction-indeterminate recovery against ``duplicate_evictions``,
+watch-confirm tombstones, warm-standby failover on the file-lock
+elector, the DripQueue drain (half-filled window at signal time), and
+the SIGTERM flight flush."""
+
+import importlib.util
+import os
+import signal
+
+import pytest
+
+from crane_scheduler_tpu.cluster.kube import KubeClusterClient
+from crane_scheduler_tpu.resilience.recovery import (
+    OUTCOME_BOUND_AS_INTENDED,
+    OUTCOME_EVICT_UNAPPLIED,
+    OUTCOME_EVICTED,
+    IntentJournal,
+    KillSwitch,
+    Reconciler,
+    SimulatedCrash,
+    WarmStandby,
+    replay_journal,
+)
+
+_STUB = os.path.join(os.path.dirname(__file__), "kube_stub.py")
+spec = importlib.util.spec_from_file_location("kube_stub", _STUB)
+kube_stub = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(kube_stub)
+
+
+@pytest.fixture()
+def stub():
+    server = kube_stub.KubeStubServer().start()
+    yield server
+    server.stop()
+
+
+def _die():
+    raise SimulatedCrash("SIGKILL at journal offset")
+
+
+def _seed_nodes(stub, n=4):
+    for i in range(n):
+        stub.state.add_node(f"node-{i}", f"10.0.0.{i}")
+
+
+def _crash_bind_recover(stub, jdir, ns, offset):
+    """One life: bind a batch with a KillSwitch armed at ``offset``
+    journal bytes (the process 'dies' there), then a second life
+    reconciles the journal and schedules whatever provably needs it.
+    Returns the (key, node) assignments attempted."""
+    n = 6
+    for i in range(n):
+        stub.state.add_pod(ns, f"p{i}")
+    pairs = [(f"{ns}/p{i}", f"node-{i % 4}") for i in range(n)]
+
+    journal = IntentJournal(str(jdir))
+    if offset is not None:
+        journal.kill_switch = KillSwitch(offset, action=_die)
+    client = KubeClusterClient(stub.url)
+    client.attach_intent_journal(journal)
+    try:
+        client.bind_pods(pairs)
+    except SimulatedCrash:
+        pass  # the first life ends here, at exactly `offset` bytes
+    client.stop()
+    journal.close()
+
+    # second life: reconcile BEFORE scheduling opens
+    journal2 = IntentJournal(str(jdir))
+    client2 = KubeClusterClient(stub.url)
+    client2.attach_intent_journal(journal2)
+    report = Reconciler(journal2, client2.get_pod_live).reconcile()
+    redo = {key: node for key, node, _t, _a in report.reschedule}
+    if redo:
+        client2.bind_pods(list(redo.items()))
+    # the normal pending sweep covers pods whose intent never hit disk
+    pending = [
+        (key, node) for key, node in pairs
+        if key not in redo and not client2.get_pod_live(key).node_name
+    ]
+    if pending:
+        client2.bind_pods(pending)
+    client2.stop()
+    journal2.close()
+    return pairs
+
+
+def test_kill_at_any_byte_offset_zero_dup_zero_lost(stub, tmp_path):
+    """THE tentpole gate: sweep the SIGKILL offset across the whole
+    journal write stream — intent phase (nothing on the wire yet) and
+    outcome phase (POSTs already landed, acks lost) — and prove via the
+    stub's per-pod ``bind_posts`` oracle that recovery re-POSTs exactly
+    the lost binds and never the landed ones."""
+    _seed_nodes(stub)
+    # clean life to measure the full journal stream length
+    pairs = _crash_bind_recover(stub, tmp_path / "warm", "warm", None)
+    total = IntentJournal(str(tmp_path / "warm")).bytes_written
+    probe = sum(
+        len(line) for line in open(
+            os.path.join(str(tmp_path / "warm"), "intent-000001.jsonl"),
+            "rb",
+        )
+    )
+    assert probe > 0
+    for key, node in pairs:
+        assert stub.state.bind_posts.get(key, 0) == 1
+
+    offsets = list(range(1, probe + 40, 37))
+    for off in offsets:
+        ns = f"k{off}"
+        pairs = _crash_bind_recover(stub, tmp_path / ns, ns, off)
+        for key, node in pairs:
+            assert stub.state.bind_posts.get(key, 0) == 1, (off, key)
+            live = stub.state.pods[key]
+            assert live["spec"].get("nodeName") == node, (off, key)
+    assert stub.state.duplicate_binds() == 0
+
+
+def test_outcome_phase_crash_classifies_bound_as_intended(stub, tmp_path):
+    """A crash BETWEEN the POST landing (2xx) and the ack reaching disk
+    is the dangerous window: the intent replays unresolved while the
+    server already bound the pod. Reconciliation must read the live
+    object and ack, never re-POST."""
+    _seed_nodes(stub)
+    for i in range(4):
+        stub.state.add_pod("t", f"p{i}")
+    pairs = [(f"t/p{i}", f"node-{i}") for i in range(4)]
+    journal = IntentJournal(str(tmp_path))
+    client = KubeClusterClient(stub.url)
+    client.attach_intent_journal(journal)
+    # arm past the intent block: the cut lands inside the ack writes
+    client.bind_pods(pairs[:0])  # no-op; journal still at 0 bytes
+    probe = IntentJournal(str(tmp_path / "probe"))
+    for key, node in pairs:
+        probe.intent("bind", key, node)
+    journal.kill_switch = KillSwitch(
+        probe.bytes_written + 10, action=_die
+    )
+    with pytest.raises(SimulatedCrash):
+        client.bind_pods(pairs)
+    client.stop()
+    journal.close()
+    assert sum(stub.state.bind_posts.values()) == 4  # all landed
+
+    journal2 = IntentJournal(str(tmp_path))
+    client2 = KubeClusterClient(stub.url)
+    report = Reconciler(journal2, client2.get_pod_live).reconcile()
+    client2.stop()
+    assert report.outcomes.get(OUTCOME_BOUND_AS_INTENDED, 0) >= 3
+    assert report.reschedule == []
+    assert sum(stub.state.bind_posts.values()) == 4  # and stayed 4
+    assert stub.state.duplicate_binds() == 0
+
+
+def test_watch_confirm_tombstones_bind_intent(stub, tmp_path):
+    """The live path's journal hygiene: a watch-confirmed placement
+    tombstones its intent, so a later restart replays nothing."""
+    _seed_nodes(stub)
+    stub.state.add_pod("t", "p0")
+    journal = IntentJournal(str(tmp_path))
+    client = KubeClusterClient(stub.url)
+    client.attach_intent_journal(journal)
+    client.start()
+    try:
+        assert client.bind_pods([("t/p0", "node-1")]) == ["t/p0"]
+        deadline = 50
+        while deadline and not any(
+            r.get("t") == "tombstone"
+            for r in IntentJournal.read(str(tmp_path))
+        ):
+            import time
+
+            time.sleep(0.05)
+            deadline -= 1
+        assert deadline, "watch echo never tombstoned the intent"
+    finally:
+        client.stop()
+        journal.close()
+    assert replay_journal(str(tmp_path)).unresolved() == []
+
+
+def test_indeterminate_eviction_never_reposts(stub, tmp_path):
+    """Satellite: an eviction whose response was lost in transport
+    journals unresolved; reconciliation finds the pod alive, re-arms the
+    node cooldown, and never POSTs a second eviction — proven by the
+    stub's ``duplicate_evictions`` oracle."""
+    _seed_nodes(stub)
+    stub.state.add_pod("t", "victim", spec={"nodeName": "node-0"})
+    stub.state.inject_write_faults((0, {}))  # reset: read, never answered
+    journal = IntentJournal(str(tmp_path))
+    client = KubeClusterClient(stub.url)
+    client.attach_intent_journal(journal)
+    assert client.evict_pod("t/victim") is False
+    client.stop()
+    journal.close()
+    # the stub never processed it: the pod survives, nothing counted
+    assert sum(stub.state.evict_posts.values()) == 0
+
+    journal2 = IntentJournal(str(tmp_path))
+    client2 = KubeClusterClient(stub.url)
+    report = Reconciler(journal2, client2.get_pod_live).reconcile()
+    client2.stop()
+    journal2.close()
+    assert report.outcomes == {OUTCOME_EVICT_UNAPPLIED: 1}
+    assert report.rearm_cooldowns == ["node-0"]
+    assert sum(stub.state.evict_posts.values()) == 0  # no second POST
+    assert stub.state.duplicate_evictions() == 0
+    assert "t/victim" in stub.state.pods
+
+
+def test_eviction_landed_but_ack_lost_reconciles_to_evicted(stub, tmp_path):
+    _seed_nodes(stub)
+    stub.state.add_pod("t", "v2", spec={"nodeName": "node-0"})
+    client = KubeClusterClient(stub.url)
+    assert client.evict_pod("t/v2") is True  # landed; ack "lost" below
+    client.stop()
+    journal = IntentJournal(str(tmp_path))
+    journal.intent("evict", "t/v2", "node-0")  # crash left it unresolved
+    client2 = KubeClusterClient(stub.url)
+    report = Reconciler(journal, client2.get_pod_live).reconcile()
+    client2.stop()
+    journal.close()
+    assert report.outcomes == {OUTCOME_EVICTED: 1}
+    assert report.rearm_cooldowns == []
+    assert sum(stub.state.evict_posts.values()) == 1
+    assert stub.state.duplicate_evictions() == 0
+
+
+def test_cooldown_rearm_blocks_next_sweep():
+    """The descheduler side of eviction recovery: a re-armed cooldown
+    makes the next sweep skip the node instead of racing the in-flight
+    eviction."""
+    from crane_scheduler_tpu.descheduler import (
+        DeschedulerConfig,
+        LoadAwareDescheduler,
+        WatermarkPolicy,
+    )
+    from crane_scheduler_tpu.cluster import ClusterState, Node
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+
+    cluster = ClusterState()
+    cluster.add_node(Node(name="node-0"))
+    config = DeschedulerConfig(
+        watermarks=(WatermarkPolicy("cpu_usage_avg_5m", 0.5, 0.7),),
+        node_cooldown_seconds=300.0,
+    )
+    d = LoadAwareDescheduler(cluster, DEFAULT_POLICY, config)
+    d.rearm_cooldown("node-0", now=1000.0)
+    assert d._last_evict["node-0"] == 1000.0
+
+
+def test_warm_standby_failover_reconciles_before_ready(tmp_path):
+    """Two processes on one lease: A leads, B holds warm standby; when
+    A's lease releases, B must reconcile the shared journal directory
+    BEFORE flipping ready — and report failover time under the gate."""
+    lock = str(tmp_path / "leader.lock")
+    jdir = str(tmp_path / "intents")
+    # the "dead leader" left an unresolved bind intent behind
+    j = IntentJournal(jdir)
+    j.intent("bind", "ns/orphan", "node-1")
+    j.close()
+
+    table = {"ns/orphan": None}  # provably unbound: reschedulable
+
+    def lookup(key):
+        if key not in table:
+            return None
+        import types
+
+        return types.SimpleNamespace(node_name=table[key])
+
+    a = WarmStandby(
+        lock, "sched-a", jdir, lookup,
+        lease_duration=1.0, renew_deadline=0.6, retry_period=0.1,
+    ).start()
+    assert a.wait_ready(5.0)
+    assert a.report.outcomes == {"unbound_reschedulable": 1}
+
+    promoted = []
+    b = WarmStandby(
+        lock, "sched-b", jdir, lookup,
+        on_promote=lambda rep: promoted.append(rep),
+        lease_duration=1.0, renew_deadline=0.6, retry_period=0.1,
+    ).start()
+    assert not b.wait_ready(0.5)  # standby while A holds the lock
+
+    a.stop()  # the leader dies
+    assert b.wait_ready(5.0), "standby never took over"
+    assert promoted and promoted[0] is b.report
+    # A already resolved the orphan; B's reconcile replays nothing new
+    assert b.report.total() == 0
+    assert b.failover_seconds is not None and b.failover_seconds <= 5.0
+    b.stop()
+
+
+def test_flush_on_signal_chains_and_flushes(tmp_path):
+    """Satellite: SIGTERM drains the flight recorder (atexit alone
+    misses signal deaths) and still runs the previously-installed
+    handler."""
+    from crane_scheduler_tpu import telemetry as tel_mod
+    from crane_scheduler_tpu.telemetry.lifecycle import FlightRecorder
+
+    tel = tel_mod.Telemetry(flight_dir=str(tmp_path))
+    seen = []
+    prev = signal.signal(signal.SIGTERM, lambda n, f: seen.append(n))
+    try:
+        tel_mod.flush_on_signal(tel)
+        with tel.spans.span("pre-sigterm-span"):
+            pass
+        signal.raise_signal(signal.SIGTERM)
+        assert seen == [signal.SIGTERM]
+        recs = list(FlightRecorder.read(str(tmp_path)))
+        assert any(r.get("kind") == "span" for r in recs)
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+        tel._flight_stop.set()
+
+
+def test_flight_recorder_fsync_flag(tmp_path, monkeypatch):
+    from crane_scheduler_tpu.telemetry.lifecycle import FlightRecorder
+
+    calls = []
+    real = os.fsync
+    monkeypatch.setattr(
+        os, "fsync", lambda fd: (calls.append(fd), real(fd))
+    )
+    fr = FlightRecorder(str(tmp_path), fsync=True)
+    fr.write("span", {"name": "x"})
+    fr.close()
+    assert len(calls) == 1
+    assert list(FlightRecorder.read(str(tmp_path)))[0]["name"] == "x"
+
+
+# -- DripQueue ---------------------------------------------------------------
+
+
+def _drip_fixtures(seed=7, n_nodes=24, n_pods=40):
+    import random
+
+    from test_drip_columnar import (
+        build_cluster,
+        build_scheduler,
+        fuzz_node_specs,
+        fuzz_pod_specs,
+        make_pod,
+    )
+
+    rng = random.Random(seed)
+    node_specs = fuzz_node_specs(rng, n_nodes)
+    pod_specs = fuzz_pod_specs(random.Random(seed + 1), n_pods)
+    return build_cluster, build_scheduler, node_specs, pod_specs, make_pod
+
+
+def test_drip_queue_matches_schedule_queue():
+    """offer()-at-a-time placements are bit-identical to one
+    schedule_queue call over the same pod sequence."""
+    build_cluster, build_scheduler, node_specs, pod_specs, make_pod = (
+        _drip_fixtures()
+    )
+    ca = build_cluster(node_specs)
+    cb = build_cluster(node_specs)
+    sa = build_scheduler(ca, columnar=True)
+    sb = build_scheduler(cb, columnar=True)
+
+    pods_a, pods_b = [], []
+    for spec in pod_specs:
+        pa, pb = make_pod(*spec), make_pod(*spec)
+        ca.add_pod(pa)
+        cb.add_pod(pb)
+        pods_a.append(pa)
+        pods_b.append(pb)
+    batch = [
+        (r.node, r.feasible, r.reason)
+        for r in sa.schedule_queue(pods_a, window=8)
+    ]
+    queue = sb.open_queue(window=8)
+    for pod in pods_b:
+        queue.offer(pod)
+    queue.drain()
+    incremental = [
+        (r.node, r.feasible, r.reason) for r in queue.take_results()
+    ]
+    assert incremental == batch
+
+
+def test_drip_queue_drains_half_filled_window():
+    """Satellite: the SIGTERM scenario — a window half-filled at signal
+    time dispatches on drain(), losing nothing."""
+    build_cluster, build_scheduler, node_specs, pod_specs, make_pod = (
+        _drip_fixtures(n_pods=5)
+    )
+    cluster = build_cluster(node_specs)
+    sched = build_scheduler(cluster, columnar=True)
+    queue = sched.open_queue(window=32)
+    offered = 0
+    for spec in pod_specs:
+        if spec[3]:
+            continue  # daemonsets fall back immediately; keep it pure
+        pod = make_pod(*spec)
+        cluster.add_pod(pod)
+        queue.offer(pod)
+        offered += 1
+    assert len(queue) == offered > 0  # half-filled, nothing dispatched
+    assert queue.results == []
+    assert queue.drain() == offered  # the SIGTERM drain
+    assert len(queue) == 0
+    results = queue.take_results()
+    assert len(results) == offered
+    bound = [r for r in results if r.node]
+    assert bound, "drained window bound nothing"

@@ -1,0 +1,227 @@
+"""Recovery smoke gate (``make recovery-smoke``): one seeded kill →
+reconcile → verify pass over the crash-safe placement plane, then a
+strict-parse scrape of the recovery metric families.
+
+Checks, in order:
+- a bind batch killed at a seeded journal byte offset (SIGKILL
+  simulated by the KillSwitch) leaves a parseable journal prefix;
+- restart reconciliation classifies every unresolved intent against
+  the live apiserver stub and re-POSTs exactly the lost binds — the
+  stub's per-pod ``bind_posts`` oracle reads 1 everywhere, zero
+  duplicates;
+- an indeterminate eviction (response lost in transport) reconciles to
+  a cooldown re-arm, never a second eviction POST;
+- ``crane_recovery_intents_replayed``,
+  ``crane_recovery_reconciled_total``, ``crane_recovery_journal_bytes``
+  and ``crane_failover_seconds`` render through the strict exposition
+  parser off a live ``/metrics`` scrape.
+
+Exit 0 = every check passed; any violation prints the failure and exits
+nonzero. Runs in a few wall-clock seconds.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import random
+import sys
+import tempfile
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SEED = 12
+BATCH = 8
+
+
+def main() -> int:
+    from crane_scheduler_tpu.cluster.kube import KubeClusterClient
+    from crane_scheduler_tpu.resilience.recovery import (
+        IntentJournal,
+        KillSwitch,
+        Reconciler,
+        SimulatedCrash,
+        WarmStandby,
+    )
+    from crane_scheduler_tpu.service.http import HealthServer
+    from crane_scheduler_tpu.telemetry import Telemetry
+    from crane_scheduler_tpu.telemetry.expfmt import (
+        ExpositionError,
+        parse_exposition,
+    )
+
+    stub_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests", "kube_stub.py",
+    )
+    stub_spec = importlib.util.spec_from_file_location(
+        "kube_stub_smoke", stub_path
+    )
+    kube_stub = importlib.util.module_from_spec(stub_spec)
+    stub_spec.loader.exec_module(kube_stub)
+
+    failures = 0
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        nonlocal failures
+        mark = "ok" if ok else "FAIL"
+        print(f"[recovery-smoke] {name}: "
+              f"{mark}{' — ' + detail if detail else ''}")
+        if not ok:
+            failures += 1
+
+    tel = Telemetry()
+    server = kube_stub.KubeStubServer().start()
+    root = tempfile.mkdtemp(prefix="crane-recovery-smoke-")
+    health = HealthServer(port=0, telemetry=tel)
+    health.start()
+    base = f"http://127.0.0.1:{health.port}"
+
+    def die():
+        raise SimulatedCrash("recovery-smoke kill")
+
+    try:
+        for i in range(4):
+            server.state.add_node(f"node-{i}", f"10.0.0.{i}")
+        for i in range(BATCH):
+            server.state.add_pod("smoke", f"p{i}")
+        pairs = [(f"smoke/p{i}", f"node-{i % 4}") for i in range(BATCH)]
+
+        # -- first life: seeded SIGKILL mid bind batch -----------------
+        rng = random.Random(SEED)
+        offset = rng.randrange(1, 1000)
+        jdir = os.path.join(root, "intents")
+        journal = IntentJournal(jdir, telemetry=tel)
+        journal.kill_switch = KillSwitch(offset, action=die)
+        client = KubeClusterClient(server.url)
+        client.attach_intent_journal(journal)
+        crashed = False
+        try:
+            client.bind_pods(pairs)
+        except SimulatedCrash:
+            crashed = True
+        client.stop()
+        journal.close()
+        check("seeded kill landed mid-stream", crashed,
+              f"offset={offset}")
+
+        # -- second life: reconcile, then schedule what provably needs it
+        journal2 = IntentJournal(jdir, telemetry=tel)
+        client2 = KubeClusterClient(server.url)
+        client2.attach_intent_journal(journal2)
+        report = Reconciler(
+            journal2, client2.get_pod_live, telemetry=tel
+        ).reconcile()
+        redo = {k: n for k, n, _t, _a in report.reschedule}
+        if redo:
+            client2.bind_pods(list(redo.items()))
+        pending = [
+            (k, n) for k, n in pairs
+            if k not in redo and not client2.get_pod_live(k).node_name
+        ]
+        if pending:
+            client2.bind_pods(pending)
+        client2.stop()
+        journal2.close()
+        check("reconciler classified the journal tail",
+              report.total() >= 0,
+              f"outcomes={dict(sorted(report.outcomes.items()))}")
+        lost = [k for k, _n in pairs
+                if server.state.bind_posts.get(k, 0) != 1]
+        check("every pod exactly one binding POST", not lost,
+              f"lost_or_dup={lost}" if lost else f"{BATCH}/{BATCH}")
+        check("zero duplicate binds (stub oracle)",
+              server.state.duplicate_binds() == 0)
+
+        # -- indeterminate eviction: re-arm, never re-POST -------------
+        server.state.add_pod("smoke", "victim",
+                             spec={"nodeName": "node-0"})
+        server.state.inject_write_faults((0, {}))
+        ejdir = os.path.join(root, "evict-intents")
+        journal3 = IntentJournal(ejdir, telemetry=tel)
+        client3 = KubeClusterClient(server.url)
+        client3.attach_intent_journal(journal3)
+        evicted = client3.evict_pod("smoke/victim")
+        client3.stop()
+        journal3.close()
+        journal4 = IntentJournal(ejdir, telemetry=tel)
+        client4 = KubeClusterClient(server.url)
+        ereport = Reconciler(
+            journal4, client4.get_pod_live, telemetry=tel
+        ).reconcile()
+        client4.stop()
+        journal4.close()
+        check("indeterminate eviction failed visibly", evicted is False)
+        check("eviction reconciled to cooldown re-arm",
+              ereport.rearm_cooldowns == ["node-0"],
+              f"cooldowns={ereport.rearm_cooldowns}")
+        check("no second eviction POST",
+              sum(server.state.evict_posts.values()) == 0
+              and server.state.duplicate_evictions() == 0)
+
+        # -- warm standby: failover observes crane_failover_seconds ----
+        lock = os.path.join(root, "leader.lock")
+        sdir = os.path.join(root, "standby-intents")
+        lookup = client2.get_pod_live
+        a = WarmStandby(
+            lock, "smoke-a", sdir, lookup, telemetry=tel,
+            lease_duration=1.0, renew_deadline=0.6, retry_period=0.1,
+        ).start()
+        check("leader led", a.wait_ready(10.0))
+        b = WarmStandby(
+            lock, "smoke-b", sdir, lookup, telemetry=tel,
+            lease_duration=1.0, renew_deadline=0.6, retry_period=0.1,
+        ).start()
+        a.stop()
+        check("standby took over", b.wait_ready(10.0))
+        check("failover under the 5 s gate",
+              b.failover_seconds is not None
+              and b.failover_seconds <= 5.0,
+              f"{b.failover_seconds:.3f}s" if b.failover_seconds else "")
+        b.stop()
+
+        # -- strict-parse the recovery families off the live scrape ----
+        with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        try:
+            families = parse_exposition(text)
+            check("strict exposition parse", True,
+                  f"{len(families)} families")
+        except ExpositionError as e:
+            families = {}
+            check("strict exposition parse", False, str(e))
+        for required in (
+            "crane_recovery_intents_replayed",
+            "crane_recovery_reconciled_total",
+            "crane_recovery_journal_bytes",
+            "crane_failover_seconds",
+        ):
+            check(f"family {required}", required in families)
+        replayed = sum(
+            s[2]
+            for s in families.get(
+                "crane_recovery_intents_replayed", {}
+            ).get("samples", ())
+        )
+        check("intents_replayed counted the replay", replayed >= 1,
+              f"replayed={replayed}")
+        reconciled = sum(
+            s[2]
+            for s in families.get(
+                "crane_recovery_reconciled_total", {}
+            ).get("samples", ())
+        )
+        check("reconciled_total counted outcomes", reconciled >= 1,
+              f"reconciled={reconciled}")
+    finally:
+        health.stop()
+        server.stop()
+
+    print(f"[recovery-smoke] {'PASS' if not failures else 'FAIL'}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
