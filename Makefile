@@ -10,7 +10,7 @@ IMAGE_SCHEDULER := $(REGISTRY)/crane-scheduler-tpu:$(GIT_VERSION)
 
 .PHONY: all native test test-fast bench sim e2e metrics-smoke \
 	desched-smoke chaos-smoke recovery-smoke trace-smoke drip-smoke \
-	dashboards \
+	overload-smoke dashboards \
 	clean images image-annotator image-scheduler push-images
 
 all: native test
@@ -60,6 +60,15 @@ chaos-smoke:
 # warm-standby failover; strict-parses the crane_recovery_* families
 recovery-smoke:
 	$(PYTHON) tools/recovery_smoke.py
+
+# seeded open-loop storm over the wire against an admission-controlled
+# sidecar: sheds must happen (429/503 + Retry-After), goodput must
+# survive, /healthz must stay 200 on the IO thread throughout, the
+# slowloris reaper must free half-sent connections, and the
+# crane_service_shed_total / admission / brownout families must
+# strict-parse — see doc/overload.md
+overload-smoke:
+	$(PYTHON) tools/overload_smoke.py
 
 # one pod traced end to end over a live stub apiserver (traceparent on
 # the bind POST, lifecycle record in the flight ring), then replayed

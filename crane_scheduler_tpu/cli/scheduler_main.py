@@ -90,6 +90,20 @@ def _serve(args, cluster, config, policy, journal, recovery,
         cluster, config, nrt_lister=cluster.nrt_lister, policy=policy,
         tie_break_seed=args.tie_break_seed,
     )
+    if args.bind_watermark_pods > 0:
+        # overload backpressure (ISSUE 13): pause dispatch windows while
+        # the kube write plane holds >= watermark un-sent writes, so an
+        # admission storm upstream cannot grow the bind queues unbounded
+        watermark = args.bind_watermark_pods
+
+        def _bind_backpressure():
+            while (
+                cluster.pending_writes() >= watermark
+                and not stop.is_set()
+            ):
+                time.sleep(0.01)
+
+        sched.bind_backpressure = _bind_backpressure
     queue = sched.open_queue(window=args.window)
     deadline = (
         time.monotonic() + args.run_seconds
@@ -189,6 +203,10 @@ def main(argv=None) -> int:
                              "SIGTERM/SIGINT)")
     parser.add_argument("--window", type=int, default=32,
                         help="--serve: drip dispatch window size")
+    parser.add_argument("--bind-watermark-pods", type=int, default=0,
+                        help="--serve: pause dispatch windows while the "
+                             "kube write plane holds this many un-sent "
+                             "writes (overload backpressure; 0 disables)")
     parser.add_argument("--lock-file", default=None,
                         help="--serve: leader-election lock path. The "
                              "process runs as a warm standby (mirror "

@@ -56,6 +56,25 @@ def main(argv=None) -> int:
                         help="host:port of process 0 (jax.distributed)")
     parser.add_argument("--num-processes", type=int, default=None)
     parser.add_argument("--process-id", type=int, default=None)
+    # overload protection (ISSUE 13): IO-thread admission control,
+    # brownout tiers, and the slowloris idle reaper — doc/overload.md
+    parser.add_argument("--admission-limit", type=int, default=0,
+                        help="adaptive concurrency cap (gradient limiter "
+                             "max); 0 disables admission control")
+    parser.add_argument("--queue-depth", type=int, default=64,
+                        help="per-tenant admission queue depth (excess "
+                             "sheds 503 + Retry-After)")
+    parser.add_argument("--tenant-rate", type=float, default=0.0,
+                        help="per-tenant request rate limit in req/s "
+                             "(token bucket; 0 = unlimited)")
+    parser.add_argument("--tenant-burst", type=float, default=10.0,
+                        help="token-bucket burst per tenant")
+    parser.add_argument("--idle-timeout", type=float, default=30.0,
+                        help="seconds before an idle/half-sent connection "
+                             "is reaped (slowloris defense; 0 disables)")
+    parser.add_argument("--stale-budget", type=float, default=30.0,
+                        help="brownout tier 1: max age of the cached "
+                             "pre-rendered response served under pressure")
     parser.add_argument("--flight-dir", default=None,
                         help="directory for the crash-safe flight recorder "
                              "(lifecycle records + spans as a bounded JSONL "
@@ -127,9 +146,28 @@ def main(argv=None) -> int:
         now_bucket_s=args.now_bucket,
     )
     service.refresh()
+    admission = brownout = None
+    if args.admission_limit > 0:
+        from ..service import AdmissionController, BrownoutController
+        from ..service.overload import GradientLimiter, TenantQueues
+
+        brownout = BrownoutController(
+            stale_budget_s=args.stale_budget,
+            telemetry=service.telemetry,
+        )
+        admission = AdmissionController(
+            limiter=GradientLimiter(max_limit=args.admission_limit),
+            queues=TenantQueues(depth=args.queue_depth),
+            tenant_rate=args.tenant_rate,
+            tenant_burst=args.tenant_burst,
+            brownout=brownout,
+            telemetry=service.telemetry,
+        )
     server = ScoringHTTPServer(
         service, port=args.port, frontend=args.frontend,
         workers=args.http_workers,
+        admission=admission, brownout=brownout,
+        idle_timeout_s=args.idle_timeout or None,
     )
     server.start()
     print(

@@ -66,6 +66,26 @@ SERVICE_ACCOUNT_TOKEN = "/var/run/secrets/kubernetes.io/serviceaccount/token"  #
 SERVICE_ACCOUNT_CA = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
 NRT_RETRY_SECONDS = 60.0  # re-probe cadence while the CRD is absent
 
+# deadline propagation (ISSUE 13): kube-bound POSTs forward the
+# thread's remaining budget — or mint the client's configured default —
+# beside traceparent. Lazily imported: the deadline module lives in the
+# service package and the header is only needed on the write path.
+_deadline_mod = None
+
+
+def _deadline_pair(default_budget_ms) -> tuple[str, str] | None:
+    global _deadline_mod
+    if _deadline_mod is None:
+        from ..service import deadline as _dm
+
+        _deadline_mod = _dm
+    dl = _deadline_mod.current()
+    if dl is not None:
+        return _deadline_mod.HEADER, dl.header_value()
+    if default_budget_ms:
+        return _deadline_mod.HEADER, f"{float(default_budget_ms):.3f}"
+    return None
+
 
 def node_from_json(obj: dict) -> Node:
     meta = obj.get("metadata", {})
@@ -787,6 +807,10 @@ class KubeClusterClient:
         write_breaker=None,
     ):
         self.base_url = base_url.rstrip("/")
+        # ISSUE 13: default budget (ms) minted as crane-deadline-ms on
+        # POSTs when no thread-local deadline is active (None = only
+        # forward an inherited deadline, mint nothing)
+        self.post_deadline_ms: float | None = None
         # ISSUE 8: per-fault-domain breakers. The read breaker sees one
         # outcome per LIST and per watch-stream iteration; the write
         # breaker one per pooled write. Both are OBSERVATIONAL on this
@@ -1729,6 +1753,16 @@ class KubeClusterClient:
                 out[status] = out.get(status, 0) + n
         return out
 
+    def pending_writes(self) -> int:
+        """Writes enqueued on the pooled workers but not yet sent — the
+        bind-plane depth signal for overload backpressure (ISSUE 13):
+        ``Scheduler.bind_backpressure`` can pause dispatch windows while
+        this sits above a watermark instead of letting an admission
+        storm grow the write queues without bound."""
+        with self._pool_lock:
+            workers = list(self._pool)
+        return sum(w.queue.qsize() for w in workers)
+
     @staticmethod
     def _reconnect_immediately(delivered: bool, failures: int,
                                lived: float, idle_expired: bool) -> bool:
@@ -2604,12 +2638,17 @@ class KubeClusterClient:
             m.labels(kind="post_batch").observe(time.perf_counter() - t0)
 
     def _trace_header(self, key: str) -> dict | None:
-        """``{"traceparent": ...}`` when the pod is lifecycle-tracked."""
+        """``{"traceparent": ...}`` when the pod is lifecycle-tracked,
+        plus the ``crane-deadline-ms`` budget (thread-local deadline,
+        else the client's configured POST default)."""
         lc = self._lifecycle
-        if lc is None:
-            return None
-        tp = lc.traceparent(key)
-        return {"traceparent": tp} if tp else None
+        tp = lc.traceparent(key) if lc is not None else None
+        headers = {"traceparent": tp} if tp else None
+        dl = _deadline_pair(self.post_deadline_ms)
+        if dl is not None:
+            headers = headers or {}
+            headers[dl[0]] = dl[1]
+        return headers
 
     @staticmethod
     def _intent_op(path: str) -> str | None:
@@ -2688,9 +2727,15 @@ class KubeClusterClient:
             if lc is not None else {}
         )
 
+        dl = _deadline_pair(self.post_deadline_ms)
+
         def _hdr(key):
             v = tp.get(key)
-            return {"traceparent": v} if v else None
+            headers = {"traceparent": v} if v else None
+            if dl is not None:
+                headers = headers or {}
+                headers[dl[0]] = dl[1]
+            return headers
 
         # crash-safety: journal every bind/eviction intent BEFORE any
         # route puts bytes on the wire (a kill after this point leaves

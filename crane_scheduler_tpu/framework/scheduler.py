@@ -269,6 +269,7 @@ class _BindFlushQueue:
         self._max_pods = int(max_pods)
         self._q: _queue.SimpleQueue = _queue.SimpleQueue()
         self._outstanding = 0
+        self._outstanding_pods = 0  # un-flushed pods (watermark signal)
         self._lock = threading.Lock()
         self._drained = threading.Condition(self._lock)
         self._closed = False
@@ -298,6 +299,7 @@ class _BindFlushQueue:
                      tracked=()) -> None:
         with self._lock:
             self._outstanding += 1
+            self._outstanding_pods += len(result.assignments)
         self._q.put(("batch", result, now, tracked))
 
     def submit_burst(self, namespace: str, names: list, node_table,
@@ -305,10 +307,38 @@ class _BindFlushQueue:
                      tracked=()) -> None:
         with self._lock:
             self._outstanding += 1
+            self._outstanding_pods += len(names)
         self._q.put(
             ("burst", namespace, names, node_table, node_idx, result, now,
              tracked)
         )
+
+    def depth_pods(self) -> int:
+        """Pods submitted but not yet flushed (the watermark signal)."""
+        with self._lock:
+            return self._outstanding_pods
+
+    def wait_below(self, watermark: int,
+                   timeout_s: float | None = None) -> bool:
+        """Backpressure (ISSUE 13): block the producer until the
+        un-flushed pod depth drops below ``watermark``. A saturated
+        bind plane propagates back to window admission instead of
+        queueing unboundedly. Returns False only on timeout; a worker
+        error returns True immediately (``flush`` will surface it)."""
+        deadline = (
+            None if timeout_s is None else time.monotonic() + timeout_s
+        )
+        with self._drained:
+            while self._outstanding_pods >= max(1, int(watermark)):
+                if self._error is not None:
+                    return True
+                wait = 0.5
+                if deadline is not None:
+                    wait = min(wait, deadline - time.monotonic())
+                    if wait <= 0:
+                        return False
+                self._drained.wait(timeout=wait)
+            return True
 
     def flush(self) -> None:
         """Block until every submitted bind has flushed; re-raises a
@@ -384,6 +414,9 @@ class _BindFlushQueue:
                 self._m_window_seconds.observe(open_seconds)
             with self._drained:
                 self._outstanding -= len(window)
+                self._outstanding_pods = max(
+                    0, self._outstanding_pods - count
+                )
                 self._drained.notify_all()
 
     def _flush_window_inner(self, window: list) -> None:
@@ -514,6 +547,12 @@ class Scheduler:
         self._telemetry = (
             telemetry if telemetry is not None else active_telemetry()
         )
+        # bind-plane backpressure (ISSUE 13): an optional callable that
+        # blocks while the downstream bind-flush queue is over its
+        # watermark — window admission pauses instead of queueing binds
+        # unboundedly. Wired by whoever owns the flush queue
+        # (scheduler_main --bind-watermark-pods, tests, bench).
+        self.bind_backpressure = None
         # device-resident batch engine (scorer.drip_batch), lazy like
         # the columns; _batch holds the dispatch-window distributions
         # drip_stats() exposes
@@ -1049,6 +1088,12 @@ class Scheduler:
         tie in the window). The kernel is pure w.r.t. the host columns,
         so rejecting a window costs only the kernel time."""
         dyn, _dyn_weight, tracker, _order = rec
+        bp = self.bind_backpressure
+        if bp is not None:
+            # admission pause: don't start a window the bind plane
+            # can't absorb (both schedule_queue and DripQueue funnel
+            # their windows through here)
+            bp()
         k = len(buf)
         drip = self._ensure_drip(rec)
         tel = self._telemetry
@@ -1752,7 +1797,8 @@ class BatchScheduler:
                                    depth: int = 4,
                                    overlap_refresh: bool = False,
                                    overlap_bind: bool = False,
-                                   bind_window_s: float = 0.005):
+                                   bind_window_s: float = 0.005,
+                                   bind_watermark_pods: "int | None" = None):
         """Pipelined burst scheduling: dispatch up to ``depth`` cycles
         ahead (JAX dispatch is asynchronous) and start each result's
         device->host copy immediately (``copy_to_host_async``) BEFORE
@@ -1788,7 +1834,14 @@ class BatchScheduler:
         one bind transaction overlapped against the next cycle, so wire
         latency stops serializing cycles. A yielded result's bind
         fields settle when its window flushes; consuming the generator
-        to completion settles every result."""
+        to completion settles every result.
+
+        ``bind_watermark_pods``: overload backpressure (ISSUE 13) —
+        when the background bind plane has at least this many pods
+        outstanding, pause dispatching new cycles until the flush
+        worker drains below the watermark. Keeps a storm of admitted
+        work from growing the bind queue without bound while the wire
+        is the bottleneck. Only meaningful with ``overlap_bind``."""
         from collections import deque
         from concurrent.futures import ThreadPoolExecutor
 
@@ -1818,6 +1871,8 @@ class BatchScheduler:
         lc = self._lifecycle
         try:
             for pods in batches:
+                if bindq is not None and bind_watermark_pods:
+                    bindq.wait_below(bind_watermark_pods)
                 now = self._clock()
                 # per-cycle trace context: the cycle's spans stamp with
                 # one trace id so lifecycle records can join the cycle
@@ -1908,6 +1963,7 @@ class BatchScheduler:
         self, bursts, bind: bool = True, depth: int = 4,
         overlap_refresh: bool = False, overlap_bind: bool = False,
         bind_window_s: float = 0.005,
+        bind_watermark_pods: "int | None" = None,
     ):
         """Pipelined columnar bursts: ``bursts`` yields ``(namespace,
         names)`` pairs; one ``BurstResult`` per burst, in order. Same
@@ -1921,7 +1977,9 @@ class BatchScheduler:
         ``bound_rows``/``node_idx`` settle when their window flushes;
         full consumption settles everything). Requires a burst-capable
         cluster (``add_pod_burst``/``bind_burst`` — ClusterState has
-        them)."""
+        them). ``bind_watermark_pods`` pauses dispatch while the bind
+        plane holds at least that many outstanding pods (ISSUE 13
+        backpressure; see ``schedule_batches_pipelined``)."""
         from collections import deque
 
         if depth < 1:
@@ -1953,6 +2011,8 @@ class BatchScheduler:
         lc = self._lifecycle
         try:
             for namespace, names in bursts:
+                if bindq is not None and bind_watermark_pods:
+                    bindq.wait_below(bind_watermark_pods)
                 now = self._clock()
                 ctx = tracing.new_context() if tel is not None else None
                 with tracing.use(ctx):

@@ -28,6 +28,13 @@ Four pieces, shared by every component:
 drives the kube/prometheus stubs to prove the above under injected
 faults (tests/test_chaos.py, tools/chaos_smoke.py, bench config 12).
 
+``loadgen`` (ISSUE 13) is the serving-plane counterpart of ``chaos``:
+a seeded open-loop ``StormSchedule`` (arrival timelines that do not
+negotiate with a slowing server), ``replay_admission`` for virtual-time
+deterministic replays of the admission state machine, ``run_open_loop``
+for firing the same schedule on real sockets, and ``SlowClientSwarm``
+as the slowloris injector the frontend's idle reaper must defeat.
+
 ``recovery`` (ISSUE 12) extends resilience from remote faults to the
 process's own death: the crash-safe placement-intent journal
 (``IntentJournal``), restart reconciliation (``Reconciler``), and the
@@ -39,6 +46,16 @@ from .breaker import BreakerOpenError, BreakerState, CircuitBreaker
 from .chaos import ChaosEvent, ChaosPlan
 from .degraded import DegradedModeController
 from .health import HealthRegistry, HealthState
+from .loadgen import (
+    Arrival,
+    SlowClientSwarm,
+    StormSchedule,
+    VirtualClock,
+    WireResult,
+    replay_admission,
+    run_open_loop,
+    timeline_counts,
+)
 from .recovery import (
     IntentJournal,
     JournalReplay,
@@ -52,6 +69,7 @@ from .recovery import (
 from .retry import RetryBudgetExceeded, RetryPolicy
 
 __all__ = [
+    "Arrival",
     "BreakerOpenError",
     "BreakerState",
     "CircuitBreaker",
@@ -68,6 +86,13 @@ __all__ = [
     "RetryBudgetExceeded",
     "RetryPolicy",
     "SimulatedCrash",
+    "SlowClientSwarm",
+    "StormSchedule",
+    "VirtualClock",
     "WarmStandby",
+    "WireResult",
+    "replay_admission",
     "replay_journal",
+    "run_open_loop",
+    "timeline_counts",
 ]

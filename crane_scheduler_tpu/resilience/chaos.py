@@ -20,6 +20,11 @@ unknown kinds raise so a typo'd plan fails loudly):
 - ``close_watches``                   — all watch streams dropped
 - ``watch_410(after)``                — watch resumes answered 410 Gone
 - ``skew_annotations(offset_s)``      — node stamps written clock-skewed
+- ``request_storm(rate_x, duration)`` — open-loop serving storm at
+  ``rate_x`` times baseline capacity for ``duration`` steps (ISSUE 13;
+  the applier points at a ``StormSchedule``/load driver, not a stub)
+- ``slow_client(count, stall_s)``     — slowloris: N connections that
+  send a partial request then stall, pinning frontend conn slots
 
 ``ChaosPlan.generate(seed, ...)`` builds a randomized-but-reproducible
 plan: every fault event is paired with a heal inside the horizon, so
@@ -71,9 +76,15 @@ _FAULT_KINDS: Tuple[Tuple[str, object], ...] = (
     # fault: only emitted when the caller opts in via kinds=, so plans
     # generated for wire-stub drivers never require a kill applier.
     ("kill_process", "restart_process"),
+    # overload (ISSUE 13): serving-plane faults — an open-loop request
+    # storm and slowloris clients. Opt-in like kill_process: they need
+    # a serving frontend to point at, which the wire-stub drivers for
+    # the kube/prom kinds don't have.
+    ("request_storm", "storm_heal"),
+    ("slow_client", None),
 )
 
-_OPT_IN_KINDS = frozenset({"kill_process"})
+_OPT_IN_KINDS = frozenset({"kill_process", "request_storm", "slow_client"})
 
 
 @dataclass
@@ -155,6 +166,15 @@ class ChaosPlan:
             elif kind == "skew_annotations":
                 # skew far enough that stamps look expired to the oracle
                 params["offset_s"] = rng.choice((-3600.0, -7200.0))
+            elif kind == "request_storm":
+                # rate multiplier vs. baseline capacity; duration in
+                # steps (the paired storm_heal marks the calm point,
+                # the burst itself ends after ``duration``)
+                params["rate_x"] = rng.choice((2.0, 3.0, 5.0))
+                params["duration"] = rng.randint(3, 10)
+            elif kind == "slow_client":
+                params["count"] = rng.randint(2, 8)
+                params["stall_s"] = round(rng.uniform(1.0, 10.0), 3)
             elif kind == "kill_process":
                 # absolute journal byte offset for the KillSwitch: any
                 # offset is legal (the crash-safety contract is "kill at

@@ -59,10 +59,19 @@ class RetryPolicy:
         self._clock = clock
 
     def backoff_s(self, attempt: int, retry_after_s: float = 0.0) -> float:
-        """Sleep before attempt ``attempt+1`` (attempt is 0-based)."""
+        """Sleep before attempt ``attempt+1`` (attempt is 0-based).
+
+        A server Retry-After is a FLOOR, not a schedule: sleeping
+        exactly the advertised value re-synchronizes every client a
+        mass-shed event turned away — they all come back in the same
+        instant and shed again (ISSUE 13). The jitter is added ON TOP
+        of the floor, so the server's minimum is always honored and
+        the retry wave spreads across a full jitter window."""
         cap = min(self.max_delay_s, self.base_delay_s * (2.0**attempt))
         jittered = self._rng.uniform(0.0, cap)
-        return max(jittered, retry_after_s)
+        if retry_after_s > 0.0:
+            return retry_after_s + jittered
+        return jittered
 
     def call(self, fn, *args, on_retry=None, **kwargs):
         """Run ``fn`` with bounded retries. Non-retryable exceptions

@@ -1,5 +1,24 @@
+from . import deadline
 from .scoring import ScoringService
 from .leader import LeaderElector
 from .http import ScoringHTTPServer, HealthServer
+from .overload import (
+    AdmissionController,
+    BrownoutController,
+    GradientLimiter,
+    TenantQueues,
+    TokenBucket,
+)
 
-__all__ = ["ScoringService", "LeaderElector", "ScoringHTTPServer", "HealthServer"]
+__all__ = [
+    "AdmissionController",
+    "BrownoutController",
+    "GradientLimiter",
+    "HealthServer",
+    "LeaderElector",
+    "ScoringHTTPServer",
+    "ScoringService",
+    "TenantQueues",
+    "TokenBucket",
+    "deadline",
+]

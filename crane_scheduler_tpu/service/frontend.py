@@ -19,13 +19,33 @@ thread. So:
 
 The stdlib ``ThreadingHTTPServer`` front end (``frontend="threaded"``
 on ``ScoringHTTPServer``) stays as the comparison/fallback path.
+
+Overload protection (ISSUE 13) lives on the IO thread, where a
+decision costs a dict lookup instead of a worker slot:
+
+- an ``inline_handler`` (the router's ``handle_inline``) answers
+  ``GET /healthz`` without a worker-pool hop, so probes stay green
+  when every worker is saturated or wedged;
+- an ``AdmissionController`` classifies each parsed request (expired
+  deadline → 504, over-rate tenant → 429 + Retry-After, background
+  priority under brownout tier 2 → 503) and gates job dispatch on an
+  adaptive concurrency limit, parking ready connections in bounded
+  per-tenant queues with weighted-fair handoff;
+- an idle/read-timeout reaper closes stalled connections (slowloris:
+  a half-sent request cannot pin a connection slot indefinitely).
+
+Every IO-thread response rides ``_enqueue_write`` — the same FIFO the
+workers use — so pipelined response ordering holds by construction no
+matter who answered.
 """
 
 from __future__ import annotations
 
+import json
 import selectors
 import socket
 import threading
+import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 
@@ -38,23 +58,43 @@ _REASONS = {
     400: "Bad Request",
     404: "Not Found",
     413: "Payload Too Large",
+    429: "Too Many Requests",
     431: "Request Header Fields Too Large",
     500: "Internal Server Error",
     501: "Not Implemented",
+    503: "Service Unavailable",
+    504: "Gateway Timeout",
 }
 
 
 def render_response(
-    status: int, content_type: str, body: bytes, close: bool = False
+    status: int, content_type: str, body: bytes, close: bool = False,
+    extra_headers: dict | None = None,
 ) -> bytes:
     head = (
         f"HTTP/1.1 {status} {_REASONS.get(status, 'OK')}\r\n"
         f"Content-Type: {content_type}\r\n"
         f"Content-Length: {len(body)}\r\n"
     )
+    if extra_headers:
+        for name, value in extra_headers.items():
+            head += f"{name}: {value}\r\n"
     if close:
         head += "Connection: close\r\n"
     return (head + "\r\n").encode("latin-1") + body
+
+
+def render_shed(status: int, reason: str, retry_after_s: float = 0.0,
+                close: bool = False) -> bytes:
+    """A pre-rendered shed response (429/503/504 + Retry-After)."""
+    body = json.dumps({"error": "overloaded" if status != 504
+                       else "deadline exceeded", "reason": reason}).encode()
+    extra = (
+        {"Retry-After": f"{retry_after_s:.3f}"} if retry_after_s > 0 else None
+    )
+    return render_response(
+        status, "application/json", body, close=close, extra_headers=extra
+    )
 
 
 class _Conn:
@@ -62,6 +102,7 @@ class _Conn:
         "sock", "fd", "inbuf", "outbuf", "scan_from", "head_end",
         "body_len", "req_head", "pending", "job_active", "close_after",
         "read_eof", "lock", "registered", "dead", "writes_queued",
+        "last_activity", "queued",
     )
 
     def __init__(self, sock):
@@ -81,6 +122,8 @@ class _Conn:
         self.registered = 0  # current selector interest mask
         self.dead = False
         self.writes_queued = 0  # responses enqueued but not yet drained
+        self.last_activity = time.monotonic()  # idle-reaper anchor
+        self.queued = False  # parked in an admission tenant queue
 
 
 class AsyncHTTPServer:
@@ -90,8 +133,18 @@ class AsyncHTTPServer:
     dispatch, single-flight waits)."""
 
     def __init__(self, handler, host: str = "127.0.0.1", port: int = 0,
-                 workers: int = 8):
+                 workers: int = 8, inline_handler=None, admission=None,
+                 idle_timeout_s: float | None = 30.0):
         self._handler = handler
+        # fast non-blocking answers on the IO thread (GET /healthz):
+        # (method, target, headers) -> (status, ctype, body) | None
+        self._inline = inline_handler
+        # overload.AdmissionController (or None = admit everything)
+        self._admission = admission
+        self._idle_timeout = (
+            float(idle_timeout_s) if idle_timeout_s else None
+        )
+        self._last_sweep = time.monotonic()
         self._listener = socket.create_server((host, port), backlog=512)
         self._listener.setblocking(False)
         self._sel = selectors.DefaultSelector()
@@ -107,6 +160,8 @@ class AsyncHTTPServer:
         self._stopping = threading.Event()
         self._thread: threading.Thread | None = None
         self.connections_accepted = 0
+        self.idle_closed = 0  # reaper victims (slowloris defense)
+        self.inline_served = 0  # IO-thread answers (no worker hop)
 
     @property
     def port(self) -> int:
@@ -139,9 +194,12 @@ class AsyncHTTPServer:
         sel = self._sel
         sel.register(self._listener, selectors.EVENT_READ, "accept")
         sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        tick = 1.0
+        if self._idle_timeout is not None:
+            tick = min(1.0, max(0.02, self._idle_timeout / 4.0))
         try:
             while not self._stopping.is_set():
-                for key, events in sel.select(timeout=1.0):
+                for key, events in sel.select(timeout=tick):
                     if key.data == "accept":
                         self._accept()
                     elif key.data == "wake":
@@ -153,6 +211,11 @@ class AsyncHTTPServer:
                             self._on_readable(conn)
                         if events & selectors.EVENT_WRITE and not conn.dead:
                             self._flush(conn)
+                if self._idle_timeout is not None:
+                    now = time.monotonic()
+                    if now - self._last_sweep >= tick:
+                        self._last_sweep = now
+                        self._sweep_idle(now)
         finally:
             for conn in list(self._conns.values()):
                 self._close_conn(conn)
@@ -191,7 +254,26 @@ class AsyncHTTPServer:
             self._sel.register(sock, selectors.EVENT_READ, conn)
             conn.registered = selectors.EVENT_READ
 
+    def _sweep_idle(self, now: float) -> None:
+        """Close connections with no forward progress for the idle
+        window — the slowloris defense. A connection with an active
+        job, parsed-but-unserved requests, or a parked admission slot
+        is the server's debt, not the client's, and is exempt."""
+        timeout = self._idle_timeout
+        for conn in list(self._conns.values()):
+            if conn.dead or now - conn.last_activity <= timeout:
+                continue
+            with conn.lock:
+                busy = conn.job_active or bool(conn.pending) or conn.queued
+            if busy or conn.writes_queued:
+                continue
+            self.idle_closed += 1
+            if self._admission is not None:
+                self._admission.count_shed("idle")
+            self._close_conn(conn)
+
     def _on_readable(self, conn: _Conn) -> None:
+        got_bytes = False
         try:
             while True:
                 try:
@@ -202,9 +284,12 @@ class AsyncHTTPServer:
                     conn.read_eof = True
                     break
                 conn.inbuf += chunk
+                got_bytes = True
         except OSError:
             self._close_conn(conn)
             return
+        if got_bytes:
+            conn.last_activity = time.monotonic()
         self._parse_requests(conn)
         if conn.dead:
             return
@@ -215,7 +300,10 @@ class AsyncHTTPServer:
 
     def _parse_requests(self, conn: _Conn) -> None:
         """Carve every complete request out of the connection buffer —
-        the whole pipelined backlog lands as one worker batch."""
+        the whole pipelined backlog lands as one worker batch. Each
+        request tuple carries a ``pre`` slot: a response the IO thread
+        already rendered (inline healthz, admission shed) that the
+        emitter uses instead of calling the handler."""
         batch: list = []
         while True:
             if conn.req_head is None:
@@ -239,21 +327,92 @@ class AsyncHTTPServer:
             conn.req_head = None
             conn.head_end = None
             conn.body_len = 0
-            batch.append((method, target, headers, body, keep))
+            batch.append((
+                method, target, headers, body, keep,
+                self._pre_answer(method, target, headers, keep),
+            ))
             if not keep:
                 # the client promised no more requests on this socket
                 conn.inbuf.clear()
                 conn.read_eof = True
                 break
         if batch:
-            with conn.lock:
-                conn.pending.extend(batch)
-                if not conn.job_active:
-                    conn.job_active = True
-                    try:
-                        self._pool.submit(self._conn_job, conn)
-                    except RuntimeError:  # pool shut down mid-stop
-                        conn.job_active = False
+            self._dispatch_batch(conn, batch)
+
+    def _pre_answer(self, method, target, headers, keep) -> bytes | None:
+        """IO-thread fast path for one parsed request: an inline answer
+        (healthz — no worker hop) or an admission shed (expired
+        deadline, over-rate tenant, priority). None = needs a worker."""
+        if self._inline is not None:
+            try:
+                answered = self._inline(method, target, headers)
+            except Exception:
+                answered = None
+            if answered is not None:
+                status, ctype, payload = answered
+                self.inline_served += 1
+                return render_response(
+                    status, ctype, payload, close=not keep
+                )
+        adm = self._admission
+        if adm is not None:
+            decision = adm.classify(method, target, headers)
+            if decision is not None:
+                adm.count_shed(decision.reason)
+                return render_shed(
+                    decision.status, decision.reason,
+                    decision.retry_after_s, close=not keep,
+                )
+        return None
+
+    def _dispatch_batch(self, conn: _Conn, batch: list) -> None:
+        """Hand a parsed batch to its emitter. All-pre batches on a
+        quiet connection are emitted straight from the IO thread via
+        the write FIFO (no worker, no admission slot — this is what
+        keeps /healthz green with a wedged pool); anything else joins
+        ``pending`` and takes the worker path, gated by admission."""
+        adm = self._admission
+        with conn.lock:
+            if (
+                not conn.pending and not conn.job_active and not conn.queued
+                and all(t[5] is not None for t in batch)
+            ):
+                out = b"".join(t[5] for t in batch)
+                close = any(not t[4] for t in batch)
+                self._enqueue_write(conn, out, close)
+                return
+            conn.pending.extend(batch)
+            if conn.job_active or conn.queued:
+                return  # the running job / future slot will consume it
+            if adm is None or adm.acquire():
+                conn.job_active = True
+                try:
+                    self._pool.submit(self._conn_job, conn)
+                except RuntimeError:  # pool shut down mid-stop
+                    conn.job_active = False
+                    if adm is not None:
+                        adm.finish()
+                return
+            from .overload import request_tenant
+
+            if adm.queue(request_tenant(batch[0][2]), conn):
+                conn.queued = True
+                return
+            # tenant queue full: shed the whole backlog, 503 each
+            backlog, conn.pending = conn.pending, []
+        out = bytearray()
+        close = False
+        for _m, _t, _h, _b, keep, pre in backlog:
+            if pre is not None:
+                out += pre  # already answered (inline / earlier shed)
+            else:
+                adm.count_shed("queue_full")
+                out += render_shed(
+                    503, "queue_full", adm.retry_after_s, close=not keep
+                )
+            if not keep:
+                close = True
+        self._enqueue_write(conn, bytes(out), close)
 
     def _parse_head(self, conn: _Conn, head: bytes) -> bool:
         try:
@@ -323,11 +482,17 @@ class AsyncHTTPServer:
                         # the IO thread may have seen job_active=True and
                         # skipped the close — nudge it to re-check
                         self._enqueue_write(conn, b"", False)
-                    return
+                    break
                 conn.pending = []
             out = bytearray()
             close = False
-            for method, target, headers, body, keep in batch:
+            for method, target, headers, body, keep, pre in batch:
+                if pre is not None:
+                    # answered on the IO thread; emit in request order
+                    out += pre
+                    if not keep:
+                        close = True
+                    continue
                 try:
                     status, ctype, payload = handler(
                         method, target, headers, body
@@ -343,7 +508,33 @@ class AsyncHTTPServer:
             if close:
                 with conn.lock:
                     conn.job_active = False
+                break
+        self._job_done()
+
+    def _job_done(self) -> None:
+        """This job's admission slot is free — hand it, weighted-fair,
+        to the next parked connection (skipping ones that died while
+        waiting)."""
+        adm = self._admission
+        if adm is None:
+            return
+        nxt = adm.finish()
+        while nxt is not None:
+            submit = False
+            with nxt.lock:
+                nxt.queued = False
+                if not nxt.dead and nxt.pending and not nxt.job_active:
+                    nxt.job_active = True
+                    submit = True
+            if submit:
+                try:
+                    self._pool.submit(self._conn_job, nxt)
+                except RuntimeError:  # pool shut down mid-stop
+                    with nxt.lock:
+                        nxt.job_active = False
+                    adm.finish()
                 return
+            nxt = adm.abandon()
 
     def _enqueue_write(self, conn: _Conn, data: bytes, close: bool) -> None:
         with self._writes_lock:
@@ -372,6 +563,10 @@ class AsyncHTTPServer:
             while conn.outbuf:
                 sent = conn.sock.send(conn.outbuf)
                 del conn.outbuf[:sent]
+                if sent:
+                    # send progress counts as activity: a slow-but-live
+                    # reader is not the reaper's business
+                    conn.last_activity = time.monotonic()
         except BlockingIOError:
             pass
         except OSError:

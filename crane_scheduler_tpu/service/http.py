@@ -46,7 +46,9 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..telemetry import tracing
-from .scoring import ScoringService
+from . import deadline as _deadline
+from .deadline import DeadlineExpiredError
+from .scoring import LatencyRing, ScoringService
 
 _JSON = "application/json"
 _OPENMETRICS = "application/openmetrics-text; version=1.0.0; charset=utf-8"
@@ -61,49 +63,108 @@ class ServiceRouter:
     ``(method, target, headers, body) -> (status, content_type, bytes)``.
     ``headers`` keys are lower-cased."""
 
-    def __init__(self, service: ScoringService, health=None):
+    def __init__(self, service: ScoringService, health=None,
+                 admission=None, brownout=None):
         self.service = service
         # HealthRegistry (ISSUE 8): /healthz serves its aggregated
         # snapshot — overall worst-of state plus per-component reasons —
         # instead of an unconditional "ok"
         self.health = health
+        # overload protection (ISSUE 13): the admission controller gets
+        # the accepted-request latency feed for its gradient limit; the
+        # brownout controller rides on the service (serve-stale path)
+        self.admission = admission
+        self.brownout = brownout
         reg = service.telemetry.registry
         self._m_request_seconds = reg.histogram(
             "crane_service_request_seconds",
-            "Service request handling latency",
+            "Service request handling latency (accepted requests only; "
+            "sheds land in crane_service_shed_total)",
             labelnames=("endpoint",),
         )
         self._m_inflight = reg.gauge(
             "crane_service_inflight", "Requests currently being handled"
         )
+        self._m_shed = reg.counter(
+            "crane_service_shed_total",
+            "Requests shed before serving, by reason",
+            labelnames=("reason",),
+        )
+        # accepted-request latency window: sheds are excluded so the
+        # exported p99 reflects traffic actually served
+        self.accepted_latencies = LatencyRing()
+        self._lat_lock = threading.Lock()
 
     def handle(self, method, target, headers, body):
         path, _, _ = target.partition("?")
         endpoint = path if path in _ENDPOINTS else "other"
         ctx = tracing.parse_traceparent(headers.get("traceparent"))
+        dl = _deadline.from_headers(headers)
         self._m_inflight.inc()
         start = time.perf_counter()
+        shed_reason = None
         try:
+            if dl is not None and dl.expired():
+                # budget burned on the wire or in the worker queue —
+                # shed before any service work
+                shed_reason = "deadline_queue"
+                return self._shed_response(shed_reason)
             try:
-                if ctx is None:
-                    return self._route(method, target, headers, body)
-                # traced request: the request span parents to the caller
-                # (the pod's root context) and service spans recorded
-                # inside — refresh, score_batch — parent to the request
-                with self.service.telemetry.spans.span(
-                    "service_request", ctx=ctx, endpoint=endpoint,
-                    method=method,
-                ):
-                    return self._route(method, target, headers, body)
+                with _deadline.use(dl):
+                    if ctx is None:
+                        return self._route(method, target, headers, body)
+                    # traced request: the request span parents to the
+                    # caller (the pod's root context) and service spans
+                    # recorded inside — refresh, score_batch — parent to
+                    # the request
+                    with self.service.telemetry.spans.span(
+                        "service_request", ctx=ctx, endpoint=endpoint,
+                        method=method,
+                    ):
+                        return self._route(method, target, headers, body)
+            except DeadlineExpiredError as exc:
+                # a checkpoint deeper in the stack (device dispatch)
+                # pulled the cord before the expensive step
+                shed_reason = f"deadline_{exc.stage}"
+                return self._shed_response(shed_reason)
             except Exception:
                 return 500, _JSON, json.dumps(
                     {"error": "internal error"}
                 ).encode()
         finally:
             self._m_inflight.dec()
-            self._m_request_seconds.labels(endpoint=endpoint).observe(
-                time.perf_counter() - start
-            )
+            elapsed = time.perf_counter() - start
+            if shed_reason is None:
+                self._m_request_seconds.labels(endpoint=endpoint).observe(
+                    elapsed
+                )
+                with self._lat_lock:
+                    self.accepted_latencies.record(elapsed)
+                if self.admission is not None and method == "POST":
+                    # the gradient limit keys on served-work latency;
+                    # probes/scrapes would only pollute the baseline
+                    self.admission.observe(elapsed)
+            else:
+                self._m_shed.labels(reason=shed_reason).inc()
+
+    @staticmethod
+    def _shed_response(reason: str) -> tuple[int, str, bytes]:
+        return 504, _JSON, json.dumps(
+            {"error": "deadline exceeded", "reason": reason}
+        ).encode()
+
+    def handle_inline(self, method, target, headers):
+        """The async front end's IO-thread fast path: answer what must
+        never wait on a worker slot. Only ``GET /healthz`` — the whole
+        point is a green probe while the pool is saturated or wedged.
+        Returns None for everything else (normal worker path)."""
+        path, _, _ = target.partition("?")
+        if method == "GET" and path == "/healthz":
+            try:
+                return self._route_get("/healthz", headers)
+            except Exception:
+                return None
+        return None
 
     @staticmethod
     def _json(code: int, payload) -> tuple[int, str, bytes]:
@@ -289,13 +350,21 @@ class ScoringHTTPServer:
         workers: int = 8,
         protocol: str = "HTTP/1.1",
         health=None,
+        admission=None,
+        brownout=None,
+        idle_timeout_s: float | None = 30.0,
     ):
         if frontend is None:
             frontend = os.environ.get("CRANE_SERVICE_FRONTEND", "async")
         if frontend not in ("async", "threaded"):
             raise ValueError(f"unknown frontend {frontend!r}")
         self.frontend = frontend
-        self.router = ServiceRouter(service, health=health)
+        if brownout is not None:
+            # the serve-stale brownout path lives in the service
+            service.brownout = brownout
+        self.router = ServiceRouter(
+            service, health=health, admission=admission, brownout=brownout
+        )
         self.httpd = None  # the threaded front end's stdlib server
         self._async = None
         self._thread: threading.Thread | None = None
@@ -310,7 +379,10 @@ class ScoringHTTPServer:
             from .frontend import AsyncHTTPServer
 
             self._async = AsyncHTTPServer(
-                self.router.handle, host=host, port=port, workers=workers
+                self.router.handle, host=host, port=port, workers=workers,
+                inline_handler=self.router.handle_inline,
+                admission=admission,
+                idle_timeout_s=idle_timeout_s,
             )
 
     @property

@@ -47,6 +47,7 @@ from ..policy.compile import compile_policy
 from ..policy.types import DynamicSchedulerPolicy
 from ..loadstore.store import NodeLoadStore
 from ..resilience.breaker import BreakerOpenError
+from . import deadline as _deadline
 from ..scorer import oracle
 from ..scorer.batched import BatchedScorer
 from ..telemetry import Telemetry
@@ -133,12 +134,18 @@ class _SingleFlight:
 class _ResponseCache:
     """Tiny thread-safe LRU for rendered response bodies. Keys embed the
     store version, so stale entries can never hit — the cap only bounds
-    memory across ``now`` buckets."""
+    memory across ``now`` buckets.
+
+    ``latest()`` is the brownout escape hatch (ISSUE 13): the newest
+    rendered body regardless of key, as long as it is younger than the
+    caller's relaxed staleness budget — under overload a slightly stale
+    answer beats a shed one."""
 
     def __init__(self, capacity: int = 16):
         self._capacity = capacity
         self._lock = threading.Lock()
         self._entries: dict = {}
+        self._latest: tuple[bytes, float] | None = None  # (body, mono_at)
 
     def get(self, key):
         with self._lock:
@@ -153,12 +160,25 @@ class _ResponseCache:
         with self._lock:
             self._entries.pop(key, None)
             self._entries[key] = body
+            self._latest = (body, time.monotonic())
             while len(self._entries) > self._capacity:
                 self._entries.pop(next(iter(self._entries)))
+
+    def latest(self, max_age_s: float) -> bytes | None:
+        """The most recently rendered body if it is at most
+        ``max_age_s`` old (monotonic clock), else None."""
+        with self._lock:
+            if self._latest is None:
+                return None
+            body, at = self._latest
+        if time.monotonic() - at > max_age_s:
+            return None
+        return body
 
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._latest = None
 
     def __len__(self) -> int:
         with self._lock:
@@ -173,6 +193,8 @@ class ServiceStats:
     coalesced_scores: int = 0  # requests served by another's dispatch
     response_cache_hits: int = 0
     fallbacks: int = 0
+    brownout_served: int = 0  # stale renders served under brownout
+    expired_at_dispatch: int = 0  # invariant counter: must stay 0
     last_refresh_at: float = 0.0
     last_score_seconds: float = 0.0
     score_seconds_total: float = 0.0
@@ -300,6 +322,21 @@ class ScoringService:
             "crane_scoring_degraded_scores_total",
             "score_batch calls served spread-only in degraded mode",
         )
+        # overload protection (ISSUE 13): brownout serve-stale + the
+        # zero-expired-dispatch invariant. ``brownout`` is assigned by
+        # the server wiring (ScoringHTTPServer / service_main).
+        self.brownout = None
+        self._m_brownout_served = reg.counter(
+            "crane_service_brownout_served_total",
+            "Score responses served from the newest pre-rendered body "
+            "at relaxed staleness under brownout",
+        )
+        self._m_expired_dispatch = reg.counter(
+            "crane_scoring_expired_at_dispatch_total",
+            "Requests whose deadline was already expired when the "
+            "device dispatch started (invariant: stays 0 — expired "
+            "requests are shed at earlier checkpoints)",
+        )
 
     # -- refresh -----------------------------------------------------------
 
@@ -359,7 +396,12 @@ class ScoringService:
     # -- scoring -----------------------------------------------------------
 
     def score_batch(self, now: float | None = None) -> BatchVerdicts:
-        """Score every node; never raises (fail-open to the oracle)."""
+        """Score every node; never raises on device failure (fail-open
+        to the oracle). The one deliberate exception: an expired
+        request deadline aborts BEFORE any scoring work — wasting a
+        device round-trip on an answer nobody is waiting for is the
+        failure mode ISSUE 13 exists to prevent."""
+        _deadline.check("dispatch")
         if now is None:
             now = self._clock()
         start = time.perf_counter()
@@ -406,6 +448,13 @@ class ScoringService:
     def _score_tpu(self, now: float) -> BatchVerdicts:
         import numpy as np
 
+        dl = _deadline.current()
+        if dl is not None and dl.expired():
+            # should be unreachable (earlier checkpoints shed first);
+            # counted, not raised, so the invariant is observable
+            self._m_expired_dispatch.inc()
+            with self._stats_lock:
+                self.stats.expired_at_dispatch += 1
         snap = self.store.snapshot(bucket=self._bucket)
         res = self.scorer(
             snap.values, snap.ts, snap.hot_value, snap.hot_ts, snap.node_valid, now
@@ -486,6 +535,18 @@ class ScoringService:
         cached (a recovered device must win the next request)."""
         if self.legacy_mode:
             return self._score_response_legacy(now, refresh)
+        bo = self.brownout
+        if bo is not None and bo.tier >= 1:
+            # brownout: the newest pre-rendered body at the relaxed
+            # staleness bound beats a refresh + dispatch — and far
+            # beats a shed. A cold cache falls through to the normal
+            # path (tier 1 still serves; it just serves fresher).
+            stale = self._resp_cache.latest(bo.stale_budget_s)
+            if stale is not None:
+                with self._stats_lock:
+                    self.stats.brownout_served += 1
+                self._m_brownout_served.inc()
+                return stale
         if refresh:
             self.refresh_coalesced()
         now_val = self._resolve_now(now)
@@ -496,6 +557,10 @@ class ScoringService:
                 self.stats.response_cache_hits += 1
             self._m_resp_cache_hits.inc()
             return body
+        # last checkpoint before the expensive step: a request whose
+        # budget died in refresh/cache-miss handling must not start a
+        # device dispatch it cannot use
+        _deadline.check("dispatch")
 
         def compute() -> bytes:
             verdicts = self.score_batch(now=now_val)
@@ -683,6 +748,8 @@ class ScoringService:
                 "coalesced_scores": self.stats.coalesced_scores,
                 "response_cache_hits": self.stats.response_cache_hits,
                 "fallbacks": self.stats.fallbacks,
+                "brownout_served": self.stats.brownout_served,
+                "expired_at_dispatch": self.stats.expired_at_dispatch,
                 "last_refresh_at": self.stats.last_refresh_at,
                 "last_score_seconds": self.stats.last_score_seconds,
                 "score_seconds_total": self.stats.score_seconds_total,

@@ -17,6 +17,7 @@ from __future__ import annotations
 import json
 import os
 import queue
+import socket
 import threading
 import time
 from collections import deque
@@ -46,6 +47,9 @@ class KubeStubState:
         self.requests: list[tuple[str, str]] = []  # (method, path) log
         # W3C trace headers observed on writes: (method, path, traceparent)
         self.trace_headers: list[tuple[str, str, str]] = []
+        # crane-deadline-ms budgets observed on writes (ISSUE 13):
+        # (method, path, value)
+        self.deadline_headers: list[tuple[str, str, str]] = []
         self.connections = 0  # TCP accepts (keep-alive reuse visible here)
         self.open_sockets: list = []  # live connections (severed on stop)
         self._rv = 0  # global resourceVersion counter (like etcd's)
@@ -443,7 +447,20 @@ def _make_handler(state: KubeStubState):
             if status == 0:
                 # reset: the request was fully read but never answered —
                 # close the stream so everything pipelined behind it on
-                # this connection dies with it
+                # this connection dies with it. Half-close and drain the
+                # unread pipelined backlog first: closing with bytes
+                # still in the kernel receive buffer turns the FIN into
+                # an RST, and an RST destroys responses the client has
+                # not yet read — the already-answered requests must stay
+                # answered for the indeterminate accounting to hold.
+                try:
+                    self.wfile.flush()
+                    self.connection.shutdown(socket.SHUT_WR)
+                    self.connection.settimeout(1.0)
+                    while self.connection.recv(65536):
+                        pass
+                except OSError:
+                    pass
                 self.close_connection = True
                 return
             if status == -1:
@@ -835,6 +852,9 @@ def _make_handler(state: KubeStubState):
             tp = self.headers.get("traceparent")
             if tp:
                 state.trace_headers.append(("POST", self.path, tp))
+            dl = self.headers.get("crane-deadline-ms")
+            if dl:
+                state.deadline_headers.append(("POST", self.path, dl))
             body = self._read_body()
             parts = self.path.strip("/").split("/")
             code, payload = 404, {"message": "bad post path"}

@@ -1,0 +1,780 @@
+"""Overload-resilient serving (ISSUE 13): deadline propagation,
+adaptive admission control, brownout tiers, the slowloris reaper, and
+the scheduler-side bind backpressure.
+
+The contract under test: an open-loop storm is decided on the IO
+thread (429/503/504 + Retry-After) before it costs a worker slot or a
+device round-trip; ``/healthz`` stays green with a wedged pool; a
+half-sent request cannot pin a connection slot; sheds never pollute
+the accepted-request latency window; server Retry-After plus client
+full-jitter backoff produces no synchronized retry waves; and an
+expired deadline never reaches device dispatch
+(``expired_at_dispatch`` stays 0).
+"""
+
+import importlib.util
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from crane_scheduler_tpu.policy import DEFAULT_POLICY
+from crane_scheduler_tpu.service import deadline as dl_mod
+from crane_scheduler_tpu.service.deadline import (
+    Deadline,
+    DeadlineExpiredError,
+    parse_budget_ms,
+)
+from crane_scheduler_tpu.service.overload import (
+    AdmissionController,
+    BrownoutController,
+    GradientLimiter,
+    TenantQueues,
+    TokenBucket,
+)
+from crane_scheduler_tpu.sim import SimConfig, Simulator
+
+_STUB = os.path.join(os.path.dirname(__file__), "kube_stub.py")
+_spec = importlib.util.spec_from_file_location("kube_stub", _STUB)
+kube_stub = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(kube_stub)
+
+
+def make_sim(n_nodes=4, seed=0):
+    sim = Simulator(SimConfig(n_nodes=n_nodes, seed=seed))
+    sim.sync_metrics()
+    return sim
+
+
+def make_service(sim, **kwargs):
+    from crane_scheduler_tpu.service import ScoringService
+
+    svc = ScoringService(sim.cluster, DEFAULT_POLICY, **kwargs)
+    svc.refresh()
+    return svc
+
+
+# --- deadline propagation ---------------------------------------------------
+
+
+def test_parse_budget_ms_strict():
+    assert parse_budget_ms("250") == 250.0
+    assert parse_budget_ms("  1.5 ") == 1.5
+    assert parse_budget_ms(300) == 300.0
+    assert parse_budget_ms(-5.0) == -5.0  # parseable => already expired
+    for bad in (None, "", "abc", "nan", "inf", "-inf", True, [1], {}):
+        assert parse_budget_ms(bad) is None, bad
+
+
+def test_deadline_budget_and_expiry():
+    dl = Deadline.from_budget_ms(250.0, now=100.0)
+    assert dl.remaining_ms(now=100.0) == pytest.approx(250.0)
+    assert not dl.expired(now=100.2)
+    assert dl.expired(now=100.3)
+    # header re-mints the REMAINING budget, floored at zero
+    assert float(dl.header_value(now=100.1)) == pytest.approx(150.0)
+    assert dl.header_value(now=200.0) == "0.000"
+    with pytest.raises(DeadlineExpiredError) as exc:
+        dl.check("dispatch", now=100.4)
+    assert exc.value.stage == "dispatch"
+    assert exc.value.overrun_ms == pytest.approx(150.0)
+    dl.check("dispatch", now=100.1)  # in budget: no raise
+
+
+def test_deadline_anchor_charges_queue_wait():
+    # the async front end anchors at parse; the worker-side re-parse
+    # must charge the wait between the two, not restart the budget
+    headers = {dl_mod.HEADER: "50"}
+    parsed = dl_mod.anchor_headers(headers, now=10.0)
+    assert parsed is not None and not parsed.expired(now=10.01)
+    assert dl_mod._ANCHOR_KEY in headers
+    later = dl_mod.from_headers(headers, now=10.2)  # 200ms queue wait
+    assert later.expired(now=10.2)
+    # without the anchor the same wire header would look fresh
+    fresh = dl_mod.from_headers({dl_mod.HEADER: "50"}, now=10.2)
+    assert not fresh.expired(now=10.2)
+
+
+def test_deadline_thread_local_use():
+    assert dl_mod.current() is None
+    dl_mod.check("anywhere")  # unbounded: no-op
+    dl = Deadline.from_budget_ms(10_000.0)
+    with dl_mod.use(dl):
+        assert dl_mod.current() is dl
+        with dl_mod.use(None):  # passthrough, not a reset
+            assert dl_mod.current() is dl
+    assert dl_mod.current() is None
+
+
+def test_deadline_malformed_headers_ignored():
+    assert dl_mod.from_headers({}) is None
+    assert dl_mod.from_headers({dl_mod.HEADER: "garbage"}) is None
+    assert dl_mod.anchor_headers({dl_mod.HEADER: "inf"}) is None
+
+
+# --- admission primitives ---------------------------------------------------
+
+
+def test_token_bucket_rate_and_retry_after():
+    b = TokenBucket(rate=10.0, burst=2.0)
+    assert b.try_take(0.0) and b.try_take(0.0)  # burst
+    assert not b.try_take(0.0)
+    assert b.retry_after_s(0.0) == pytest.approx(0.1)
+    assert b.try_take(0.11)  # one token refilled
+    unlimited = TokenBucket(rate=0.0, burst=1.0)
+    assert all(unlimited.try_take(0.0) for _ in range(100))
+    assert unlimited.retry_after_s(0.0) == 0.0
+
+
+def test_gradient_limiter_cuts_on_inflation_and_recovers():
+    lim = GradientLimiter(min_limit=1, max_limit=32, initial=32)
+    for _ in range(20):
+        lim.observe(0.01)
+    healthy = lim.limit
+    assert healthy >= 30  # stable latency keeps the limit up
+    trough = healthy
+    for _ in range(40):
+        lim.observe(0.2)  # 20x inflation
+        trough = min(trough, lim.limit)
+    assert trough < healthy / 2  # the storm squeezed concurrency
+    # sustained slowness re-baselines (by design) so the limit climbs
+    # off the floor rather than pinning at min forever
+    assert lim.limit > trough
+    for _ in range(300):
+        lim.observe(0.01)
+    assert lim.limit == lim.max_limit  # healthy latency fully re-opens
+
+
+def test_tenant_queues_bounded_and_weighted_fair():
+    q = TenantQueues(depth=2, weights={"gold": 2.0, "bronze": 1.0})
+    assert q.push("gold", "g1") and q.push("gold", "g2")
+    assert not q.push("gold", "g3")  # per-tenant bound
+    assert q.push("bronze", "b1") and q.push("bronze", "b2")
+    assert len(q) == 4
+    drained = [q.pop() for _ in range(4)]
+    assert q.pop() is None
+    # weighted-fair: gold drains ahead 2:1, FIFO within each tenant
+    assert drained.index("g1") < drained.index("g2")
+    assert drained.index("b1") < drained.index("b2")
+    assert drained[0] == "g1"
+
+    # sustained 2:1 service ratio under continuous backlog
+    q2 = TenantQueues(depth=1000, weights={"gold": 2.0, "bronze": 1.0})
+    for i in range(300):
+        q2.push("gold", ("g", i))
+        q2.push("bronze", ("b", i))
+    first = [q2.pop()[0] for _ in range(90)]
+    assert first.count("g") == 60 and first.count("b") == 30
+
+
+def test_admission_classify_rate_limit_and_exemptions():
+    clock = [0.0]
+    adm = AdmissionController(
+        tenant_rate=1.0, tenant_burst=1.0, retry_after_s=0.5,
+        clock=lambda: clock[0],
+    )
+    assert adm.classify("POST", "/v1/score", {}) is None
+    decision = adm.classify("POST", "/v1/score", {})
+    assert decision is not None and decision.status == 429
+    assert decision.reason == "rate_limit"
+    assert decision.retry_after_s >= 0.5
+    # probes and scrapes are never admission-gated
+    assert adm.classify("GET", "/healthz", {}) is None
+    assert adm.classify("GET", "/metrics?x=1", {}) is None
+    # distinct tenants meter independently
+    assert adm.classify("POST", "/v1/score", {"crane-tenant": "b"}) is None
+
+
+def test_admission_classify_sheds_expired_deadline():
+    adm = AdmissionController(clock=lambda: 50.0)
+    decision = adm.classify(
+        "POST", "/v1/score", {dl_mod.HEADER: "-1"}, now=50.0
+    )
+    assert decision is not None
+    assert (decision.status, decision.reason) == (504, "deadline_parse")
+
+
+def test_admission_slot_lifecycle_and_weighted_handoff():
+    adm = AdmissionController(
+        limiter=GradientLimiter(min_limit=1, max_limit=1, initial=1),
+        queues=TenantQueues(depth=2),
+    )
+    assert adm.acquire()
+    assert not adm.acquire()  # limit 1
+    assert adm.queue("default", "parked-1")
+    assert adm.queue("default", "parked-2")
+    assert not adm.queue("default", "parked-3")  # queue full
+    assert adm.pressure() == pytest.approx(3.0)  # (1 + 2) / 1
+    assert adm.finish() == "parked-1"  # slot handed over, FIFO
+    assert adm.abandon() == "parked-2"  # dead conn: next in line
+    assert adm.finish() is None
+    assert adm.pressure() == pytest.approx(0.0)
+    assert adm.stats["admitted"] == 0 and adm.stats["queued"] == 2
+
+
+def test_brownout_tiers_hysteresis():
+    bo = BrownoutController(enter1=1.2, exit1=0.8, enter2=3.0, exit2=1.5)
+    assert bo.tier == 0
+    assert bo.note(1.0) == 0  # below enter1
+    assert bo.note(1.5) == 1  # entered tier 1
+    assert bo.note(1.0) == 1  # hysteresis: needs < exit1 to leave
+    assert bo.note(3.5) == 2
+    assert bo.note(2.0) == 2  # needs < exit2 to leave
+    assert bo.note(1.0) == 1
+    assert bo.note(0.5) == 0
+    with pytest.raises(ValueError):
+        BrownoutController(enter1=1.0, exit1=1.0, enter2=3.0, exit2=1.5)
+
+
+def test_brownout_floored_by_degraded_mode():
+    class _Degraded:
+        active = True
+
+    bo = BrownoutController(degraded=_Degraded())
+    assert bo.tier == 1  # cluster-wide staleness floors the tier
+    bo.note(5.0)
+    assert bo.tier == 2  # pressure still escalates past the floor
+    bo.note(0.1)
+    assert bo.tier == 1  # never back to 0 while degraded
+
+
+def test_admission_priority_shed_under_tier2():
+    bo = BrownoutController()
+    bo.note(5.0)
+    assert bo.tier == 2
+    adm = AdmissionController(brownout=bo, clock=lambda: 0.0)
+    low = adm.classify("POST", "/v1/score", {"crane-priority": "low"})
+    assert low is not None and (low.status, low.reason) == (503, "priority")
+    assert adm.classify("POST", "/v1/score", {}) is None  # normal priority
+
+
+# --- service integration: brownout serve-stale, dispatch gate ---------------
+
+
+def test_brownout_serves_stale_render():
+    sim = make_sim(4, seed=21)
+    svc = make_service(sim)
+
+    class _Tier:
+        tier = 0
+        stale_budget_s = 30.0
+
+    svc.brownout = _Tier()
+    now = sim.clock.now()
+    fresh = svc.score_response_bytes(now=now, refresh=False)
+    _Tier.tier = 1
+    # a different `now` would miss the response cache and re-dispatch;
+    # under brownout it serves the newest render instead
+    stale = svc.score_response_bytes(now=now + 5.0, refresh=False)
+    assert stale == fresh
+    assert svc.metrics()["brownout_served"] == 1
+    assert svc.metrics()["score_calls"] == 1  # no second dispatch
+    _Tier.tier = 0
+    refreshed = svc.score_response_bytes(now=now + 5.0, refresh=False)
+    assert refreshed != fresh  # healthy again: rendered for real
+
+
+def test_expired_deadline_never_reaches_dispatch():
+    sim = make_sim(3, seed=22)
+    svc = make_service(sim)
+    expired = Deadline(time.monotonic() - 1.0)
+    with dl_mod.use(expired):
+        with pytest.raises(DeadlineExpiredError) as exc:
+            svc.score_batch()
+        assert exc.value.stage == "dispatch"
+        with pytest.raises(DeadlineExpiredError):
+            svc.score_response_bytes(now=sim.clock.now(), refresh=False)
+    # the invariant counter: the gate fired BEFORE _score_tpu ran
+    assert svc.metrics()["expired_at_dispatch"] == 0
+    assert svc.metrics()["score_calls"] == 0
+    # an in-budget deadline passes through untouched
+    with dl_mod.use(Deadline.from_budget_ms(60_000.0)):
+        verdicts = svc.score_batch()
+    assert len(verdicts.scores) == 3
+    assert svc.metrics()["expired_at_dispatch"] == 0
+
+
+def test_router_sheds_expired_at_queue_and_excludes_from_latency():
+    from crane_scheduler_tpu.service.http import ServiceRouter
+
+    sim = make_sim(3, seed=23)
+    svc = make_service(sim)
+    adm = AdmissionController()
+    router = ServiceRouter(svc, admission=adm)
+
+    status, _, body = router.handle(
+        "POST", "/v1/score", {dl_mod.HEADER: "-1"},
+        json.dumps({"refresh": False}).encode(),
+    )
+    assert status == 504
+    assert json.loads(body)["reason"] == "deadline_queue"
+    # sheds never land in the accepted-latency window or the gradient feed
+    assert len(router.accepted_latencies) == 0
+    assert adm.stats["observed"] == 0
+
+    status, _, _ = router.handle(
+        "POST", "/v1/score", {dl_mod.HEADER: "60000"},
+        json.dumps({"refresh": False, "now": sim.clock.now()}).encode(),
+    )
+    assert status == 200
+    assert len(router.accepted_latencies) == 1
+    assert adm.stats["observed"] == 1  # accepted POST feeds the limiter
+
+    text = svc.render_prometheus()
+    assert 'crane_service_shed_total{reason="deadline_queue"} 1' in text
+
+
+# --- async front end: inline healthz, wire sheds, slowloris reaper ----------
+
+
+def _recv_http_responses(sock, count, timeout=15.0):
+    """Read ``count`` Content-Length-framed responses off a raw socket."""
+    sock.settimeout(timeout)
+    buf = bytearray()
+    out = []
+    while len(out) < count:
+        head_end = buf.find(b"\r\n\r\n")
+        if head_end < 0:
+            chunk = sock.recv(65536)
+            assert chunk, "server closed mid-response"
+            buf += chunk
+            continue
+        head = bytes(buf[:head_end]).decode("latin-1")
+        length = 0
+        for line in head.split("\r\n")[1:]:
+            name, _, value = line.partition(":")
+            if name.strip().lower() == "content-length":
+                length = int(value.strip())
+        total = head_end + 4 + length
+        while len(buf) < total:
+            chunk = sock.recv(65536)
+            assert chunk, "server closed mid-body"
+            buf += chunk
+        out.append((head, bytes(buf[head_end + 4:total])))
+        del buf[:total]
+    return out
+
+
+def _get(target, headers=""):
+    return (
+        f"GET {target} HTTP/1.1\r\nHost: t\r\n{headers}\r\n"
+    ).encode()
+
+
+def _post(target, payload, headers=""):
+    body = json.dumps(payload).encode()
+    return (
+        f"POST {target} HTTP/1.1\r\nHost: t\r\n"
+        f"Content-Type: application/json\r\n{headers}"
+        f"Content-Length: {len(body)}\r\n\r\n"
+    ).encode() + body
+
+
+def test_healthz_inline_with_wedged_worker_pool():
+    """Satellite (a): GET /healthz is answered on the IO thread — a
+    pool wedged solid on stuck handlers cannot take the probe down."""
+    from crane_scheduler_tpu.service.frontend import AsyncHTTPServer
+
+    release = threading.Event()
+
+    def wedged_handler(method, target, headers, body):
+        release.wait(timeout=30.0)
+        return 200, "application/json", b'{"late": true}'
+
+    def inline(method, target, headers):
+        if method == "GET" and target.partition("?")[0] == "/healthz":
+            return 200, "application/json", b'{"status": "ok"}'
+        return None
+
+    srv = AsyncHTTPServer(
+        wedged_handler, workers=2, inline_handler=inline,
+        idle_timeout_s=None,
+    )
+    srv.start()
+    wedgers = []
+    try:
+        # wedge every worker slot with a POST that never returns
+        for _ in range(2):
+            s = socket.create_connection(("127.0.0.1", srv.port))
+            s.sendall(_post("/v1/score", {}))
+            wedgers.append(s)
+        time.sleep(0.2)  # let both jobs occupy the pool
+        with socket.create_connection(("127.0.0.1", srv.port)) as probe:
+            probe.sendall(_get("/healthz"))
+            (head, body), = _recv_http_responses(probe, 1, timeout=5.0)
+        assert head.startswith("HTTP/1.1 200")
+        assert json.loads(body)["status"] == "ok"
+        assert srv.inline_served >= 1
+    finally:
+        release.set()
+        for s in wedgers:
+            s.close()
+        srv.stop()
+
+
+def test_idle_reaper_frees_slowloris_connections():
+    """Satellite (b): a half-sent request cannot pin a connection slot
+    past the idle window; a connection with an in-flight job is exempt."""
+    from crane_scheduler_tpu.resilience import SlowClientSwarm
+    from crane_scheduler_tpu.service.frontend import AsyncHTTPServer
+
+    def slow_handler(method, target, headers, body):
+        time.sleep(0.7)  # far past the idle window, but job-active
+        return 200, "application/json", b'{"ok": true}'
+
+    srv = AsyncHTTPServer(slow_handler, workers=2, idle_timeout_s=0.25)
+    srv.start()
+    try:
+        legit = socket.create_connection(("127.0.0.1", srv.port))
+        legit.sendall(_post("/v1/score", {}))
+        with SlowClientSwarm("127.0.0.1", srv.port, count=3) as swarm:
+            assert swarm.wait_closed(3, timeout_s=10.0) == 3
+        assert srv.idle_closed >= 3
+        # the in-flight request rode out a job longer than the idle
+        # window: busy connections are the server's debt, not reaped
+        (head, body), = _recv_http_responses(legit, 1, timeout=10.0)
+        assert head.startswith("HTTP/1.1 200")
+        legit.close()
+    finally:
+        srv.stop()
+
+
+@pytest.fixture()
+def overload_server():
+    sim = make_sim(4, seed=31)
+    svc = make_service(sim)
+    from crane_scheduler_tpu.service import ScoringHTTPServer
+
+    brownout = BrownoutController(telemetry=svc.telemetry)
+    admission = AdmissionController(
+        limiter=GradientLimiter(min_limit=1, max_limit=2, initial=2),
+        queues=TenantQueues(depth=4),
+        tenant_rates={"metered": 1.0},
+        tenant_burst=1.0,
+        brownout=brownout,
+        telemetry=svc.telemetry,
+    )
+    srv = ScoringHTTPServer(
+        svc, port=0, frontend="async", admission=admission,
+        brownout=brownout, idle_timeout_s=5.0,
+    )
+    srv.start()
+    try:
+        yield sim, svc, srv, admission
+    finally:
+        srv.stop()
+
+
+def test_wire_shed_rate_limited_tenant(overload_server):
+    """Satellite (c) on the wire: the over-rate tenant gets 429 +
+    Retry-After from the IO thread; the shed is counted by reason and
+    the accepted-latency window never sees it."""
+    sim, svc, srv, admission = overload_server
+    hdr = "crane-tenant: metered\r\n"
+    with socket.create_connection(("127.0.0.1", srv.port)) as sock:
+        sock.sendall(_post(
+            "/v1/score", {"refresh": False, "now": sim.clock.now()},
+            headers=hdr,
+        ))
+        (head1, _), = _recv_http_responses(sock, 1)
+        sock.sendall(_post("/v1/score", {"refresh": False}, headers=hdr))
+        (head2, body2), = _recv_http_responses(sock, 1)
+    assert head1.startswith("HTTP/1.1 200")
+    assert head2.startswith("HTTP/1.1 429")
+    assert "Retry-After:" in head2
+    assert json.loads(body2)["reason"] == "rate_limit"
+    accepted = len(srv.router.accepted_latencies)
+    text = svc.render_prometheus()
+    assert 'crane_service_shed_total{reason="rate_limit"} 1' in text
+    assert accepted == 1  # only the 200 landed in the window
+
+
+def test_wire_shed_expired_deadline_504(overload_server):
+    sim, svc, srv, admission = overload_server
+    with socket.create_connection(("127.0.0.1", srv.port)) as sock:
+        sock.sendall(_post(
+            "/v1/score", {"refresh": False},
+            headers=f"{dl_mod.HEADER}: -1\r\n",
+        ))
+        (head, body), = _recv_http_responses(sock, 1)
+    assert head.startswith("HTTP/1.1 504")
+    assert json.loads(body)["reason"] == "deadline_parse"
+    assert 'reason="deadline_parse"' in svc.render_prometheus()
+
+
+def test_healthz_and_metrics_exempt_while_storming(overload_server):
+    sim, svc, srv, admission = overload_server
+    # exhaust the metered tenant so POSTs shed...
+    with socket.create_connection(("127.0.0.1", srv.port)) as sock:
+        for _ in range(3):
+            sock.sendall(_post(
+                "/v1/score", {"refresh": False},
+                headers="crane-tenant: metered\r\n",
+            ))
+        _recv_http_responses(sock, 3)
+        # ...but the probe and the scrape on the same connection answer 200
+        sock.sendall(_get("/healthz"))
+        (head, _), = _recv_http_responses(sock, 1)
+        assert head.startswith("HTTP/1.1 200")
+        sock.sendall(_get("/metrics", headers="Accept: text/plain\r\n"))
+        (mhead, mbody), = _recv_http_responses(sock, 1)
+        assert mhead.startswith("HTTP/1.1 200")
+        assert b"crane_service_shed_total" in mbody
+
+
+# --- retry de-synchronization (satellite d) ---------------------------------
+
+
+def test_retry_after_floor_plus_jitter_desynchronizes_wave():
+    """A mass-shed answers every client the same Retry-After. Sleeping
+    exactly that value re-synchronizes the wave; the client policy must
+    honor the floor and SPREAD the come-back times."""
+    from crane_scheduler_tpu.resilience.retry import RetryPolicy
+
+    retry_after = 1.0
+    delays = []
+    for seed in range(40):
+        p = RetryPolicy(base_delay_s=0.2, max_delay_s=0.5, seed=seed)
+        delays.append(p.backoff_s(0, retry_after_s=retry_after))
+    assert all(d >= retry_after for d in delays)  # the floor holds
+    assert max(delays) - min(delays) > 0.05  # ...but spread out
+    assert len({round(d, 4) for d in delays}) > 30  # no herd instant
+
+
+def test_shed_response_feeds_client_retry_after():
+    """The wire 429's Retry-After parses into the float the client
+    RetryPolicy consumes as its floor."""
+    from crane_scheduler_tpu.service.frontend import render_shed
+
+    raw = render_shed(429, "rate_limit", retry_after_s=0.75)
+    head = raw.split(b"\r\n\r\n", 1)[0].decode("latin-1")
+    value = None
+    for line in head.split("\r\n")[1:]:
+        name, _, v = line.partition(":")
+        if name.strip().lower() == "retry-after":
+            value = float(v.strip())
+    assert value == pytest.approx(0.75)
+
+
+# --- kube-bound deadline forwarding -----------------------------------------
+
+
+def test_kube_posts_carry_deadline_header():
+    from crane_scheduler_tpu.cluster.kube import KubeClusterClient
+
+    stub = kube_stub.KubeStubServer().start()
+    client = KubeClusterClient(stub.url)
+    try:
+        stub.state.add_node("node-a", "10.0.0.1")
+        stub.state.add_pod("default", "p1")
+        stub.state.add_pod("default", "p2")
+        stub.state.add_pod("default", "p3")
+        client.start()
+
+        # no thread-local deadline, no default: no header minted
+        assert client.bind_pod("default/p1", "node-a")
+        assert stub.state.deadline_headers == []
+
+        # a configured POST default mints the budget
+        client.post_deadline_ms = 5000.0
+        assert client.bind_pod("default/p2", "node-a")
+        pairs = [
+            (path, float(v))
+            for _m, path, v in stub.state.deadline_headers
+        ]
+        assert any(
+            path.endswith("/pods/p2/binding") and v == pytest.approx(5000.0)
+            for path, v in pairs
+        )
+
+        # an active thread-local deadline wins over the default and
+        # forwards the REMAINING budget
+        with dl_mod.use(Deadline.from_budget_ms(250.0)):
+            assert client.bind_pod("default/p3", "node-a")
+        p3 = [
+            float(v) for _m, path, v in stub.state.deadline_headers
+            if path.endswith("/pods/p3/binding")
+        ]
+        assert p3 and 0.0 < p3[0] <= 250.0
+    finally:
+        client.stop()
+        stub.stop()
+
+
+# --- scheduler-side backpressure --------------------------------------------
+
+
+class _SlowBindCluster:
+    """bind_pods blocks long enough for depth to be observable."""
+
+    def __init__(self, delay_s=0.3):
+        self.delay_s = delay_s
+        self.bound = []
+
+    def bind_pods(self, assignments, now=None):
+        time.sleep(self.delay_s)
+        keys = list(assignments)
+        self.bound.extend(keys)
+        return keys
+
+
+class _FakeBatchResult:
+    def __init__(self, keys):
+        self.assignments = {k: "node-0" for k in keys}
+        self.unassigned = []
+
+
+class _FakeSched:
+    _telemetry = None
+    _lifecycle = None
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+
+def test_bind_flush_queue_watermark_wait():
+    from crane_scheduler_tpu.framework.scheduler import _BindFlushQueue
+
+    cluster = _SlowBindCluster(delay_s=0.3)
+    bindq = _BindFlushQueue(_FakeSched(cluster), window_s=0.01)
+    try:
+        assert bindq.wait_below(1)  # empty plane: no wait
+        bindq.submit_batch(_FakeBatchResult([f"ns/p{i}" for i in range(10)]),
+                           now=0.0)
+        assert bindq.depth_pods() == 10
+        # over the watermark while the flush sleeps: bounded wait times out
+        assert not bindq.wait_below(5, timeout_s=0.05)
+        # and unblocks the moment the window flushes below it
+        assert bindq.wait_below(5, timeout_s=5.0)
+        assert bindq.depth_pods() == 0
+        assert len(cluster.bound) == 10
+    finally:
+        bindq.close()
+
+
+def test_dispatch_window_invokes_bind_backpressure():
+    """Every drip/schedule_queue window funnels through
+    ``_dispatch_window``, which consults ``Scheduler.bind_backpressure``
+    before dispatching — the hook the CLI wires to the write plane."""
+    from test_drip_columnar import (
+        build_cluster,
+        build_scheduler,
+        fuzz_node_specs,
+        fuzz_pod_specs,
+        make_pod,
+    )
+    import random
+
+    rng = random.Random(5)
+    cluster = build_cluster(fuzz_node_specs(rng, 8))
+    sched = build_scheduler(cluster, columnar=True)
+    calls = []
+    sched.bind_backpressure = lambda: calls.append(1)
+    queue = sched.open_queue(window=4)
+    for spec in fuzz_pod_specs(random.Random(6), 10):
+        pod = make_pod(*spec)
+        cluster.add_pod(pod)
+        queue.offer(pod)
+    queue.drain()
+    results = queue.take_results()
+    assert len(results) == 10
+    # 10 pods / window 4 => >= 3 window dispatches, each gated
+    assert len(calls) >= 3
+
+
+def test_pipelined_batches_respect_bind_watermark():
+    from crane_scheduler_tpu.framework.scheduler import BatchScheduler
+
+    sim = make_sim(8, seed=41)
+    sched = BatchScheduler(sim.cluster, DEFAULT_POLICY)
+    pods = [[sim.make_pod() for _ in range(4)] for _ in range(4)]
+    results = list(sched.schedule_batches_pipelined(
+        pods, bind=True, depth=2, overlap_bind=True,
+        bind_window_s=0.002, bind_watermark_pods=6,
+    ))
+    assert len(results) == 4
+    bound = sum(len(r.assignments) for r in results)
+    assert bound > 0  # watermark pauses never deadlock the pipeline
+
+
+# --- seeded open-loop storms ------------------------------------------------
+
+
+def _storm_factory(clock):
+    return AdmissionController(
+        limiter=GradientLimiter(min_limit=1, max_limit=4, initial=4),
+        queues=TenantQueues(depth=8),
+        tenant_rate=0.0,
+        clock=clock,
+    )
+
+
+def test_storm_schedule_seeded_and_phased():
+    from crane_scheduler_tpu.resilience import StormSchedule
+
+    a = StormSchedule.storm(11, baseline_rps=100, storm_x=3.0,
+                            warm_s=1.0, storm_s=2.0, cool_s=1.0)
+    b = StormSchedule.storm(11, baseline_rps=100, storm_x=3.0,
+                            warm_s=1.0, storm_s=2.0, cool_s=1.0)
+    c = StormSchedule.storm(12, baseline_rps=100, storm_x=3.0,
+                            warm_s=1.0, storm_s=2.0, cool_s=1.0)
+    assert a.arrivals == b.arrivals  # same seed, same timeline
+    assert a.arrivals != c.arrivals
+    warm = sum(1 for x in a if x.t < 1.0)
+    stormy = sum(1 for x in a if 1.0 <= x.t < 3.0)
+    # ~100 warm, ~600 storm: the 3x phase is unmistakable
+    assert stormy > 2.0 * warm
+    assert all(a.arrivals[i].t <= a.arrivals[i + 1].t
+               for i in range(len(a) - 1))
+
+
+def test_admission_replay_deterministic_and_sheds_under_storm():
+    """The bench-17 determinism gate in miniature: same seed => the
+    same shed/admit timeline, bit-identical; and a 3x open-loop storm
+    over a capacity-4 controller MUST shed."""
+    from crane_scheduler_tpu.resilience import (
+        StormSchedule, replay_admission, timeline_counts,
+    )
+
+    sched = StormSchedule.storm(
+        17, baseline_rps=150, storm_x=3.0, warm_s=0.5, storm_s=1.0,
+        cool_s=0.5, tenants=("a", "b"),
+    )
+    t1 = replay_admission(sched.arrivals, _storm_factory,
+                          service_time_s=0.02)
+    t2 = replay_admission(sched.arrivals, _storm_factory,
+                          service_time_s=0.02)
+    assert t1 == t2
+    counts = timeline_counts(t1)
+    assert counts.get("shed:queue_full", 0) > 0  # the storm shed
+    served = counts.get("admit", 0) + counts.get("dequeue", 0)
+    assert served > 0  # ...but goodput never hit zero
+
+
+def test_open_loop_wire_storm_sheds_but_serves(overload_server):
+    """Open-loop wire storm against the live frontend: sheds happen,
+    accepted traffic still completes, /healthz stays green."""
+    from crane_scheduler_tpu.resilience import StormSchedule, run_open_loop
+
+    sim, svc, srv, admission = overload_server
+    sched = StormSchedule(
+        19, duration_s=1.0, phases=[(0.0, 60.0)],
+        tenants=("metered",),  # rate-limited at 1 rps: mostly sheds
+    )
+    results = run_open_loop(
+        "127.0.0.1", srv.port, sched.arrivals,
+        body=json.dumps({"refresh": False}).encode(),
+        target="/v1/score", time_scale=1.0, timeout_s=15.0,
+    )
+    statuses = [r.status for r in results]
+    assert statuses.count(429) > 0, statuses
+    assert statuses.count(200) >= 1, statuses
+    assert all(s in (200, 429, 503) for s in statuses), statuses
+    with socket.create_connection(("127.0.0.1", srv.port)) as sock:
+        sock.sendall(_get("/healthz"))
+        (head, _), = _recv_http_responses(sock, 1)
+    assert head.startswith("HTTP/1.1 200")
+    assert admission.stats["shed"] >= statuses.count(429)

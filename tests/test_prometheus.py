@@ -309,7 +309,10 @@ def test_retry_honors_retry_after_floor(flaky_stub):
     FlakyProm.status = 429
     FlakyProm.retry_after = 3
     assert client.query_by_node_ip("m", "ip") == "0.50000"
-    assert sleeps == [3.0]  # Retry-After floors the jittered backoff
+    # Retry-After floors the sleep; jitter rides on top (additive, so a
+    # mass-shed event cannot re-synchronize every client — ISSUE 13)
+    assert len(sleeps) == 1
+    assert 3.0 <= sleeps[0] <= 3.0 + 0.002
 
 
 def test_breaker_opens_on_outage_and_fails_fast(flaky_stub):

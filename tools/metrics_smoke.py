@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import urllib.error
 import urllib.request
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -280,6 +281,55 @@ def main() -> int:
         check("fallback reason label",
               fallback_reasons.get("unknown_plugin", 0) >= 1,
               str(fallback_reasons))
+
+        # 8. overload families (ISSUE 13): one POST with an already
+        # expired deadline budget must shed 504 on the serving path,
+        # count under crane_service_shed_total{reason}, stay OUT of the
+        # accepted-request latency window, and keep the registry
+        # strict-parseable
+        accepted_before = len(server.router.accepted_latencies)
+        req = urllib.request.Request(
+            f"{base}/v1/score",
+            data=json.dumps({"refresh": False}).encode(),
+            headers={
+                "Content-Type": "application/json",
+                "crane-deadline-ms": "-1",
+            },
+            method="POST",
+        )
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            shed_status = 200
+        except urllib.error.HTTPError as e:
+            shed_status = e.code
+        check("expired deadline sheds 504", shed_status == 504,
+              f"status={shed_status}")
+        check("shed excluded from accepted latencies",
+              len(server.router.accepted_latencies) == accepted_before)
+        with urllib.request.urlopen(
+            urllib.request.Request(
+                f"{base}/metrics",
+                headers={"Accept": "text/plain;version=0.0.4"},
+            ),
+            timeout=10,
+        ) as r:
+            shed_text = r.read().decode()
+        try:
+            shed_families = parse_exposition(shed_text)
+            check("overload strict parse", True,
+                  f"{len(shed_families)} families")
+        except ExpositionError as e:
+            shed_families = {}
+            check("overload strict parse", False, str(e))
+        shed_samples = {
+            dict(s[1]).get("reason"): s[2]
+            for s in shed_families.get(
+                "crane_service_shed_total", {}
+            ).get("samples", ())
+        }
+        check("shed_total deadline_queue reason",
+              shed_samples.get("deadline_queue", 0) >= 1,
+              str(shed_samples))
     finally:
         server.stop()
 

@@ -1,0 +1,191 @@
+"""Overload smoke gate (``make overload-smoke``): boot the scoring
+sidecar with admission control + brownout enabled, drive a seeded
+open-loop storm at several times its configured capacity over the real
+wire, and assert the overload contract end to end:
+
+- sheds happen (429/503 with Retry-After) — the storm is real;
+- accepted requests still complete (goodput never collapses to zero);
+- ``GET /healthz`` answers 200 on the IO thread THROUGHOUT the storm,
+  including while the worker pool is saturated;
+- the slowloris reaper frees half-sent connections;
+- the ``crane_service_shed_total`` / admission / brownout families
+  strict-parse under the exposition parser.
+
+Exit 0 = every check passed; any violation prints the failure and
+exits nonzero. Deterministic arrival schedule (seeded); wall-clock
+outcomes (exact shed counts) are asserted as ranges, not exact values.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+    from crane_scheduler_tpu.resilience import (
+        SlowClientSwarm,
+        StormSchedule,
+        run_open_loop,
+    )
+    from crane_scheduler_tpu.service import (
+        AdmissionController,
+        BrownoutController,
+        GradientLimiter,
+        ScoringHTTPServer,
+        ScoringService,
+        TenantQueues,
+    )
+    from crane_scheduler_tpu.sim import SimConfig, Simulator
+    from crane_scheduler_tpu.telemetry.expfmt import (
+        ExpositionError,
+        parse_exposition,
+    )
+
+    failures = 0
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        nonlocal failures
+        mark = "ok" if ok else "FAIL"
+        print(f"[overload-smoke] {name}: {mark}"
+              f"{' — ' + detail if detail else ''}")
+        if not ok:
+            failures += 1
+
+    sim = Simulator(SimConfig(n_nodes=16, seed=3))
+    sim.sync_metrics()
+    svc = ScoringService(sim.cluster, DEFAULT_POLICY)
+    svc.refresh()
+    brownout = BrownoutController(telemetry=svc.telemetry)
+    admission = AdmissionController(
+        limiter=GradientLimiter(min_limit=1, max_limit=4, initial=4),
+        queues=TenantQueues(depth=8),
+        tenant_rates={"metered": 2.0},
+        tenant_burst=2.0,
+        brownout=brownout,
+        telemetry=svc.telemetry,
+    )
+    server = ScoringHTTPServer(
+        svc, port=0, frontend="async", admission=admission,
+        brownout=brownout, idle_timeout_s=0.5,
+    )
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+
+    health_codes: list[int] = []
+    health_stop = threading.Event()
+
+    def health_probe():
+        while not health_stop.is_set():
+            try:
+                with urllib.request.urlopen(
+                    f"{base}/healthz", timeout=5
+                ) as r:
+                    health_codes.append(r.status)
+            except Exception:
+                health_codes.append(0)
+            health_stop.wait(0.1)
+
+    prober = threading.Thread(target=health_probe, daemon=True)
+
+    try:
+        # 1. seeded open-loop storm against the metered tenant: its
+        # 2 rps token bucket faces ~80 rps, so the vast majority of
+        # the storm MUST shed on the IO thread while the rest serves
+        storm = StormSchedule(
+            23, duration_s=1.5, phases=[(0.0, 80.0)], tenants=("metered",),
+        )
+        prober.start()
+        body = json.dumps({"refresh": False}).encode()
+        results = run_open_loop(
+            "127.0.0.1", server.port, storm.arrivals,
+            target="/v1/score", body=body, timeout_s=20.0,
+        )
+        statuses = [r.status for r in results]
+        served = statuses.count(200)
+        shed = sum(1 for s in statuses if s in (429, 503))
+        check("storm arrivals", len(results) >= 60, f"n={len(results)}")
+        check("storm sheds on the IO thread", shed >= 20,
+              f"shed={shed} of {len(statuses)}")
+        check("goodput survives the storm", served >= 2,
+              f"served={served}")
+        check("only overload statuses", all(
+            s in (200, 429, 503) for s in statuses
+        ), str(sorted(set(statuses))))
+
+        # 2. slowloris: half-sent requests are reaped, never pinning
+        # connection slots past the idle window
+        with SlowClientSwarm("127.0.0.1", server.port, count=4) as swarm:
+            closed = swarm.wait_closed(4, timeout_s=10.0)
+        check("slowloris connections reaped", closed == 4,
+              f"closed={closed}/4")
+
+        health_stop.set()
+        prober.join(timeout=5.0)
+        check("healthz green throughout", health_codes
+              and all(c == 200 for c in health_codes),
+              f"{len(health_codes)} probes, "
+              f"bad={[c for c in health_codes if c != 200]}")
+
+        # 3. the shed accounting matches the wire, and the new
+        # families strict-parse
+        req = urllib.request.Request(
+            f"{base}/metrics",
+            headers={"Accept": "text/plain;version=0.0.4"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            text = r.read().decode()
+        try:
+            families = parse_exposition(text)
+            check("strict exposition parse", True,
+                  f"{len(families)} families")
+        except ExpositionError as e:
+            families = {}
+            check("strict exposition parse", False, str(e))
+        for required in (
+            "crane_service_shed_total",
+            "crane_service_admission_inflight",
+            "crane_service_admission_queued",
+            "crane_service_admission_limit",
+            "crane_service_brownout_tier",
+        ):
+            check(f"family {required}", required in families)
+        shed_by_reason = {
+            dict(s[1]).get("reason"): s[2]
+            for s in families.get(
+                "crane_service_shed_total", {}
+            ).get("samples", ())
+        }
+        counted = sum(v for k, v in shed_by_reason.items()
+                      if k in ("rate_limit", "queue_full", "priority"))
+        check("shed_total matches the wire", counted >= shed,
+              f"families={shed_by_reason} wire={shed}")
+        check("idle reaps counted",
+              shed_by_reason.get("idle", 0) >= 4, str(shed_by_reason))
+        check("admission stats consistent",
+              admission.stats["shed"] >= shed
+              and admission.stats["admitted"] + admission.stats["queued"]
+              >= served,
+              str(dict(admission.stats)))
+    finally:
+        health_stop.set()
+        server.stop()
+
+    print(f"[overload-smoke] {'PASS' if not failures else 'FAIL'}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
