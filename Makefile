@@ -10,7 +10,7 @@ IMAGE_SCHEDULER := $(REGISTRY)/crane-scheduler-tpu:$(GIT_VERSION)
 
 .PHONY: all native test test-fast bench sim e2e metrics-smoke \
 	desched-smoke chaos-smoke recovery-smoke trace-smoke drip-smoke \
-	overload-smoke dashboards \
+	shard-smoke overload-smoke dashboards \
 	clean images image-annotator image-scheduler push-images
 
 all: native test
@@ -49,6 +49,14 @@ desched-smoke:
 # crane_drip_kernel_seconds families must strict-parse
 drip-smoke:
 	$(PYTHON) tools/drip_smoke.py
+
+# two drip schedulers racing over one contended queue against the wire
+# stub on a forced 8-way host-device placement mesh: per-pod
+# bind_posts == 1 oracle, zero duplicate POSTs, claim_lost conflicts
+# must occur, and the crane_shard_* families must strict-parse — see
+# doc/sharding.md
+shard-smoke:
+	$(PYTHON) tools/shard_smoke.py
 
 # scripted prometheus outage through the breaker + degraded-mode
 # controller + health registry; strict-parses the resilience families

@@ -175,6 +175,7 @@ def main() -> int:
     import jax.numpy as jnp
 
     from crane_scheduler_tpu.parallel import ShardedScheduleStep, make_node_mesh
+    from crane_scheduler_tpu.parallel.mesh import mesh_shape
     from crane_scheduler_tpu.loadstore.store import DeviceSnapshot
     from crane_scheduler_tpu.policy import DEFAULT_POLICY, compile_policy
 
@@ -607,6 +608,15 @@ def main() -> int:
                 "telemetry_trace_file": trace_file,
                 "telemetry_series": len(tel.registry.snapshot()),
                 "host_load_1m": load_1m,
+                # self-describing environment (shard-scaling runs are
+                # only comparable with the mesh/device context attached)
+                "env": {
+                    "device_count": jax.device_count(),
+                    "host_count": jax.process_count(),
+                    "platform": jax.devices()[0].platform,
+                    "mesh": mesh_shape(mesh),
+                    "schedulers": 1,
+                },
             }
         )
     )

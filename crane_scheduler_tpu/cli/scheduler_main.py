@@ -29,6 +29,38 @@ import os
 import time
 
 
+def _shard_mode(args, cluster):
+    """``--shards`` wiring shared by serve and one-shot modes: wrap the
+    mirror in this process's ``ShardView`` (per-shard version fences,
+    shard-filtered nodes, optimistic conflict retry) and return a pod
+    filter so N cooperating processes partition the pending queue by
+    pod-key hash — nodes shard by name, pods by key, both
+    deterministic, so no two processes ever POST the same bind."""
+    if args.shards <= 1:
+        return cluster, None
+    from ..cluster.shards import ShardSpec, shard_of
+    from ..framework.shardplane import ShardView
+
+    cluster.configure_shards(args.shards, args.shard_overlap)
+    view = ShardView(
+        cluster,
+        ShardSpec(args.shard_index, args.shards, args.shard_overlap),
+    )
+
+    def pod_filter(key: str) -> bool:
+        return shard_of(key, args.shards) == args.shard_index
+
+    return view, pod_filter
+
+
+def _placement_mesh(args):
+    if getattr(args, "placement_mesh", 0) <= 0:
+        return None
+    from ..parallel.mesh import make_placement_mesh
+
+    return make_placement_mesh(args.placement_mesh)
+
+
 def _serve(args, cluster, config, policy, journal, recovery,
            telemetry) -> int:
     """Long-running drip serving (master mode): pending pods stream into
@@ -86,10 +118,14 @@ def _serve(args, cluster, config, policy, journal, recovery,
         journal = standby.journal
         cluster.attach_intent_journal(journal)
 
+    sched_cluster, pod_filter = _shard_mode(args, cluster)
     sched = build_scheduler_from_config(
-        cluster, config, nrt_lister=cluster.nrt_lister, policy=policy,
-        tie_break_seed=args.tie_break_seed,
+        sched_cluster, config, nrt_lister=cluster.nrt_lister,
+        policy=policy, tie_break_seed=args.tie_break_seed,
+        mesh=_placement_mesh(args),
     )
+    if pod_filter is not None:
+        sched.conflict_retry = True
     if args.bind_watermark_pods > 0:
         # overload backpressure (ISSUE 13): pause dispatch windows while
         # the kube write plane holds >= watermark un-sent writes, so an
@@ -126,6 +162,8 @@ def _serve(args, cluster, config, policy, journal, recovery,
         for pod in live:
             if pod.node_name or pod.key() in offered:
                 continue
+            if pod_filter is not None and not pod_filter(pod.key()):
+                continue  # another shard's process owns this pod
             offered.add(pod.key())
             queue.offer(pod)
             progressed += 1
@@ -201,6 +239,23 @@ def main(argv=None) -> int:
     parser.add_argument("--run-seconds", type=float, default=0.0,
                         help="--serve: exit after this long (0 = until "
                              "SIGTERM/SIGINT)")
+    parser.add_argument("--placement-mesh", type=int, default=0,
+                        help="shard the drip batch kernel's columns "
+                             "over the first N local devices "
+                             "(doc/sharding.md); 0 = single-device "
+                             "kernel")
+    parser.add_argument("--shards", type=int, default=1,
+                        help="partition the node keyspace into N "
+                             "shards and schedule only this process's "
+                             "shard (run one process per shard; "
+                             "doc/sharding.md)")
+    parser.add_argument("--shard-index", type=int, default=0,
+                        help="which shard this process owns "
+                             "(0..shards-1)")
+    parser.add_argument("--shard-overlap", type=float, default=0.0,
+                        help="fraction of the keyspace co-owned with "
+                             "the ring-successor shard (optimistic "
+                             "conflict mode; 0 = disjoint)")
     parser.add_argument("--window", type=int, default=32,
                         help="--serve: drip dispatch window size")
     parser.add_argument("--bind-watermark-pods", type=int, default=0,
@@ -296,7 +351,10 @@ def main(argv=None) -> int:
         if telemetry is not None:
             _tel.flush_on_signal(telemetry)
 
+        sched_cluster, pod_filter = _shard_mode(args, cluster)
         pending = [p for p in cluster.list_pods() if not p.node_name]
+        if pod_filter is not None:
+            pending = [p for p in pending if pod_filter(p.key())]
         if args.pods is not None:  # unset means ALL pending, never 50
             pending = pending[: args.pods]
         stats = {"scheduled": 0, "unschedulable": 0}
@@ -326,9 +384,12 @@ def main(argv=None) -> int:
                 # CRD is installed; empty lister otherwise (plugin
                 # treats a missing CR as Unschedulable only for
                 # guaranteed-CPU pods it enforces)
-                cluster, config, nrt_lister=cluster.nrt_lister, policy=policy,
-                tie_break_seed=args.tie_break_seed,
+                sched_cluster, config, nrt_lister=cluster.nrt_lister,
+                policy=policy, tie_break_seed=args.tie_break_seed,
+                mesh=_placement_mesh(args),
             )
+            if pod_filter is not None:
+                sched.conflict_retry = True
             for pod in pending:
                 result = sched.schedule_one(pod)
                 stats["scheduled" if result.node else "unschedulable"] += 1

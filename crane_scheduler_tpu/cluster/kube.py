@@ -1263,6 +1263,10 @@ class KubeClusterClient:
                 nodes.append(obj)
         self._mirror.replace_nodes(nodes)
         self._node_rvs = new_rvs
+        # bounded-map invariant: new_rvs is built from listed (live)
+        # pages only, but a concurrent watch delete can land between
+        # the list and here — prune against the reconciled mirror
+        self.prune_node_rvs()
         # keyed on node_version (NOT sched_version): pod/event churn
         # must not invalidate node columns that didn't change
         self._node_columns_cache = (pages, self._mirror.node_version, None)
@@ -2096,6 +2100,31 @@ class KubeClusterClient:
             for name in names:
                 rvs.pop(name, None)
 
+    def rv_reuse_size(self) -> int:
+        """Current size of the resourceVersion-reuse map (bounded-map
+        regression gate: must track the live node count — see
+        ``prune_node_rvs``). Pods intentionally have no such map: the
+        native decoder keys reuse by bare object name, which collides
+        across pod namespaces (doc/read-path.md)."""
+        return len(self._node_rvs)
+
+    def prune_node_rvs(self) -> int:
+        """Evict rv-reuse entries whose node left the mirror. Every
+        delete path already pops its own entry (watch applies, patches,
+        relist reconciliation), so this is the hard backstop that turns
+        "should stay bounded" into an invariant: after any relist the
+        map holds only live nodes, no matter what interleaving of
+        watch churn and relist raced before it. O(map); runs once per
+        relist. Returns the evicted count."""
+        rvs = self._node_rvs
+        if not rvs:
+            return 0
+        get = self._mirror.get_node
+        dead = [name for name in rvs if get(name) is None]
+        for name in dead:
+            rvs.pop(name, None)
+        return len(dead)
+
     def _apply_node(self, change_type: str, obj: dict) -> None:
         node = node_from_json(obj)
         self._invalidate_node_rvs((node.name,))
@@ -2294,6 +2323,15 @@ class KubeClusterClient:
 
     def pod_changes_since(self, version: int):
         return self._mirror.pod_changes_since(version)
+
+    def configure_shards(self, count: int, overlap: float = 0.0) -> None:
+        self._mirror.configure_shards(count, overlap)
+
+    def shard_layout(self):
+        return self._mirror.shard_layout()
+
+    def shard_versions(self, index: int) -> tuple[int, int, int]:
+        return self._mirror.shard_versions(index)
 
     def list_nodes(self):
         return self._mirror.list_nodes()

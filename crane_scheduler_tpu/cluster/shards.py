@@ -1,0 +1,90 @@
+"""Deterministic node-shard ownership, shared by every layer.
+
+The sharded placement plane (doc/sharding.md) needs ONE answer to
+"which shard(s) observe node X" that the cluster mirror (per-shard
+watch fences), the shard views (column membership), and the bench/smoke
+drivers all agree on — a disagreement would silently desync a shard's
+version fence from the columns built over it. Ownership is a pure
+function of the node *name* (stable across relists, restarts, and
+processes): primary shard = ``crc32(name) % count``, matching the
+reference annotator's worker-pool hashing (ref:
+pkg/controller/annotator/node.go:148-177) and Agon's partitioned
+scheduler assignment (arxiv 2109.00665).
+
+Overlap is opt-in competition: with ``overlap > 0`` a deterministic
+fraction of each shard's nodes is *also* observed by the next shard
+(ring order), so two schedulers can race for the same capacity and the
+optimistic conflict protocol gets exercised instead of proven dead by
+construction. ``overlap`` is a fraction in [0, 1): 0 = disjoint
+partition, 0.25 = a quarter of the keyspace co-owned. Derived from a
+second independent slice of the same CRC so the co-owned set is not
+correlated with the primary assignment.
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass
+
+__all__ = ["shard_of", "shard_owners", "ShardSpec"]
+
+# overlap is quantized to 1/1024ths of the keyspace: coarse enough to
+# stay deterministic across platforms, fine enough for a 5% gate
+_OVERLAP_QUANTA = 1024
+
+
+def _crc(name: str) -> int:
+    return zlib.crc32(name.encode("utf-8"))
+
+
+def shard_of(name: str, count: int) -> int:
+    """Primary owner shard for ``name`` under a ``count``-way layout."""
+    if count <= 1:
+        return 0
+    return _crc(name) % count
+
+
+def shard_owners(name: str, count: int, overlap: float = 0.0) -> tuple[int, ...]:
+    """Every shard that observes ``name`` (primary first).
+
+    With ``overlap`` > 0, a deterministic ``overlap`` fraction of names
+    is co-owned by the ring successor of the primary shard. The
+    co-ownership draw uses bits of the CRC independent of the modulus,
+    so overlap membership is uncorrelated with primary assignment.
+    """
+    if count <= 1:
+        return (0,)
+    c = _crc(name)
+    primary = c % count
+    if overlap <= 0.0:
+        return (primary,)
+    draw = (c >> 12) % _OVERLAP_QUANTA
+    if draw < int(overlap * _OVERLAP_QUANTA):
+        return (primary, (primary + 1) % count)
+    return (primary,)
+
+
+@dataclass(frozen=True)
+class ShardSpec:
+    """One scheduler's slice of the node keyspace.
+
+    ``index`` observes its primary partition plus (under overlap) the
+    co-owned spill from its ring predecessor — i.e. ``observes(name)``
+    iff ``index in shard_owners(name, count, overlap)``.
+    """
+
+    index: int
+    count: int
+    overlap: float = 0.0
+
+    def __post_init__(self):
+        if not (0 <= self.index < self.count):
+            raise ValueError(f"shard index {self.index} not in [0, {self.count})")
+        if not (0.0 <= self.overlap < 1.0):
+            raise ValueError(f"overlap {self.overlap} not in [0, 1)")
+
+    def observes(self, name: str) -> bool:
+        return self.index in shard_owners(name, self.count, self.overlap)
+
+    def owners(self, name: str) -> tuple[int, ...]:
+        return shard_owners(name, self.count, self.overlap)

@@ -223,6 +223,17 @@ class ClusterState:
         # batch handlers that also accept columnar delivery (parallel to
         # _batch_handlers; None = must materialize events for this one)
         self._batch_columnar: list[Callable | None] = []
+        # per-shard watch fences (sharded placement plane): when a
+        # shard layout is configured, every write additionally bumps
+        # the fence of each shard that OBSERVES the touched node — a
+        # bind or annotation patch in shard 0 must not invalidate shard
+        # 1's drip columns. Writes without a node name (bulk sweeps,
+        # relists, burst binds) conservatively bump every shard.
+        self._shard_layout: tuple[int, float] | None = None  # (count, overlap)
+        self._shard_sched: list[int] = []
+        self._shard_pod: list[int] = []
+        self._shard_node: list[int] = []
+        self._shard_owner_cache: dict[str, tuple[int, ...]] = {}
 
     @property
     def sched_version(self) -> int:
@@ -251,6 +262,69 @@ class ClusterState:
         if len(log) == log.maxlen:
             self._pod_log_floor = log[0][0]
         log.append((self._pod_version, node_name))
+        if self._shard_layout is not None:
+            self._bump_shards_locked(node_name, pod=True)
+
+    # -- per-shard watch fences (sharded placement plane) ------------------
+
+    def configure_shards(self, count: int, overlap: float = 0.0) -> None:
+        """Enable per-shard version fences for a ``count``-way node
+        partition (``cluster.shards.shard_owners`` ownership). Each
+        shard's (sched, pod, node) counters start at the global values
+        and from then on move only when a write touches a node that
+        shard observes — the O(dirty) refresh gate for N concurrent
+        drip schedulers. Reconfiguring resets the fences."""
+        from .shards import shard_owners  # noqa: F401  (validates import)
+
+        if count < 1:
+            raise ValueError(f"shard count must be >= 1, got {count}")
+        with self._lock:
+            self._shard_layout = (int(count), float(overlap))
+            self._shard_sched = [self._sched_version] * count
+            self._shard_pod = [self._pod_version] * count
+            self._shard_node = [self._node_version] * count
+            self._shard_owner_cache = {}
+
+    def shard_layout(self) -> tuple[int, float] | None:
+        with self._lock:
+            return self._shard_layout
+
+    def shard_versions(self, index: int) -> tuple[int, int, int]:
+        """(sched, pod, node) fence for shard ``index``; falls back to
+        the global counters when no layout is configured (a ShardView
+        over an unconfigured mirror degrades to global invalidation)."""
+        with self._lock:
+            if self._shard_layout is None:
+                return (self._sched_version, self._pod_version,
+                        self._node_version)
+            return (self._shard_sched[index], self._shard_pod[index],
+                    self._shard_node[index])
+
+    def _bump_shards_locked(
+        self, name: str | None, pod: bool = False, node: bool = False
+    ) -> None:
+        layout = self._shard_layout
+        if layout is None:
+            return
+        count, overlap = layout
+        if name is None:
+            owners: tuple[int, ...] | range = range(count)
+        else:
+            owners = self._shard_owner_cache.get(name)  # type: ignore[assignment]
+            if owners is None:
+                from .shards import shard_owners
+
+                owners = shard_owners(name, count, overlap)
+                cache = self._shard_owner_cache
+                if len(cache) > 2_000_000:  # churn backstop
+                    cache.clear()
+                cache[name] = owners
+        for s in owners:
+            self._shard_sched[s] += 1
+            if pod:
+                self._shard_pod[s] += 1
+            if node:
+                self._shard_node[s] += 1
 
     def pod_changes_since(self, version: int):
         """Node names with bound-pod changes after ``version``, or None
@@ -350,6 +424,7 @@ class ClusterState:
             self._nodes[node.name] = node
             self._sched_version += 1
             self._node_version += 1
+            self._bump_shards_locked(node.name, node=True)
             # annotation-only updates (e.g. a kube mirror echoing the
             # annotator's own patches as MODIFIED events) must not defeat
             # (name, ip) pair caches keyed on node_set_version
@@ -367,6 +442,8 @@ class ClusterState:
             self._sched_version += 1
             self._node_version += 1
             self._node_set_version += 1
+            self._bump_shards_locked(name, node=True)
+            self._shard_owner_cache.pop(name, None)
 
     def get_node(self, name: str) -> Node | None:
         with self._lock:
@@ -416,11 +493,13 @@ class ClusterState:
             self._nodes.pop(name, None)
             self._drop_overlay_locked(name)
             self._sched_version += 1
+            self._bump_shards_locked(name, node=True)
             return True
         prev = self._nodes.get(name)
         self._drop_overlay_locked(name)
         self._nodes[name] = node
         self._sched_version += 1
+        self._bump_shards_locked(name, node=True)
         if prev is None:
             self._note_pod_change_locked(name)
         return prev is None or prev.addresses != node.addresses
@@ -490,6 +569,7 @@ class ClusterState:
             self._nodes = new
             self._sched_version += 1
             self._node_version += 1
+            self._bump_shards_locked(None, node=True)  # relist: all fences
             if set_changed:
                 self._node_set_version += 1
 
@@ -525,6 +605,7 @@ class ClusterState:
             self._nodes[name] = replace(node, annotations=anno)
             self._sched_version += 1
             self._node_version += 1
+            self._bump_shards_locked(name, node=True)
             return True
 
     def patch_node_annotations_bulk(self, per_node: Mapping[str, Mapping[str, str]]) -> int:
@@ -554,6 +635,7 @@ class ClusterState:
                 d["annotations"] = anno
                 nodes[name] = new_node
                 self._sched_version += 1
+                self._bump_shards_locked(name, node=True)
                 patched += 1
             if patched:
                 self._node_version += 1
@@ -586,6 +668,7 @@ class ClusterState:
                     self._fold_overlay_locked()
             self._sched_version += len(names)
             self._node_version += 1
+            self._bump_shards_locked(None, node=True)  # sweep: all fences
         return len(names)
 
     def patch_node_annotation_groups(self, groups) -> int:
@@ -726,6 +809,7 @@ class ClusterState:
                     self._burst_index.pop(key, None)
                 if pod.node_name:
                     self._sched_version += 1
+                    self._bump_shards_locked(None, pod=True)
                 return
         if pod is not None:
             self._index_remove(pod)
@@ -1131,6 +1215,9 @@ class ClusterState:
             self._count_arr[slots] += bc
             self._count_version += 1
             self._sched_version += n
+            # burst binds skip the pod journal by design; shard fences
+            # can't attribute them, so every fence moves
+            self._bump_shards_locked(None, pod=True)
             rv_base = self._rv_next
             self._rv_next += n
             if notify:

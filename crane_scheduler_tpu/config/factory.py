@@ -23,6 +23,7 @@ def build_scheduler_from_config(
     clock=None,
     policy=None,
     tie_break_seed=None,
+    mesh=None,
 ) -> Scheduler:
     """Build a Scheduler for the first profile.
 
@@ -30,7 +31,8 @@ def build_scheduler_from_config(
     (useful in tests/sim); ``nrt_lister`` is required when the NRT plugin
     is enabled. ``tie_break_seed`` opts into the stock framework's
     random-among-ties host selection (seeded; default off = lowest
-    snapshot index, deterministic).
+    snapshot index, deterministic). ``mesh`` shards the drip batch
+    kernel over a placement mesh (doc/sharding.md).
     """
     import time
 
@@ -38,7 +40,7 @@ def build_scheduler_from_config(
         raise ValueError("scheduler configuration has no profiles")
     profile = config.profiles[0]
     sched = Scheduler(cluster, clock=clock or time.time,
-                      tie_break_seed=tie_break_seed)
+                      tie_break_seed=tie_break_seed, mesh=mesh)
 
     weights = {pw.name: pw.weight for pw in profile.score_enabled}
     enabled = set(profile.filter_enabled) | set(weights)

@@ -508,6 +508,7 @@ class Scheduler:
         telemetry: Telemetry | None = None,
         tie_break_seed: int | None = None,
         columnar: bool = True,
+        mesh=None,
     ):
         """``tie_break_seed``: opt-in reference-faithful host selection —
         the stock kube-scheduler samples RANDOMLY among equal-score
@@ -525,7 +526,12 @@ class Scheduler:
         pod allow it — placements are bit-identical to the scalar loop,
         which remains the fallback (and the parity oracle) for
         daemonset pods, degraded mode, scalar extended resources, and
-        any unrecognized plugin."""
+        any unrecognized plugin.
+
+        ``mesh``: optional 1-D placement mesh (``parallel.mesh
+        .make_placement_mesh``) — the drip batch kernel shards its
+        columns along the node axis and runs the shard-parallel
+        program, bit-identical to single-device (doc/sharding.md)."""
         import random
 
         self.cluster = cluster
@@ -557,10 +563,21 @@ class Scheduler:
         # the columns; _batch holds the dispatch-window distributions
         # drip_stats() exposes
         self._batch_kernel = None
+        self._kernel_mesh = mesh
         self._batch = {
             "dispatches": 0, "pods": 0, "replays": 0,
-            "batch_sizes": [], "kernel_seconds": [],
+            "batch_sizes": [], "kernel_seconds": [], "conflicts": 0,
         }
+        # optimistic multi-scheduler mode (framework.shardplane): when
+        # another binder can move this scheduler's shard between column
+        # build and bind POST, the window re-checks the pod_version
+        # fence pre-POST and drops-and-retries on movement instead of
+        # POSTing placements computed over stale capacity. Off for the
+        # single-scheduler case: the fence can't move under one binder,
+        # and the check would only add a version read per window.
+        self.conflict_retry = False
+        self.conflict_cb = None  # callable(outcome: str) | None
+        self.max_window_retries = 4
         self._m_decisions = None
         self._m_fallback = None
         self._m_batch_pods = None
@@ -618,6 +635,7 @@ class Scheduler:
             "dispatches": b["dispatches"],
             "pods": b["pods"],
             "replays": b["replays"],
+            "conflicts": b["conflicts"],
             "batch_sizes": list(b["batch_sizes"]),
             "kernel_seconds": list(b["kernel_seconds"]),
         }
@@ -1081,12 +1099,20 @@ class Scheduler:
             self._dispatch_window(buf, rec, results)
         return results
 
-    def _dispatch_window(self, buf, rec, results) -> None:
+    def _dispatch_window(self, buf, rec, results, _retry: int = 0) -> None:
         """One coalesced window through the jitted kernel: dispatch,
         then either accept (bulk bind + sequential host folds under the
         pre -> pre+n_bound stamp discipline) or replay per-pod (seeded
         tie in the window). The kernel is pure w.r.t. the host columns,
-        so rejecting a window costs only the kernel time."""
+        so rejecting a window costs only the kernel time.
+
+        Under ``conflict_retry`` (multi-scheduler shard plane) the
+        window additionally re-reads the pod_version fence after the
+        kernel and BEFORE the bind POSTs: a competing binder moving the
+        shard in that gap means the placements were computed over stale
+        free columns, so the whole window drops and retries at queue
+        position against rebuilt columns (``_retry`` bounds the loop;
+        exhaustion falls back to the serialized per-pod path)."""
         dyn, _dyn_weight, tracker, _order = rec
         bp = self.bind_backpressure
         if bp is not None:
@@ -1111,7 +1137,9 @@ class Scheduler:
             if kern is None:
                 from ..scorer.drip_batch import DripBatchKernel
 
-                kern = self._batch_kernel = DripBatchKernel()
+                kern = self._batch_kernel = DripBatchKernel(
+                    mesh=self._kernel_mesh
+                )
             chosen, feasible, ties = kern.dispatch(
                 drip.schedulable, drip.weighted,
                 drip.bounded, drip.free, vecs,
@@ -1127,6 +1155,32 @@ class Scheduler:
         if self._m_batch_pods is not None:
             self._m_batch_pods.observe(k)
             self._m_kernel_s.observe(dt)
+
+        if (
+            self.conflict_retry
+            and tracker is not None
+            and drip.free is not None
+            and self.cluster.pod_version != drip._fit_pod_ver
+        ):
+            # optimistic bind conflict (shard plane): a competing
+            # binder moved this shard's pod_version fence between
+            # column build and bind POST, so these placements were
+            # computed over stale free capacity. Nothing was POSTed
+            # (the kernel is pure), so drop the window and retry the
+            # pods at queue position over rebuilt columns; after
+            # max_window_retries fall back to the serialized per-pod
+            # path rather than livelock under sustained contention.
+            kern.mark_desynced()
+            drip.drop_fit()
+            b["conflicts"] += 1
+            if self.conflict_cb is not None:
+                self.conflict_cb("stale_window")
+            if _retry < self.max_window_retries:
+                self._dispatch_window(buf, rec, results, _retry + 1)
+            else:
+                for pod, _vec in buf:
+                    results.append(self.schedule_one(pod))
+            return
 
         if self._tie_rng is not None and bool((ties > 1).any()):
             # a real tie consumes seeded RNG the kernel cannot replay —

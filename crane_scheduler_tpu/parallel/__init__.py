@@ -5,7 +5,14 @@ from .distributed import (
     partition_nodes,
     prepare_from_local_shard,
 )
-from .mesh import make_node_mesh, node_sharding, replicated_sharding
+from .mesh import (
+    make_node_mesh,
+    make_placement_mesh,
+    mesh_shape,
+    node_sharding,
+    replicated_sharding,
+    round_up_to_shards,
+)
 from .sharded import ShardedScheduleStep
 
 __all__ = [
@@ -13,9 +20,12 @@ __all__ = [
     "host_local_to_global",
     "initialize",
     "make_node_mesh",
+    "make_placement_mesh",
+    "mesh_shape",
     "node_sharding",
     "partition_nodes",
     "prepare_from_local_shard",
     "replicated_sharding",
+    "round_up_to_shards",
     "ShardedScheduleStep",
 ]

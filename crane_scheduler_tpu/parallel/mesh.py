@@ -39,3 +39,36 @@ def node_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
+
+
+# -- placement mesh (sharded drip plane, doc/sharding.md) -------------------
+
+# The drip batch kernel's shard axis carries the same name as the node
+# axis: columns are node-major, and the placement mesh is just the node
+# mesh under a role-specific constructor so callers (scheduler CLI,
+# bench, smoke) can ask for "the placement mesh" without caring that it
+# is 1-D over nodes today.
+PLACEMENT_MESH_NAME = "placement"
+
+
+def make_placement_mesh(n_shards: int | None = None, devices=None) -> Mesh:
+    """Named 1-D placement mesh: the drip columns shard along
+    ``NODE_AXIS`` across ``n_shards`` devices (default: all local
+    devices). A 1-device mesh is valid and degrades the sharded kernel
+    to the single-device program."""
+    return make_node_mesh(n_shards, devices)
+
+
+def mesh_shape(mesh: Mesh) -> dict:
+    """Self-describing mesh metadata for bench/smoke result blobs."""
+    return {
+        "axes": dict(zip(mesh.axis_names, mesh.devices.shape)),
+        "devices": int(mesh.devices.size),
+    }
+
+
+def round_up_to_shards(n: int, mesh: Mesh) -> int:
+    """Smallest multiple of the mesh's node-axis size >= ``n`` (sharded
+    arrays need equal per-device tiles)."""
+    s = int(mesh.devices.size)
+    return -(-int(n) // s) * s

@@ -802,16 +802,38 @@ class DeviceColumnCache:
         self._device = device
         self._slots: dict[str, tuple] = {}
         self.uploads = 0
+        # mesh-repartition fence: cached device arrays are placed for
+        # ONE partitioning (device set + shard spec). set_partition()
+        # drops everything when that changes — a resized mesh must
+        # never serve columns (or let a kernel replay a fold carry)
+        # laid out for the old partitioning.
+        self._partition_token = None
+        self.repartitions = 0
 
-    def put(self, name: str, arr, version=0, prepare=None):
+    def set_partition(self, token) -> bool:
+        """Declare the current partitioning (any hashable/equatable
+        token — e.g. ``(tuple(mesh.devices.flat), mesh.axis_names)``).
+        Returns True (and drops every slot) when it changed."""
+        if token == self._partition_token:
+            return False
+        changed = self._partition_token is not None
+        self._partition_token = token
+        if changed:
+            self._slots.clear()
+            self.repartitions += 1
+        return changed
+
+    def put(self, name: str, arr, version=0, prepare=None, device=None):
         """Device array for ``arr``, uploading only when the
-        ``(identity, shape, version)`` key changed since the last call."""
+        ``(identity, shape, version)`` key changed since the last call.
+        ``device`` overrides the cache-wide placement for this column
+        (e.g. a ``NamedSharding`` for mesh-sharded columns)."""
         key = (id(arr), arr.shape, version)
         slot = self._slots.get(name)
         if slot is not None and slot[0] == key:
             return slot[1]
         host = arr if prepare is None else prepare(arr)
-        dev = jax.device_put(host, self._device)
+        dev = jax.device_put(host, device if device is not None else self._device)
         self._slots[name] = (key, dev, arr)
         self.uploads += 1
         return dev

@@ -37,7 +37,9 @@ the scoped ``jax.experimental.enable_x64`` context.
 
 from __future__ import annotations
 
+import threading
 import time
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
@@ -47,6 +49,16 @@ from jax.experimental import enable_x64
 __all__ = ["DripBatchKernel", "drip_batch_dispatch"]
 
 _I64_MIN = np.int64(np.iinfo(np.int64).min)
+
+# XLA's host-platform collectives rendezvous through one per-process
+# participant table: two shard_map programs launched concurrently from
+# different threads (the shard plane runs one scheduler per thread)
+# interleave their all_gather participants across run ids and deadlock.
+# Sharded dispatches therefore serialize process-wide — held through
+# the output sync so the program has fully retired before the next one
+# launches. Device parallelism is intra-program (across shards);
+# schedulers still overlap on the host side.
+_COLLECTIVE_LOCK = threading.Lock()
 
 # shape buckets: small node counts round up to pow2 >= 256; past 4096
 # they round to the next multiple of 4096 instead (pow2 would pad a 50k
@@ -115,6 +127,85 @@ def _drip_batch(schedulable, weighted, bounded, free, vecs, active,
     return outs, free
 
 
+@lru_cache(maxsize=8)
+def _sharded_drip_fn(mesh, want_ties: bool):
+    """Shard-parallel twin of ``_drip_batch`` over a 1-D placement mesh.
+
+    Columns arrive tiled along the node axis (equal per-device tiles —
+    the wrapper rounds the pad up to a shard multiple). Each scan step
+    computes a LOCAL first-max ``(value, global_index)`` pair, one
+    ``all_gather`` collects the S candidate pairs, and ``argmax`` over
+    the gathered values picks the winner: argmax's first-maximum rule
+    applied to shard-ordered candidates selects the lowest shard among
+    value ties, and within a shard the local argmax already took the
+    lowest local row — so the global winner is exactly the lowest
+    global index holding the maximum, bit-identical to ``np.argmax``
+    and to the single-device program. The fold lands only on the
+    winning shard (``delta`` is zeroed elsewhere), so the sharded fold
+    carry advances tile-locally with no cross-shard writes; feasible
+    and tie counts are one fused ``psum``.
+    """
+    from jax.experimental.shard_map import shard_map
+
+    from ..parallel.mesh import NODE_AXIS
+
+    P = jax.sharding.PartitionSpec
+    node1 = P(NODE_AXIS)
+    node2 = P(NODE_AXIS, None)
+    rep = P()
+
+    def body(schedulable, weighted, bounded, free, vecs, active):
+        nloc = schedulable.shape[0]
+        sid = jax.lax.axis_index(NODE_AXIS).astype(jnp.int64)
+
+        def step(free, xs):
+            vec, act = xs
+            fit_fail = bounded & ((vec > 0) & (free < vec)).any(axis=1)
+            mask = schedulable & ~fit_fail
+            w = jnp.where(mask, weighted, _I64_MIN)
+            lbest = jnp.argmax(w)  # first maximum within the tile
+            pair = jnp.stack(
+                [w[lbest], (sid * nloc + lbest).astype(jnp.int64)]
+            )
+            pairs = jax.lax.all_gather(pair, NODE_AXIS)  # [S, 2]
+            win = jnp.argmax(pairs[:, 0])  # lowest shard among ties
+            gval = pairs[win, 0]
+            gbest = pairs[win, 1]
+            feas_local = jnp.sum(mask, dtype=jnp.int64)
+            if want_ties:
+                ties_local = jnp.sum(
+                    mask & (weighted == gval), dtype=jnp.int64
+                )
+                sums = jax.lax.psum(
+                    jnp.stack([feas_local, ties_local]), NODE_AXIS
+                )
+                feasible, ties = sums[0], sums[1]
+            else:
+                feasible = jax.lax.psum(feas_local, NODE_AXIS)
+                ties = jnp.ones((), dtype=jnp.int64)
+            mine = win.astype(jnp.int64) == sid
+            delta = jnp.where(
+                act & (feasible > 0) & mine, vec, jnp.zeros_like(vec)
+            )
+            free = free.at[lbest].add(-delta)
+            out = jnp.stack(
+                [jnp.where(feasible > 0, gbest, -1).astype(jnp.int64),
+                 feasible, ties]
+            )
+            return free, out
+
+        free, outs = jax.lax.scan(step, free, (vecs, active))
+        return outs, free
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(node1, node1, node1, node2, rep, rep),
+        out_specs=(rep, node2),
+        check_rep=False,  # outs are psum/all_gather products: replicated
+    )
+    return jax.jit(fn)
+
+
 class DripBatchKernel:
     """Host wrapper: bucketing, device column placement, fold-carry reuse.
 
@@ -129,7 +220,7 @@ class DripBatchKernel:
     ``mark_desynced`` and the next dispatch re-uploads from the host.
     """
 
-    def __init__(self, device=None):
+    def __init__(self, device=None, mesh=None):
         from ..parallel.sharded import DeviceColumnCache
 
         self._cols = DeviceColumnCache(device)
@@ -139,6 +230,41 @@ class DripBatchKernel:
         self.dispatches = 0
         self.free_uploads = 0
         self.last_kernel_seconds = 0.0
+        # shard-parallel mode (doc/sharding.md): a 1-D placement mesh
+        # tiles the columns along the node axis and dispatches the
+        # shard_map program instead; a 1-device mesh (or None) runs the
+        # single-device program unchanged
+        self._mesh = None
+        self.repartitions = 0
+        if mesh is not None:
+            self.repartition(mesh)
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def _partition_token(self):
+        mesh = self._mesh
+        if mesh is None:
+            return ("single",)
+        return (
+            tuple(int(d.id) for d in mesh.devices.flat),
+            tuple(mesh.axis_names),
+            tuple(int(s) for s in mesh.devices.shape),
+        )
+
+    def repartition(self, mesh) -> bool:
+        """Point the kernel at a (possibly resized) placement mesh.
+        Any change to the device set or shard layout drops every cached
+        device column AND desyncs the fold carry — a resize must never
+        replay folds onto a carry tiled for the old partitioning.
+        Returns True when the partitioning actually changed."""
+        self._mesh = mesh
+        changed = self._cols.set_partition(self._partition_token())
+        if changed:
+            self.mark_desynced()
+            self.repartitions += 1
+        return changed
 
     def mark_synced(self, host_free) -> None:
         """Host applied exactly the kernel's folds — carry is reusable."""
@@ -168,22 +294,37 @@ class DripBatchKernel:
         npad = _bucket_nodes(n)
         kpad = _bucket(k, _MIN_K_BUCKET)
         no_fit = bounded is None or free is None
+        mesh = self._mesh
+        sharded = mesh is not None and int(mesh.devices.size) > 1
+        col_dev = free_dev_target = None
+        if sharded:
+            from ..parallel.mesh import node_sharding, round_up_to_shards
+
+            npad = round_up_to_shards(npad, mesh)  # equal tiles
+            col_dev = node_sharding(mesh, 1)
+            free_dev_target = node_sharding(mesh, 2)
+        if self._cols.set_partition(self._partition_token()):
+            self.mark_desynced()
+            self.repartitions += 1
         t0 = time.perf_counter()
         with enable_x64():
             sched_d = self._cols.put(
                 "schedulable", schedulable,
                 prepare=lambda a: _pad(a, npad, False),
+                device=col_dev,
             )
             w_d = self._cols.put(
                 "weighted", weighted,
                 prepare=lambda a: _pad(a.astype(np.int64), npad, _I64_MIN),
+                device=col_dev,
             )
             if no_fit:
                 # tracker-less plugin set: fit never fails
                 bounded = np.zeros((n,), dtype=bool)
                 free = np.zeros((n, 4), dtype=np.int64)
             bnd_d = self._cols.put(
-                "bounded", bounded, prepare=lambda a: _pad(a, npad, False)
+                "bounded", bounded, prepare=lambda a: _pad(a, npad, False),
+                device=col_dev,
             )
             free_d = self._free_dev
             if (
@@ -192,17 +333,27 @@ class DripBatchKernel:
                 or self._free_src is not free
                 or free_d.shape[0] != npad
             ):
-                free_d = jax.device_put(_pad(free, npad, 0))
+                free_d = jax.device_put(_pad(free, npad, 0), free_dev_target)
                 self._free_src = free
                 self.free_uploads += 1
             vecs_p = _pad(np.ascontiguousarray(vecs, dtype=np.int64), kpad, 0)
             active = np.zeros((kpad,), dtype=bool)
             active[:k] = True
-            outs, free_out = _drip_batch(
-                sched_d, w_d, bnd_d, free_d, vecs_p, active,
-                want_ties=want_ties,
-            )
-            outs = np.asarray(outs)  # the single D2H transfer
+            if sharded:
+                fn = _sharded_drip_fn(mesh, bool(want_ties))
+                with _COLLECTIVE_LOCK:
+                    outs, free_out = fn(
+                        sched_d, w_d, bnd_d, free_d, vecs_p, active
+                    )
+                    # sync INSIDE the lock: dispatch is async, and the
+                    # collective table must drain before the next launch
+                    outs = np.asarray(outs)
+            else:
+                outs, free_out = _drip_batch(
+                    sched_d, w_d, bnd_d, free_d, vecs_p, active,
+                    want_ties=want_ties,
+                )
+                outs = np.asarray(outs)  # the single D2H transfer
         self._free_dev = free_out
         self._free_synced = True  # provisional; caller desyncs on reject
         self.last_kernel_seconds = time.perf_counter() - t0

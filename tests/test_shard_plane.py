@@ -1,0 +1,458 @@
+"""Sharded placement plane (framework.shardplane + cluster.shards +
+per-shard watch fences in ClusterState): deterministic shard ownership,
+O(dirty) fence isolation between shards, claim-guarded binds through
+the BindArbiter, a threaded two-scheduler storm with in-shard placement
+and strict-parse conflict telemetry, a DETERMINISTIC stale-window
+conflict (an interfering kernel proxy binds through the rival view in
+the gap the version-stamp discipline protects), kernel repartition
+mid-storm, and the bounded rv-reuse map churn regression."""
+
+import importlib.util
+import os
+import random
+import threading
+import time
+
+import pytest
+
+from crane_scheduler_tpu.cluster.shards import ShardSpec, shard_of, shard_owners
+from crane_scheduler_tpu.cluster.state import ClusterState, Node
+from crane_scheduler_tpu.fit import FitTracker, ResourceFitPlugin
+from crane_scheduler_tpu.framework.scheduler import Scheduler
+from crane_scheduler_tpu.framework.shardplane import (
+    BindArbiter,
+    ShardedPlacementPlane,
+    ShardView,
+)
+from crane_scheduler_tpu.plugins import DynamicPlugin
+from crane_scheduler_tpu.policy import DEFAULT_POLICY
+from crane_scheduler_tpu.telemetry import Telemetry
+from crane_scheduler_tpu.telemetry.expfmt import parse_exposition
+from test_drip_columnar import METRICS, NOW, _anno, make_pod
+
+# -- deterministic ownership -------------------------------------------------
+
+
+def test_shard_of_partitions_and_is_stable():
+    names = [f"node-{i:04d}" for i in range(2000)]
+    owners = [shard_of(n, 4) for n in names]
+    assert set(owners) == {0, 1, 2, 3}
+    # stable across calls and count=1 degenerates to shard 0
+    assert owners == [shard_of(n, 4) for n in names]
+    assert all(shard_of(n, 1) == 0 for n in names)
+
+
+def test_shard_owners_disjoint_then_overlap():
+    names = [f"node-{i:04d}" for i in range(4000)]
+    # overlap 0: exactly one owner, the primary
+    for n in names[:200]:
+        assert shard_owners(n, 4) == (shard_of(n, 4),)
+    # overlap 0.25: co-owned fraction lands near a quarter, co-owner is
+    # always the ring successor, and primary assignment is unchanged
+    co = 0
+    for n in names:
+        owners = shard_owners(n, 4, 0.25)
+        assert owners[0] == shard_of(n, 4)
+        if len(owners) == 2:
+            assert owners[1] == (owners[0] + 1) % 4
+            co += 1
+    assert 0.18 < co / len(names) < 0.32
+
+
+def test_shard_spec_validation_and_observes():
+    with pytest.raises(ValueError):
+        ShardSpec(2, 2)
+    with pytest.raises(ValueError):
+        ShardSpec(0, 2, overlap=1.0)
+    spec0 = ShardSpec(0, 3, 0.25)
+    spec1 = ShardSpec(1, 3, 0.25)
+    for n in (f"node-{i:03d}" for i in range(500)):
+        owners = shard_owners(n, 3, 0.25)
+        assert spec0.observes(n) == (0 in owners)
+        assert spec1.observes(n) == (1 in owners)
+        assert spec0.owners(n) == owners
+
+
+# -- per-shard watch fences (the O(dirty) contract) --------------------------
+
+
+def _mk_cluster(n_nodes, count, overlap=0.0):
+    cluster = ClusterState()
+    for i in range(n_nodes):
+        cluster.add_node(
+            Node(
+                name=f"node-{i:03d}",
+                annotations={m: _anno(0.30, 30.0) for m in METRICS},
+            )
+        )
+    cluster.configure_shards(count, overlap)
+    return cluster
+
+
+def _node_owned_by(cluster, shard, count, overlap=0.0, only=False):
+    for node in cluster.list_nodes():
+        owners = shard_owners(node.name, count, overlap)
+        if shard in owners and (not only or owners == (shard,)):
+            return node.name
+    raise AssertionError(f"no node owned by shard {shard}")
+
+
+def test_named_write_bumps_only_observing_shards():
+    cluster = _mk_cluster(24, 2)
+    assert cluster.shard_layout() == (2, 0.0)
+    name0 = _node_owned_by(cluster, 0, 2, only=True)
+    name1 = _node_owned_by(cluster, 1, 2, only=True)
+    v0 = cluster.shard_versions(0)
+    v1 = cluster.shard_versions(1)
+
+    # annotation patch on a shard-0 node: shard 1's fences are untouched
+    cluster.patch_node_annotation(name0, METRICS[0], _anno(0.9, 10.0))
+    a0, a1 = cluster.shard_versions(0), cluster.shard_versions(1)
+    assert a0[2] > v0[2] and a0[0] > v0[0]
+    assert a1 == v1
+
+    # bind on a shard-1 node: pod fence moves for shard 1 only
+    pod = make_pod("p-fence", 100, 1 << 20)
+    cluster.add_pod(pod)
+    b0, b1 = cluster.shard_versions(0), cluster.shard_versions(1)
+    cluster.bind_pod(pod.key(), name1, NOW)
+    c0, c1 = cluster.shard_versions(0), cluster.shard_versions(1)
+    assert c1[1] > b1[1]
+    assert c0 == b0
+
+    # bulk relist bumps every shard (no per-name attribution)
+    cluster.replace_nodes(list(cluster.list_nodes()))
+    d0, d1 = cluster.shard_versions(0), cluster.shard_versions(1)
+    assert d0[2] > c0[2] and d1[2] > c1[2]
+
+
+def test_overlap_write_bumps_both_co_owners():
+    cluster = _mk_cluster(64, 2, overlap=0.5)
+    co_name = None
+    for node in cluster.list_nodes():
+        if len(shard_owners(node.name, 2, 0.5)) == 2:
+            co_name = node.name
+            break
+    assert co_name is not None
+    v0, v1 = cluster.shard_versions(0), cluster.shard_versions(1)
+    cluster.patch_node_annotation(co_name, METRICS[0], _anno(0.7, 5.0))
+    assert cluster.shard_versions(0)[2] > v0[2]
+    assert cluster.shard_versions(1)[2] > v1[2]
+
+
+def test_shard_view_filters_and_caches_node_list():
+    cluster = _mk_cluster(40, 2)
+    view0 = ShardView(cluster, ShardSpec(0, 2))
+    view1 = ShardView(cluster, ShardSpec(1, 2))
+    names0 = {n.name for n in view0.list_nodes()}
+    names1 = {n.name for n in view1.list_nodes()}
+    assert names0.isdisjoint(names1)
+    assert names0 | names1 == {n.name for n in cluster.list_nodes()}
+    # a write inside shard 1 leaves shard 0's cached list identity-equal
+    first = view0.list_nodes()
+    cluster.patch_node_annotation(
+        _node_owned_by(cluster, 1, 2, only=True), METRICS[0], _anno(0.8, 5.0)
+    )
+    assert view0.list_nodes() is first
+    assert view1.list_nodes() is not None
+
+
+# -- bind arbiter ------------------------------------------------------------
+
+
+def test_bind_arbiter_first_writer_wins():
+    arb = BindArbiter()
+    assert arb.claim("default/p", 0)
+    assert arb.claim("default/p", 0)  # idempotent for the holder
+    assert not arb.claim("default/p", 1)
+    assert arb.contested == 1
+    assert arb.holder("default/p") == 0
+    arb.release("default/p", 1)  # non-holder release is a no-op
+    assert arb.holder("default/p") == 0
+    arb.release("default/p", 0)
+    assert arb.holder("default/p") is None
+    assert arb.claim("default/p", 1)
+    assert len(arb) == 1
+
+
+def test_view_bind_claim_lost_posts_nothing():
+    cluster = _mk_cluster(8, 2)
+    arb = BindArbiter()
+    view0 = ShardView(cluster, ShardSpec(0, 2), arb)
+    view1 = ShardView(cluster, ShardSpec(1, 2), arb)
+    pod = make_pod("p-claim", 0, 0)
+    cluster.add_pod(pod)
+    node = cluster.list_nodes()[0].name
+    assert view0.bind_pod(pod.key(), node, NOW)
+    pre = cluster.pod_version
+    assert not view1.bind_pod(pod.key(), node, NOW)
+    assert cluster.pod_version == pre  # no write reached the mirror
+    assert view1.conflicts == {"claim_lost": 1}
+    # bulk path: the contested key is filtered out, the rest binds
+    p2, p3 = make_pod("p-b2", 0, 0), make_pod("p-b3", 0, 0)
+    cluster.add_pod(p2)
+    cluster.add_pod(p3)
+    assert arb.claim(p2.key(), 0)
+    bound = view1.bind_pods([(p2.key(), node), (p3.key(), node)], NOW)
+    assert bound == [p3.key()]
+    assert view1.conflicts["claim_lost"] == 2
+
+
+# -- plane storm -------------------------------------------------------------
+
+
+def _plane_factory(view):
+    sched = Scheduler(view, clock=lambda: NOW, columnar=True)
+    sched.register(ResourceFitPlugin(FitTracker(view)), weight=1)
+    sched.register(DynamicPlugin(DEFAULT_POLICY, clock=lambda: NOW), weight=3)
+    return sched
+
+
+def test_threaded_storm_places_in_shard_with_strict_telemetry():
+    cluster = _mk_cluster(24, 2, overlap=0.25)
+    tel = Telemetry()
+    plane = ShardedPlacementPlane(cluster, 2, overlap=0.25, telemetry=tel)
+    plane.add_scheduler(_plane_factory)
+    plane.refresh_node_gauges()
+
+    pod_lists = [[], []]
+    for i in range(40):
+        pod = make_pod(f"p{i:03d}", 50, 1 << 20)
+        cluster.add_pod(pod)
+        pod_lists[i % 2].append(pod)
+    results = plane.run_storm(pod_lists, window=8, threaded=True)
+
+    placed = 0
+    for shard, res in enumerate(results):
+        observed = {n.name for n in plane.views[shard].list_nodes()}
+        for r in res:
+            assert r.feasible > 0 and r.node is not None
+            assert r.node in observed, (shard, r.node)
+            placed += 1
+    assert placed == 40
+    # every bind landed exactly once
+    bound = [p for p in cluster.list_pods() if p.node_name]
+    assert len(bound) == 40
+
+    fams = parse_exposition(tel.registry.render())
+    for fam in (
+        "crane_shard_conflicts_total",
+        "crane_shard_binds_total",
+        "crane_shard_schedulers",
+        "crane_shard_nodes",
+    ):
+        assert fam in fams, sorted(fams)
+    binds = sum(
+        int(v) for (_n, _labels, v) in fams["crane_shard_binds_total"]["samples"]
+    )
+    assert binds == 40
+
+
+# -- deterministic stale-window conflict -------------------------------------
+
+
+class _InterferingKernel:
+    """Kernel proxy that simulates the racing-binder gap: after the
+    real dispatch (placements computed over the pre-bind columns) but
+    before the scheduler's pre-POST fence check, a rival scheduler
+    binds a pod onto a node this shard observes — exactly the window
+    the version-stamp discipline must catch."""
+
+    def __init__(self, inner, rival_bind):
+        self._inner = inner
+        self._rival_bind = rival_bind
+        self.fired = 0
+
+    def dispatch(self, *a, **kw):
+        out = self._inner.dispatch(*a, **kw)
+        if self._rival_bind is not None:
+            rival, self._rival_bind = self._rival_bind, None
+            rival()
+            self.fired += 1
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def test_stale_window_drops_and_retries_at_queue_position():
+    cluster = _mk_cluster(48, 2, overlap=0.5)
+    plane = ShardedPlacementPlane(cluster, 2, overlap=0.5)
+    scheds = plane.add_scheduler(_plane_factory)
+    sched0 = scheds[0]
+
+    # co-owned node: a bind by shard 1 moves shard 0's pod fence
+    co_name = None
+    for node in cluster.list_nodes():
+        if shard_owners(node.name, 2, 0.5) == (0, 1):
+            co_name = node.name
+            break
+    assert co_name is not None
+
+    rival_pod = make_pod("p-rival", 10, 1 << 10)
+    cluster.add_pod(rival_pod)
+
+    def rival():
+        assert plane.views[1].bind_pod(rival_pod.key(), co_name, NOW)
+
+    from crane_scheduler_tpu.scorer.drip_batch import DripBatchKernel
+
+    sched0._batch_kernel = _InterferingKernel(DripBatchKernel(), rival)
+
+    pods = []
+    for i in range(12):
+        pod = make_pod(f"p-sw{i:02d}", 20, 1 << 16)
+        cluster.add_pod(pod)
+        pods.append(pod)
+    results = sched0.schedule_queue(pods, window=12)
+
+    assert sched0._batch_kernel.fired == 1
+    assert sched0.drip_stats()["batch"]["conflicts"] == 1
+    assert plane.views[0].conflicts.get("stale_window") == 1
+    # the window retried at queue position: every pod still placed,
+    # in order, inside shard 0's observed nodes
+    observed = {n.name for n in plane.views[0].list_nodes()}
+    assert [r.pod_key for r in results] == [p.key() for p in pods]
+    for r in results:
+        assert r.feasible > 0 and r.node in observed
+    # the rival's bind really happened (capacity was taken)
+    assert cluster.get_pod(rival_pod.key()).node_name == co_name
+
+
+def test_stale_window_retry_exhaustion_falls_back_per_pod():
+    cluster = _mk_cluster(16, 1)
+    plane = ShardedPlacementPlane(cluster, 1)
+    sched = plane.add_scheduler(_plane_factory)[0]
+    sched.max_window_retries = 2
+
+    # a rival that fires on EVERY dispatch keeps the fence moving, so
+    # the window exhausts its retries and serializes per-pod
+    extra = iter(range(1000))
+
+    class _AlwaysRival(_InterferingKernel):
+        def dispatch(self, *a, **kw):
+            out = self._inner.dispatch(*a, **kw)
+            i = next(extra)
+            p = make_pod(f"p-x{i:03d}", 1, 1 << 8)
+            cluster.add_pod(p)
+            assert cluster.bind_pod(p.key(), cluster.list_nodes()[0].name, NOW)
+            self.fired += 1
+            return out
+
+    from crane_scheduler_tpu.scorer.drip_batch import DripBatchKernel
+
+    sched._batch_kernel = _AlwaysRival(DripBatchKernel(), None)
+    pods = []
+    for i in range(6):
+        pod = make_pod(f"p-ex{i}", 10, 1 << 10)
+        cluster.add_pod(pod)
+        pods.append(pod)
+    results = sched.schedule_queue(pods, window=6)
+    assert [r.pod_key for r in results] == [p.key() for p in pods]
+    assert all(r.feasible > 0 and r.node for r in results)
+    st = sched.drip_stats()
+    assert st["batch"]["conflicts"] == sched.max_window_retries + 1
+
+
+# -- repartition mid-storm (DeviceColumnCache regression) --------------------
+
+
+def test_kernel_repartition_mid_storm_desyncs_and_stays_parity():
+    from crane_scheduler_tpu.parallel.mesh import make_placement_mesh
+
+    cluster = _mk_cluster(30, 1)
+    plane = ShardedPlacementPlane(cluster, 1)
+    sched = plane.add_scheduler(_plane_factory)[0]
+
+    oracle_cluster = _mk_cluster(30, 1)
+    oracle = _plane_factory(ShardView(oracle_cluster, ShardSpec(0, 1)))
+
+    def leg(tag, lo, hi):
+        got, want = [], []
+        pods_a, pods_b = [], []
+        for i in range(lo, hi):
+            pa = make_pod(f"p{tag}{i:03d}", 40, 1 << 18)
+            pb = make_pod(f"p{tag}{i:03d}", 40, 1 << 18)
+            cluster.add_pod(pa)
+            oracle_cluster.add_pod(pb)
+            pods_a.append(pa)
+            pods_b.append(pb)
+        for r in sched.schedule_queue(pods_a, window=8):
+            got.append((r.node, r.feasible, r.reason))
+        for pb in pods_b:
+            r = oracle.schedule_one(pb)
+            want.append((r.node, r.feasible, r.reason))
+        assert got == want, tag
+
+    leg("a", 0, 16)
+    kern = sched._batch_kernel
+    assert kern is not None and kern.repartitions == 0
+    # repartition onto an explicit 1-device placement mesh mid-storm:
+    # every cached device column drops and the fold carry desyncs — the
+    # next window must re-upload, never replay onto the old layout
+    assert kern.repartition(make_placement_mesh(1)) is True
+    assert kern.repartitions == 1
+    assert kern._free_dev is None and not kern._free_synced
+    leg("b", 16, 32)
+    assert kern.free_uploads >= 2
+
+
+# -- bounded rv-reuse map (churn regression) ---------------------------------
+
+_STUB = os.path.join(os.path.dirname(__file__), "kube_stub.py")
+_spec = importlib.util.spec_from_file_location("kube_stub", _STUB)
+kube_stub = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(kube_stub)
+
+
+def _wait_until(predicate, timeout=5.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_node_rv_reuse_map_stays_bounded_under_churn():
+    """`known_rvs` must track the live node set: watch deletes pop their
+    entries, relists rebuild exactly the live set, and the relist-time
+    prune evicts anything a concurrent delete left behind — the map can
+    never grow monotonically with churn."""
+    from crane_scheduler_tpu.cluster.kube import KubeClusterClient
+
+    server = kube_stub.KubeStubServer().start()
+    try:
+        for i in range(30):
+            server.state.add_node(f"node-{i:05d}", "10.0.0.1")
+        client = KubeClusterClient(server.url)
+        try:
+            client.start()
+            assert _wait_until(lambda: len(client.list_nodes()) == 30)
+            client._relist_nodes()
+            assert client.rv_reuse_size() == 30
+
+            # watch churn: deletes pop their own entries
+            for i in range(10):
+                server.state.delete_node(f"node-{i:05d}")
+            assert _wait_until(lambda: len(client.list_nodes()) == 20)
+            assert _wait_until(lambda: client.rv_reuse_size() <= 20)
+
+            # adds arrive via watch (no rv entry until a relist); the
+            # next relist rebuilds exactly the live set
+            for i in range(40, 55):
+                server.state.add_node(f"node-{i:05d}", "10.0.9.9")
+            assert _wait_until(lambda: len(client.list_nodes()) == 35)
+            client._relist_nodes()
+            assert client.rv_reuse_size() == 35
+
+            # the race the backstop exists for: a stale entry that a
+            # concurrent watch delete left behind is pruned, not kept
+            client._node_rvs["ghost-node"] = "999"
+            assert client.prune_node_rvs() == 1
+            assert client.rv_reuse_size() == 35
+            assert client.rv_reuse_size() <= len(client.list_nodes())
+        finally:
+            client.stop()
+    finally:
+        server.stop()

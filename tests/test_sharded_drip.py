@@ -1,0 +1,297 @@
+"""Sharded drip engine parity (doc/sharding.md): the shard_map
+mask+argmax+fold kernel over a forced 8-way host-device mesh must be
+bit-identical to the single-device kernel — chosen node, feasible
+count, AND tie count — over seeded fuzz, fold-carry reuse across
+windows, mesh repartitioning mid-stream, and a full scheduler-level
+seeded tie replay (RNG stream equality with both per-pod oracles).
+
+jax fixes its device count at first import, and the pytest process is
+already initialised single-device, so every multi-device leg runs in a
+subprocess with ``XLA_FLAGS=--xla_force_host_platform_device_count=8``
+(same spawn discipline as test_distributed.py). This file doubles as
+the worker: ``python test_sharded_drip.py worker`` runs the legs and
+exits non-zero on the first mismatch.
+"""
+
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TESTS = os.path.dirname(os.path.abspath(__file__))
+
+
+# -- pytest side: spawn the forced-8-device worker ---------------------------
+
+
+def _spawn_worker(leg, timeout=420):
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [_REPO, _TESTS, env.get("PYTHONPATH", "")]
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "worker", leg],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=timeout,
+    )
+    assert proc.returncode == 0, (
+        f"worker leg {leg!r} failed (rc={proc.returncode})\n"
+        f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr}"
+    )
+    return proc.stdout
+
+
+def test_sharded_kernel_parity_fuzz():
+    out = _spawn_worker("kernel")
+    assert "kernel-parity OK" in out
+
+
+def test_sharded_scheduler_tie_replay_parity():
+    out = _spawn_worker("scheduler")
+    assert "scheduler-parity OK" in out
+
+
+def test_single_device_mesh_is_plain_kernel():
+    """A 1-device placement mesh falls back to the single-device program
+    in-process (no shard_map), so the mesh kwarg is always safe."""
+    import numpy as np
+
+    from crane_scheduler_tpu.parallel.mesh import make_placement_mesh
+    from crane_scheduler_tpu.scorer.drip_batch import DripBatchKernel
+
+    mesh = make_placement_mesh(1)
+    rng = __import__("random").Random(3)
+    n, k = 37, 9
+    schedulable = np.array([rng.random() < 0.8 for _ in range(n)])
+    weighted = np.array(
+        [rng.randrange(0, 9) for _ in range(n)], dtype=np.int64
+    )
+    bounded = np.array([rng.random() < 0.7 for _ in range(n)])
+    free = np.array(
+        [[rng.randrange(0, 4000), rng.randrange(0, 2 << 30),
+          rng.randrange(0, 1 << 20), rng.randrange(0, 20)]
+         for _ in range(n)], dtype=np.int64)
+    vecs = np.array(
+        [[rng.randrange(0, 3000), rng.randrange(0, 1 << 30), 0, 1]
+         for _ in range(k)], dtype=np.int64)
+
+    got = DripBatchKernel(mesh=mesh).dispatch(
+        schedulable, weighted, bounded, free, vecs
+    )
+    want = DripBatchKernel().dispatch(
+        schedulable, weighted, bounded, free, vecs
+    )
+    for g, w in zip(got, want):
+        assert (np.asarray(g) == np.asarray(w)).all()
+
+
+# -- worker side (forced 8 host devices) -------------------------------------
+
+
+def _fuzz_inputs(rng, n, k, score_span):
+    import numpy as np
+
+    schedulable = np.array([rng.random() < 0.8 for _ in range(n)])
+    # small score spans force real value ties across shards, exercising
+    # the lowest-shard-wins leg of the cross-shard argmax reduction
+    weighted = np.array(
+        [rng.randrange(0, score_span) for _ in range(n)], dtype=np.int64
+    )
+    bounded = np.array([rng.random() < 0.7 for _ in range(n)])
+    free = np.array(
+        [[rng.randrange(0, 4000), rng.randrange(0, 2 << 30),
+          rng.randrange(0, 1 << 20), rng.randrange(0, 20)]
+         for _ in range(n)], dtype=np.int64)
+    vecs = np.array(
+        [[rng.randrange(0, 3000), rng.randrange(0, 1 << 30), 0, 1]
+         for _ in range(k)], dtype=np.int64)
+    return schedulable, weighted, bounded, free, vecs
+
+
+def _assert_same(tag, got, want):
+    import numpy as np
+
+    for name, g, w in zip(("chosen", "feasible", "ties"), got, want):
+        g, w = np.asarray(g), np.asarray(w)
+        if not (g == w).all():
+            raise AssertionError(f"{tag}: {name} diverged\n{g}\nvs\n{w}")
+
+
+def _worker_kernel():
+    import random
+
+    import jax
+    import numpy as np
+
+    from crane_scheduler_tpu.parallel.mesh import make_placement_mesh
+    from crane_scheduler_tpu.scorer.drip_batch import DripBatchKernel
+
+    assert jax.device_count() == 8, jax.devices()
+    mesh8 = make_placement_mesh(8)
+
+    # 1) seeded fuzz: alternating tie-heavy / wide score spans
+    for seed in range(6):
+        rng = random.Random(seed)
+        n = rng.randrange(5, 700)
+        k = rng.randrange(1, 40)
+        span = 5 if seed % 2 == 0 else 2**33
+        inputs = _fuzz_inputs(rng, n, k, span)
+        got = DripBatchKernel(mesh=mesh8).dispatch(*inputs)
+        want = DripBatchKernel().dispatch(*inputs)
+        _assert_same(f"fuzz seed={seed} n={n} k={k}", got, want)
+
+    # 2) fold-carry reuse across two windows: the host applies exactly
+    # the kernel's folds, mark_synced keeps the sharded carry device-side
+    rng = random.Random(99)
+    schedulable, weighted, bounded, free, vecs1 = _fuzz_inputs(
+        rng, 300, 16, 4
+    )
+    vecs2 = _fuzz_inputs(rng, 1, 16, 4)[4]
+    kern = DripBatchKernel(mesh=mesh8)
+    base = DripBatchKernel()
+
+    def host_fold(free, outs, vecs):
+        free = free.copy()
+        chosen, feasible, _ties = outs
+        for i in range(len(vecs)):
+            if int(feasible[i]) > 0 and int(chosen[i]) >= 0:
+                free[int(chosen[i])] -= vecs[i]
+        return free
+
+    out1 = kern.dispatch(schedulable, weighted, bounded, free, vecs1)
+    ref1 = base.dispatch(schedulable, weighted, bounded, free, vecs1)
+    _assert_same("carry window1", out1, ref1)
+    free2 = host_fold(free, out1, vecs1)
+    kern.mark_synced(free2)
+    base.mark_synced(free2)
+    out2 = kern.dispatch(schedulable, weighted, bounded, free2, vecs2)
+    ref2 = base.dispatch(schedulable, weighted, bounded, free2, vecs2)
+    _assert_same("carry window2", out2, ref2)
+    assert kern.free_uploads == 1, kern.free_uploads  # carry was reused
+
+    # 3) repartition mid-stream: 8-way -> 4-way drops every device
+    # column and desyncs the carry (never replay folds onto a carry
+    # tiled for the old layout), and the next dispatch is still parity
+    mesh4 = make_placement_mesh(4)
+    assert kern.repartition(mesh4) is True
+    assert kern.repartitions == 1
+    assert kern._free_dev is None and not kern._free_synced
+    out3 = kern.dispatch(schedulable, weighted, bounded, free2, vecs2)
+    _assert_same("post-repartition", out3, ref2)
+    assert kern.free_uploads == 2  # desync forced a fresh upload
+    # same mesh again is a no-op
+    assert kern.repartition(mesh4) is False
+    assert kern.repartitions == 1
+
+    # 4) padding edge: n smaller than the shard count still pads to an
+    # equal multiple and ignores the padding rows
+    tiny = _fuzz_inputs(random.Random(5), 3, 4, 3)
+    _assert_same(
+        "tiny-n",
+        DripBatchKernel(mesh=mesh8).dispatch(*tiny),
+        DripBatchKernel().dispatch(*tiny),
+    )
+
+    print("kernel-parity OK")
+
+
+def _worker_scheduler():
+    import random
+
+    import jax
+
+    from crane_scheduler_tpu.fit import FitTracker, ResourceFitPlugin
+    from crane_scheduler_tpu.framework.scheduler import Scheduler
+    from crane_scheduler_tpu.parallel.mesh import make_placement_mesh
+    from crane_scheduler_tpu.plugins import DynamicPlugin
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+    from test_drip_batch import run_queue_leg
+    from test_drip_columnar import (
+        METRICS,
+        NOW,
+        _anno,
+        build_cluster,
+        build_scheduler,
+        fuzz_node_specs,
+        fuzz_pod_specs,
+        run_leg,
+    )
+
+    assert jax.device_count() == 8, jax.devices()
+    mesh8 = make_placement_mesh(8)
+
+    def build_mesh_scheduler(cluster, seed=None):
+        sched = Scheduler(
+            cluster, clock=lambda: NOW, columnar=True,
+            tie_break_seed=seed, mesh=mesh8,
+        )
+        sched.register(ResourceFitPlugin(FitTracker(cluster)), weight=1)
+        sched.register(
+            DynamicPlugin(DEFAULT_POLICY, clock=lambda: NOW), weight=3
+        )
+        return sched
+
+    # 1) fuzz parity: mesh-sharded queue vs both per-pod oracles
+    for seed in (0, 11):
+        rng = random.Random(seed)
+        node_specs = fuzz_node_specs(rng, 60)
+        pod_specs = fuzz_pod_specs(rng, 90)
+
+        cq = build_cluster(node_specs)
+        sq = build_mesh_scheduler(cq)
+        got = run_queue_leg(cq, sq, pod_specs, window=24)
+        assert sq._batch_kernel is not None
+        assert sq._batch_kernel.mesh is mesh8
+        assert sq._batch_kernel.dispatches > 0
+
+        cc = build_cluster(node_specs)
+        col = run_leg(cc, build_scheduler(cc, columnar=True), pod_specs)
+        cs = build_cluster(node_specs)
+        sca = run_leg(cs, build_scheduler(cs, columnar=False), pod_specs)
+        if not (got == col == sca):
+            raise AssertionError(f"scheduler fuzz seed={seed} diverged")
+
+    # 2) seeded tie replay: identical nodes guarantee window ties, the
+    # replay re-runs per-pod consuming the seeded RNG call-for-call, so
+    # placements AND the RNG stream match both per-pod paths
+    specs = [
+        (f"node-{i:02d}", {m: _anno(0.30, 30.0) for m in METRICS}, None)
+        for i in range(10)
+    ]
+    pods = [(f"p{i:03d}", 0, 0, False) for i in range(100)]
+
+    cq = build_cluster(specs)
+    sq = build_mesh_scheduler(cq, seed=7)
+    got = run_queue_leg(cq, sq, pods, window=16)
+
+    cc = build_cluster(specs)
+    sc = build_scheduler(cc, columnar=True, seed=7)
+    col = run_leg(cc, sc, pods)
+
+    cs = build_cluster(specs)
+    ss = build_scheduler(cs, columnar=False, seed=7)
+    sca = run_leg(cs, ss, pods)
+
+    assert got == col == sca, "seeded tie replay diverged"
+    assert len({node for node, _, _ in got}) > 1
+    assert sq.drip_stats()["batch"]["replays"] > 0
+    assert (
+        sq._tie_rng.getstate()
+        == sc._tie_rng.getstate()
+        == ss._tie_rng.getstate()
+    )
+
+    print("scheduler-parity OK")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 2 and sys.argv[1] == "worker":
+        leg = sys.argv[2] if len(sys.argv) > 2 else "kernel"
+        {"kernel": _worker_kernel, "scheduler": _worker_scheduler}[leg]()
+    else:
+        print("usage: test_sharded_drip.py worker {kernel|scheduler}")
+        sys.exit(2)

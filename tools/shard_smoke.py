@@ -1,0 +1,210 @@
+"""Shard-plane smoke gate (``make shard-smoke``): run TWO drip
+schedulers over one wire-stub apiserver on a FORCED 8-way host-device
+placement mesh, hand both the same contended pod queue, and fail CI
+unless
+
+  * jax really came up with 8 host devices and both schedulers
+    dispatched the shard_map kernel over the 8-way mesh (no silent
+    single-device fallback),
+  * every pod was bound exactly once — the stub's per-pod
+    ``bind_posts == 1`` oracle and ``duplicate_binds == 0`` (the
+    BindArbiter claims fire BEFORE the POST, so a lost race never
+    reaches the wire),
+  * the contended queue actually produced optimistic conflicts
+    (``claim_lost`` > 0 — a storm that cannot conflict proves nothing),
+  * every accepted placement landed inside the binding shard's observed
+    node set, and
+  * the ``crane_shard_*`` families survive the strict exposition
+    parser.
+
+Exit 0 = every check passed; any violation prints the failure and
+exits nonzero.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import time
+
+# must precede the first jax import anywhere in the process
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+_STUB = os.path.join(_REPO, "tests", "kube_stub.py")
+
+
+def _load_stub():
+    spec = importlib.util.spec_from_file_location("kube_stub", _STUB)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _wait_until(predicate, timeout=10.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+N_NODES = 24
+N_PODS = 40
+SHARDS = 2
+OVERLAP = 0.5
+
+
+def main() -> int:
+    import jax
+
+    from crane_scheduler_tpu.cluster.kube import KubeClusterClient
+    from crane_scheduler_tpu.fit import FitTracker, ResourceFitPlugin
+    from crane_scheduler_tpu.framework.scheduler import Scheduler
+    from crane_scheduler_tpu.framework.shardplane import ShardedPlacementPlane
+    from crane_scheduler_tpu.parallel.mesh import make_placement_mesh
+    from crane_scheduler_tpu.plugins import DynamicPlugin
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+    from crane_scheduler_tpu.telemetry import Telemetry
+    from crane_scheduler_tpu.telemetry.expfmt import (
+        ExpositionError,
+        parse_exposition,
+    )
+    from crane_scheduler_tpu.utils import format_local_time
+
+    failures = 0
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        nonlocal failures
+        mark = "ok" if ok else "FAIL"
+        print(f"[shard-smoke] {name}: {mark}{' — ' + detail if detail else ''}")
+        if not ok:
+            failures += 1
+
+    check("forced 8 host devices", jax.device_count() == 8,
+          f"devices={jax.device_count()}")
+
+    kube_stub = _load_stub()
+    now = time.time()
+    metrics = tuple(sp.name for sp in DEFAULT_POLICY.spec.sync_period)
+    server = kube_stub.KubeStubServer().start()
+    client = None
+    try:
+        for i in range(N_NODES):
+            anno = {
+                m: f"{0.20 + 0.01 * (i % 7):.5f},{format_local_time(now - 20.0)}"
+                for m in metrics
+            }
+            server.state.add_node(f"node-{i:03d}", f"10.0.0.{i}", anno)
+        for i in range(N_PODS):
+            server.state.add_pod(
+                "default", f"p{i:03d}",
+                spec={"containers": [{
+                    "name": "c",
+                    "resources": {"requests": {
+                        "cpu": "50m", "memory": "16Mi",
+                    }},
+                }]},
+            )
+
+        client = KubeClusterClient(server.url)
+        client.start()
+        check(
+            "wire mirror synced",
+            _wait_until(lambda: len(client.list_nodes()) == N_NODES
+                        and len(client.list_pods()) == N_PODS),
+            f"nodes={len(client.list_nodes())} pods={len(client.list_pods())}",
+        )
+
+        mesh = make_placement_mesh(8)
+        tel = Telemetry()
+        plane = ShardedPlacementPlane(
+            client, SHARDS, overlap=OVERLAP, telemetry=tel, mesh=mesh
+        )
+
+        def factory(view):
+            sched = Scheduler(view, clock=time.time, columnar=True)
+            sched.register(ResourceFitPlugin(FitTracker(view)), weight=1)
+            sched.register(
+                DynamicPlugin(DEFAULT_POLICY, clock=time.time), weight=3
+            )
+            return sched
+
+        plane.add_scheduler(factory)
+        plane.refresh_node_gauges()
+
+        # conflict storm: BOTH schedulers race over the SAME pod queue —
+        # the arbiter must let exactly one POST per pod reach the wire
+        pods = [client.get_pod(f"default/p{i:03d}") for i in range(N_PODS)]
+        results = plane.run_storm([pods, pods], window=8, threaded=True)
+
+        wins: dict[str, int] = {}
+        in_shard = True
+        for shard, res in enumerate(results):
+            observed = {n.name for n in plane.views[shard].list_nodes()}
+            for r in res:
+                if r.node is not None:
+                    wins[r.pod_key] = wins.get(r.pod_key, 0) + 1
+                    if r.node not in observed:
+                        in_shard = False
+        check("every pod won exactly once",
+              len(wins) == N_PODS and all(v == 1 for v in wins.values()),
+              f"won={len(wins)}/{N_PODS}")
+        check("placements stayed in shard", in_shard)
+
+        posts = sum(server.state.bind_posts.values())
+        dup = server.state.duplicate_binds()
+        check("bind POSTs == pods (no duplicate ever left the process)",
+              posts == N_PODS and dup == 0,
+              f"posts={posts} dup={dup}")
+        per_pod = dict(server.state.bind_posts)
+        check("per-pod bind_posts == 1 oracle",
+              len(per_pod) == N_PODS
+              and all(v == 1 for v in per_pod.values()),
+              f"pods={len(per_pod)} max={max(per_pod.values(), default=0)}")
+
+        conflicts = plane.conflict_stats()
+        check("contended queue produced conflicts",
+              conflicts.get("claim_lost", 0) > 0, f"{conflicts}")
+
+        sharded_ok = all(
+            s._batch_kernel is not None
+            and s._batch_kernel.mesh is mesh
+            and s._batch_kernel.dispatches > 0
+            for s in plane.schedulers
+        )
+        check("shard_map kernel dispatched on the 8-way mesh", sharded_ok)
+
+        try:
+            families = parse_exposition(tel.registry.render())
+            check("registry strict parse", True, f"{len(families)} families")
+        except ExpositionError as e:
+            families = {}
+            check("registry strict parse", False, str(e))
+        for required in (
+            "crane_shard_conflicts_total",
+            "crane_shard_binds_total",
+            "crane_shard_schedulers",
+            "crane_shard_nodes",
+        ):
+            check(f"family {required}", required in families)
+    finally:
+        if client is not None:
+            client.stop()
+        server.stop()
+
+    print(f"[shard-smoke] {'PASS' if not failures else 'FAIL'}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
