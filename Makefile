@@ -10,7 +10,7 @@ IMAGE_SCHEDULER := $(REGISTRY)/crane-scheduler-tpu:$(GIT_VERSION)
 
 .PHONY: all native test test-fast bench sim e2e metrics-smoke \
 	desched-smoke chaos-smoke recovery-smoke trace-smoke drip-smoke \
-	shard-smoke overload-smoke dashboards \
+	shard-smoke overload-smoke replica-smoke dashboards \
 	clean images image-annotator image-scheduler push-images
 
 all: native test
@@ -77,6 +77,15 @@ recovery-smoke:
 # strict-parse — see doc/overload.md
 overload-smoke:
 	$(PYTHON) tools/overload_smoke.py
+
+# primary + delta-stream feed + 2 wire-fed serving replicas + the
+# consistent-hash router: replicas must catch up and render
+# byte-identical verdicts at the same version key, the feed must
+# survive the idle reaper, killing a replica mid-storm must eject it
+# with goodput continuing on the survivor, and the crane_replica_* /
+# crane_router_* families must strict-parse — see doc/replication.md
+replica-smoke:
+	$(PYTHON) tools/replica_smoke.py
 
 # one pod traced end to end over a live stub apiserver (traceparent on
 # the bind POST, lifecycle record in the flight ring), then replayed

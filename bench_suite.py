@@ -102,6 +102,11 @@ def emit(payload):
     # configs that run N concurrent schedulers set "schedulers" in
     # their payload; everything else is the classic single loop
     env["schedulers"] = payload.pop("schedulers", 1)
+    # replicated-tier configs (ISSUE 16) set the serving replica count
+    # and router mode; every row records them so a replicated number
+    # can never be mistaken for a single-process one
+    env["replicas"] = payload.pop("replicas", 0)
+    env["router"] = payload.pop("router", None)
     payload.setdefault("env", env)
     print(json.dumps(payload), flush=True)
 
@@ -3168,11 +3173,365 @@ def config18(dtype, rtt, n_nodes=250_000):
         f"conflict gate: rate {conflict_rate:.3%} > 5%"
 
 
+def config19(dtype, rtt, n_nodes=50_000, n_replicas=4):
+    """Round-16 tentpole gate: the replicated scoring tier — one
+    50k-node primary publishing the delta-stream feed, N shared-nothing
+    serving replicas (each a private mirror + store + cache + breaker +
+    admission stack fed over the wire), and the consistent-hash router
+    in front.
+
+    Methodology on the 1-core CI host: real CPU parallelism can't carry
+    a replica-scaling claim here, so each replica's scorer is paced by a
+    simulated accelerator dispatch — a ``device_sim_ms`` sleep under a
+    per-replica device lock. Dispatches serialize per device exactly
+    like a real one-TPU-per-replica deployment, and the sleep releases
+    the GIL so different replicas' devices overlap the way separate
+    hosts would; the parse/render/transport CPU stays real and shared.
+    The baseline is measured IN-RUN: the same seeded closed-loop client
+    population through a single-replica router first.
+
+    Legs:
+
+      baseline — closed-loop clients (one per tenant, cache-busting
+                 unique ``now`` per request, 10 s deadlines) through a
+                 1-replica router;
+      storm    — the same client population through the N-replica hash
+                 router, tenants pre-picked via ``route_for`` to cover
+                 every replica (a thin deterministic stand-in for a
+                 large tenant population), with annotation churn
+                 publishing delta windows and replica lag sampled
+                 throughout both legs;
+      identity — at a quiesced version fence (bootstrap, and again
+                 after churn with a forced refresh), the same explicit
+                 ``now`` posted to every replica directly: bodies must
+                 be byte-identical and stamp the published version.
+
+    Gates: storm goodput >= 3x the in-run baseline at 4 replicas;
+    byte-identical verdicts across replicas at the same version key;
+    every lag sample <= the configured version budget; 0
+    expired-at-dispatch on every replica; the router's per-replica
+    request counters (strict-parsed from /metrics) show every replica
+    served."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from crane_scheduler_tpu.cluster.replication import DeltaPublisher
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+    from crane_scheduler_tpu.service import (
+        ReplicaRouter,
+        ScoringHTTPServer,
+        ScoringService,
+        ServingReplica,
+    )
+    from crane_scheduler_tpu.sim import SimConfig, Simulator
+    from crane_scheduler_tpu.telemetry.expfmt import parse_exposition
+
+    seed = 19
+    # sized so the per-replica device term dominates the shared-CPU
+    # render/transport term on the 1-core CI host: scaling then
+    # measures replica overlap, not host cores
+    device_sim_ms = 800.0
+    lag_budget = 64
+    churn_patches = 16
+    baseline_s = 12.0
+    storm_s = 14.0
+    rng = random.Random(seed)
+
+    sim = Simulator(SimConfig(n_nodes=n_nodes, seed=seed))
+    sim.sync_metrics()
+    svc = ScoringService(
+        sim.cluster, DEFAULT_POLICY, dtype=dtype, now_bucket_s=0.0
+    )
+    svc.refresh()
+    pub = DeltaPublisher(sim.cluster, telemetry=svc.telemetry)
+    server = ScoringHTTPServer(
+        svc, port=0, frontend="async", replication=pub
+    )
+    server.start()
+    # windows are published explicitly below (deterministic churn),
+    # never from the wall-clock timer
+    pub.publish_window()
+
+    def paced(inner):
+        # one simulated accelerator per replica: dispatches serialize
+        # on the device lock, and the sleep releases the GIL so OTHER
+        # replicas' devices run concurrently — the scaling axis under
+        # test
+        lock = threading.Lock()
+
+        def scorer(*args, **kwargs):
+            with lock:
+                time.sleep(device_sim_ms / 1e3)
+                return inner(*args, **kwargs)
+
+        return scorer
+
+    replicas = []
+    routers = []
+    try:
+        for i in range(n_replicas):
+            r = ServingReplica(
+                DEFAULT_POLICY,
+                name=f"replica-{i}",
+                feed=("127.0.0.1", server.port),
+                dtype=dtype,
+                now_bucket_s=0.0,
+                scorer_wrap=paced,
+            )
+            r.start()
+            replicas.append(r)
+        for r in replicas:
+            assert r.wait_caught_up(pub.published_version, timeout_s=60.0), \
+                f"{r.name} never caught up to v{pub.published_version}"
+
+        now0 = sim.clock.now()
+        counter = [0]
+        counter_lock = threading.Lock()
+
+        def fresh_now():
+            # a unique `now` per request defeats the response cache and
+            # single-flight coalescing: every request is a real dispatch
+            with counter_lock:
+                counter[0] += 1
+                return now0 + counter[0] * 1e-4
+
+        def post(port, body, headers=None, timeout=30.0):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/score", data=body,
+                method="POST",
+                headers={"Content-Type": "application/json",
+                         **(headers or {})},
+            )
+            t0 = time.perf_counter()
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    payload = resp.read()
+                    return resp.status, time.perf_counter() - t0, payload
+            except urllib.error.HTTPError as e:
+                e.read()
+                return e.code, time.perf_counter() - t0, b""
+
+        # warm every replica: the first request ingests the mirror into
+        # the columnar store (refresh=True) and pays the jit compile
+        for r in replicas:
+            for refresh in (True, False):
+                body = json.dumps(
+                    {"now": fresh_now(), "refresh": refresh}
+                ).encode()
+                status, _, _ = post(r.port, body)
+                assert status == 200, f"warmup {r.name}: HTTP {status}"
+
+        def identity_check(refresh):
+            # same version fence + same explicit now at every replica
+            # => byte-identical bodies stamping the published version
+            v = pub.published_version
+            for r in replicas:
+                assert r.wait_caught_up(v, timeout_s=60.0), \
+                    f"{r.name} stuck behind v{v}"
+            body = json.dumps(
+                {"now": fresh_now(), "refresh": refresh}
+            ).encode()
+            rendered = []
+            for r in replicas:
+                status, _, payload = post(r.port, body)
+                assert status == 200, f"identity {r.name}: HTTP {status}"
+                rendered.append(payload)
+            assert all(p == rendered[0] for p in rendered), \
+                "replicas at the same version rendered different bytes"
+            doc = json.loads(rendered[0])
+            assert doc["version"] == v, (doc["version"], v)
+            return len(rendered[0])
+
+        ident_boot = identity_check(refresh=False)
+
+        router1 = ReplicaRouter(
+            [(replicas[0].name, "127.0.0.1", replicas[0].port)],
+            primary=("127.0.0.1", server.port), mode="hash",
+            lag_budget_versions=lag_budget, port=0,
+        )
+        router1.start()
+        routers.append(router1)
+        routerN = ReplicaRouter(
+            [(r.name, "127.0.0.1", r.port) for r in replicas],
+            primary=("127.0.0.1", server.port), mode="hash",
+            lag_budget_versions=lag_budget, port=0,
+        )
+        routerN.start()
+        routers.append(routerN)
+
+        # tenant population: 3 closed-loop clients per replica (enough
+        # in-flight depth to keep each device busy across the shared
+        # parse/render hops), names picked deterministically off the
+        # static ring so the hash router spreads them over every
+        # replica (what a large real tenant population looks like,
+        # without needing thousands of client threads)
+        per_replica = {r.name: [] for r in replicas}
+        i = 0
+        while any(len(v) < 3 for v in per_replica.values()):
+            i += 1
+            assert i < 10_000, "ring never covered every replica"
+            t = f"tenant-{i}"
+            owner = routerN.route_for(t)
+            if owner is not None and len(per_replica[owner]) < 3:
+                per_replica[owner].append(t)
+        tenants = [t for ts in per_replica.values() for t in ts]
+
+        # annotation churn + lag sampling across both legs: patch a
+        # seeded handful of nodes, publish the delta window, sample
+        # every replica's lag vs the published fence
+        node_names = [n.name for n in sim.cluster.list_nodes()]
+        churn_stop = threading.Event()
+        lag_samples = []
+        windows = [0]
+
+        def churn_loop():
+            j = 0
+            while not churn_stop.is_set():
+                for _ in range(churn_patches):
+                    j += 1
+                    sim.cluster.patch_node_annotation(
+                        rng.choice(node_names),
+                        "crane.io/bench-churn", str(j),
+                    )
+                pub.publish_window()
+                windows[0] += 1
+                for _ in range(4):
+                    v = pub.published_version
+                    lag_samples.extend(
+                        max(0, v - r.applied_version) for r in replicas
+                    )
+                    if churn_stop.wait(0.5):
+                        return
+
+        churn = threading.Thread(target=churn_loop, daemon=True)
+        churn.start()
+
+        def closed_loop(port, duration_s):
+            stop_at = time.perf_counter() + duration_s
+            results = []
+            res_lock = threading.Lock()
+
+            def client(tenant):
+                while time.perf_counter() < stop_at:
+                    body = json.dumps(
+                        {"now": fresh_now(), "refresh": False}
+                    ).encode()
+                    status, lat, _ = post(
+                        port, body,
+                        headers={"crane-tenant": tenant,
+                                 "crane-deadline-ms": "10000"},
+                    )
+                    with res_lock:
+                        results.append((status, lat))
+
+            threads = [
+                threading.Thread(target=client, args=(t,)) for t in tenants
+            ]
+            t0 = time.perf_counter()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            elapsed = time.perf_counter() - t0
+            ok = sorted(lat for status, lat in results if status == 200)
+            return {
+                "clients": len(tenants),
+                "duration_s": round(elapsed, 3),
+                "requests": len(results),
+                "served": len(ok),
+                "rps": round(len(ok) / elapsed, 2),
+                "p99_ms": round(
+                    ok[int(0.99 * (len(ok) - 1))] * 1e3, 1
+                ) if ok else None,
+            }
+
+        base = closed_loop(router1.port, baseline_s)
+        storm = closed_loop(routerN.port, storm_s)
+        churn_stop.set()
+        churn.join(timeout=10.0)
+
+        # post-churn identity at the settled fence, forced refresh:
+        # every replica re-ingests its mirror and must still render the
+        # same bytes
+        ident_churn = identity_check(refresh=True)
+
+        # strict-parse the router's per-replica served counters
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{routerN.port}/metrics", timeout=10.0
+        ) as resp:
+            families = parse_exposition(resp.read().decode())
+        per_replica_requests = {
+            labels[0][1]: value
+            for _, labels, value in
+            families["crane_router_requests_total"]["samples"]
+        }
+
+        scaling = storm["rps"] / max(base["rps"], 1e-9)
+        lag_max = max(lag_samples) if lag_samples else 0
+        expired = {
+            r.name: r.service.stats.expired_at_dispatch for r in replicas
+        }
+
+        assert scaling >= 3.0, \
+            f"scaling gate: {n_replicas} replicas {scaling:.2f}x < 3x " \
+            f"({storm['rps']} vs {base['rps']} rps)"
+        assert lag_max <= lag_budget, \
+            f"lag gate: max sampled lag {lag_max} > budget {lag_budget}"
+        assert all(v == 0 for v in expired.values()), \
+            f"expired requests reached a replica device: {expired}"
+        assert all(
+            per_replica_requests.get(r.name, 0) > 0 for r in replicas
+        ), f"router starved a replica: {per_replica_requests}"
+
+        log(f"config19 [{n_nodes} nodes, {n_replicas} replicas, "
+            f"device {device_sim_ms:.0f} ms]: baseline {base['rps']} rps "
+            f"-> storm {storm['rps']} rps ({scaling:.2f}x), "
+            f"{windows[0]} churn windows, lag max {lag_max}/"
+            f"{lag_budget}, identity {ident_boot}/{ident_churn} B, "
+            f"0 expired at dispatch")
+        emit({"config": 19,
+              "replicas": n_replicas,
+              "router": "hash",
+              "desc": "replicated scoring tier: delta-stream mirror "
+                      "replication, shared-nothing serving replicas "
+                      "(simulated per-replica accelerator dispatch), "
+                      "consistent-hash router; in-run single-replica "
+                      "baseline",
+              "seed": seed,
+              "n_nodes": n_nodes,
+              "device_sim_ms": device_sim_ms,
+              "lag_budget_versions": lag_budget,
+              "baseline": base,
+              "storm": storm,
+              "scaling_x": round(scaling, 2),
+              "churn_windows": windows[0],
+              "churn_patches_per_window": churn_patches,
+              "lag_samples": len(lag_samples),
+              "lag_max_versions": lag_max,
+              "identity_bytes_bootstrap": ident_boot,
+              "identity_bytes_post_churn": ident_churn,
+              "per_replica_requests": per_replica_requests,
+              "expired_at_dispatch": expired,
+              "note": "gates: storm goodput >= 3x in-run single-replica "
+                      "baseline, byte-identical verdicts across "
+                      "replicas at the same version key (bootstrap + "
+                      "post-churn forced refresh), every lag sample <= "
+                      "budget, 0 expired-at-dispatch per replica, "
+                      "every replica served through the router"})
+    finally:
+        for router in routers:
+            router.stop()
+        for r in replicas:
+            r.stop()
+        server.stop()
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--device", choices=["cpu", "default"], default="default")
     parser.add_argument(
-        "--configs", default="1,2,3,4,5,6,7,7b,8,9,10,11,12,13,14,15,16,17,18"
+        "--configs", default="1,2,3,4,5,6,7,7b,8,9,10,11,12,13,14,15,16,17,18,19"
     )
     parser.add_argument("--f64", action="store_true")
     args = parser.parse_args(argv)
@@ -3227,6 +3586,8 @@ def main(argv=None) -> int:
         config17(dtype, rtt)
     if 18 in todo:
         config18(dtype, rtt)
+    if 19 in todo:
+        config19(dtype, rtt)
     return 0
 
 

@@ -75,6 +75,31 @@ def main(argv=None) -> int:
     parser.add_argument("--stale-budget", type=float, default=30.0,
                         help="brownout tier 1: max age of the cached "
                              "pre-rendered response served under pressure")
+    # replicated serving tier (ISSUE 16): delta-stream mirror
+    # replication + shared-nothing replicas + consistent-hash router —
+    # doc/replication.md
+    parser.add_argument("--publish-feed", action="store_true",
+                        help="publish the delta-stream replication feed "
+                             "(GET /v1/replication/feed) from this "
+                             "process's cluster state")
+    parser.add_argument("--replication-window", type=float, default=0.05,
+                        help="seconds per published delta window")
+    parser.add_argument("--replica-feed", default=None, metavar="HOST:PORT",
+                        help="run as a serving replica: mirror the "
+                             "primary's delta feed instead of any local "
+                             "cluster source")
+    parser.add_argument("--replicas", type=int, default=0,
+                        help="one-command replicated topology: run N "
+                             "in-process replicas fed by this primary "
+                             "plus a router on --port")
+    parser.add_argument("--router", choices=["hash", "rr"], default="hash",
+                        help="router replica selection: consistent-hash "
+                             "tenant affinity (hash, default) or "
+                             "round-robin (rr)")
+    parser.add_argument("--lag-budget", type=int, default=8,
+                        help="router catch-up gate: a replica behind the "
+                             "published version by more than this many "
+                             "versions is not routable")
     parser.add_argument("--flight-dir", default=None,
                         help="directory for the crash-safe flight recorder "
                              "(lifecycle records + spans as a bounded JSONL "
@@ -121,6 +146,34 @@ def main(argv=None) -> int:
         else DEFAULT_POLICY
     )
 
+    if args.replica_feed:
+        # replica mode: no local cluster source — the mirror IS the
+        # cluster, fed by the primary's delta stream
+        from ..service import ServingReplica
+
+        feed_host, _, feed_port = args.replica_feed.rpartition(":")
+        replica = ServingReplica(
+            policy,
+            feed=(feed_host or "127.0.0.1", int(feed_port)),
+            port=args.port,
+            workers=args.http_workers,
+            dtype=jnp.float32 if args.f32 else jnp.float64,
+            now_bucket_s=args.now_bucket,
+            idle_timeout_s=args.idle_timeout or None,
+        )
+        replica.start()
+        print(
+            f"serving replica on :{replica.port} "
+            f"(feed {args.replica_feed}; /v1/score /v1/replica/status)",
+            flush=True,
+        )
+        stop = threading.Event()
+        signal.signal(signal.SIGTERM, lambda *a: stop.set())
+        signal.signal(signal.SIGINT, lambda *a: stop.set())
+        stop.wait(timeout=args.run_seconds or None)
+        replica.stop()
+        return 0
+
     if args.master:
         from ..cluster.kube import KubeClusterClient
 
@@ -163,23 +216,77 @@ def main(argv=None) -> int:
             brownout=brownout,
             telemetry=service.telemetry,
         )
+    publisher = None
+    if args.publish_feed or args.replicas > 0:
+        from ..cluster.replication import DeltaPublisher
+
+        publisher = DeltaPublisher(
+            cluster, window_s=args.replication_window,
+            telemetry=service.telemetry,
+        )
+    # primary port: --port unless the router takes it (replica topology)
+    primary_port = 0 if args.replicas > 0 else args.port
     server = ScoringHTTPServer(
-        service, port=args.port, frontend=args.frontend,
+        service, port=primary_port, frontend=args.frontend,
         workers=args.http_workers,
         admission=admission, brownout=brownout,
         idle_timeout_s=args.idle_timeout or None,
+        replication=publisher,
     )
     server.start()
+    if publisher is not None:
+        publisher.start()
+        print(
+            f"delta feed on :{server.port}/v1/replication/feed "
+            f"(window {args.replication_window}s)",
+            flush=True,
+        )
     print(
         f"scoring service on :{server.port} [{server.frontend}] "
         "(/v1/score /v1/assign /metrics /debug/decisions /debug/trace)",
         flush=True,
     )
 
+    replicas = []
+    router = None
+    if args.replicas > 0:
+        from ..service import ReplicaRouter, ServingReplica
+
+        for i in range(args.replicas):
+            replica = ServingReplica(
+                policy,
+                name=f"replica-{i}",
+                feed=("127.0.0.1", server.port),
+                dtype=jnp.float32 if args.f32 else jnp.float64,
+                now_bucket_s=args.now_bucket,
+                idle_timeout_s=args.idle_timeout or None,
+            )
+            replica.start()
+            replicas.append(replica)
+        router = ReplicaRouter(
+            [(r.name, "127.0.0.1", r.port) for r in replicas],
+            primary=("127.0.0.1", server.port),
+            mode=args.router,
+            lag_budget_versions=args.lag_budget,
+            port=args.port,
+        )
+        router.start()
+        print(
+            f"router on :{router.port} [{args.router}] -> "
+            + ", ".join(f"{r.name}@:{r.port}" for r in replicas),
+            flush=True,
+        )
+
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait(timeout=args.run_seconds or None)
+    if router is not None:
+        router.stop()
+    for replica in replicas:
+        replica.stop()
+    if publisher is not None:
+        publisher.stop()
     server.stop()
     return 0
 

@@ -1,4 +1,13 @@
 from .kube import KubeClusterClient
+from .replication import (
+    DeltaDecoder,
+    DeltaPublisher,
+    DeltaStreamClient,
+    FrameError,
+    ReplicaMirror,
+    VersionGapError,
+    encode_frame,
+)
 from .state import (
     Container,
     ResourceRequirements,
@@ -19,5 +28,12 @@ __all__ = [
     "Event",
     "OwnerReference",
     "ClusterState",
+    "DeltaDecoder",
+    "DeltaPublisher",
+    "DeltaStreamClient",
+    "FrameError",
     "KubeClusterClient",
+    "ReplicaMirror",
+    "VersionGapError",
+    "encode_frame",
 ]

@@ -9,6 +9,8 @@ from .overload import (
     TenantQueues,
     TokenBucket,
 )
+from .replica import ServingReplica
+from .router import ReplicaRouter
 
 __all__ = [
     "AdmissionController",
@@ -16,8 +18,10 @@ __all__ = [
     "GradientLimiter",
     "HealthServer",
     "LeaderElector",
+    "ReplicaRouter",
     "ScoringHTTPServer",
     "ScoringService",
+    "ServingReplica",
     "TenantQueues",
     "TokenBucket",
     "deadline",

@@ -102,7 +102,7 @@ class _Conn:
         "sock", "fd", "inbuf", "outbuf", "scan_from", "head_end",
         "body_len", "req_head", "pending", "job_active", "close_after",
         "read_eof", "lock", "registered", "dead", "writes_queued",
-        "last_activity", "queued",
+        "last_activity", "queued", "stream",
     )
 
     def __init__(self, sock):
@@ -124,6 +124,39 @@ class _Conn:
         self.writes_queued = 0  # responses enqueued but not yet drained
         self.last_activity = time.monotonic()  # idle-reaper anchor
         self.queued = False  # parked in an admission tenant queue
+        self.stream = False  # upgraded to a long-lived delta stream
+
+
+class StreamHandle:
+    """A publisher's grip on one claimed stream connection. ``send``
+    rides the server's ordinary write FIFO (any thread may call it) and
+    reports liveness: False once the connection has died, which is the
+    publisher's cue to drop the consumer."""
+
+    __slots__ = ("_server", "_conn")
+
+    def __init__(self, server: "AsyncHTTPServer", conn: _Conn):
+        self._server = server
+        self._conn = conn
+
+    @property
+    def fd(self) -> int:
+        return self._conn.fd
+
+    @property
+    def alive(self) -> bool:
+        return not self._conn.dead
+
+    def send(self, data: bytes) -> bool:
+        conn = self._conn
+        if conn.dead:
+            return False
+        if data:
+            self._server._enqueue_write(conn, data, False)
+        return True
+
+    def close(self) -> None:
+        self._server._enqueue_write(self._conn, b"", True)
 
 
 class AsyncHTTPServer:
@@ -134,11 +167,18 @@ class AsyncHTTPServer:
 
     def __init__(self, handler, host: str = "127.0.0.1", port: int = 0,
                  workers: int = 8, inline_handler=None, admission=None,
-                 idle_timeout_s: float | None = 30.0):
+                 idle_timeout_s: float | None = 30.0, stream_handler=None):
         self._handler = handler
         # fast non-blocking answers on the IO thread (GET /healthz):
         # (method, target, headers) -> (status, ctype, body) | None
         self._inline = inline_handler
+        # long-lived stream claim (replication feed): (method, target,
+        # headers) -> (status, ctype, attach) | None. A claimed
+        # connection gets a headers-only response (no Content-Length —
+        # read-until-close semantics), leaves the request parser for
+        # good, and its writes ride the ordinary write FIFO via a
+        # StreamHandle passed to ``attach``.
+        self._stream = stream_handler
         # overload.AdmissionController (or None = admit everything)
         self._admission = admission
         self._idle_timeout = (
@@ -162,6 +202,7 @@ class AsyncHTTPServer:
         self.connections_accepted = 0
         self.idle_closed = 0  # reaper victims (slowloris defense)
         self.inline_served = 0  # IO-thread answers (no worker hop)
+        self.streams_opened = 0  # connections upgraded to delta streams
 
     @property
     def port(self) -> int:
@@ -258,10 +299,15 @@ class AsyncHTTPServer:
         """Close connections with no forward progress for the idle
         window — the slowloris defense. A connection with an active
         job, parsed-but-unserved requests, or a parked admission slot
-        is the server's debt, not the client's, and is exempt."""
+        is the server's debt, not the client's, and is exempt. So is a
+        stream connection: a replication feed legitimately goes quiet
+        between version windows, and reaping it would force every
+        replica through a resume cycle each idle window."""
         timeout = self._idle_timeout
         for conn in list(self._conns.values()):
-            if conn.dead or now - conn.last_activity <= timeout:
+            if conn.dead or conn.stream:
+                continue
+            if now - conn.last_activity <= timeout:
                 continue
             with conn.lock:
                 busy = conn.job_active or bool(conn.pending) or conn.queued
@@ -304,6 +350,11 @@ class AsyncHTTPServer:
         request tuple carries a ``pre`` slot: a response the IO thread
         already rendered (inline healthz, admission shed) that the
         emitter uses instead of calling the handler."""
+        if conn.stream:
+            # a claimed stream connection is write-only from our side;
+            # anything else the client sends is protocol noise
+            conn.inbuf.clear()
+            return
         batch: list = []
         while True:
             if conn.req_head is None:
@@ -327,6 +378,23 @@ class AsyncHTTPServer:
             conn.req_head = None
             conn.head_end = None
             conn.body_len = 0
+            if self._stream is not None:
+                try:
+                    claimed = self._stream(method, target, headers)
+                except Exception:
+                    claimed = None
+                if claimed is not None:
+                    with conn.lock:
+                        quiet = (not batch and not conn.pending
+                                 and not conn.job_active and not conn.queued)
+                    if not quiet:
+                        # a stream upgrade pipelined behind ordinary
+                        # requests would interleave frames with their
+                        # responses — refuse it deterministically
+                        self._reject(conn, 400)
+                        return
+                    self._begin_stream(conn, *claimed)
+                    return
             batch.append((
                 method, target, headers, body, keep,
                 self._pre_answer(method, target, headers, keep),
@@ -338,6 +406,32 @@ class AsyncHTTPServer:
                 break
         if batch:
             self._dispatch_batch(conn, batch)
+
+    def _begin_stream(self, conn: _Conn, status: int, ctype: str,
+                      attach) -> None:
+        """Upgrade a quiet connection to a long-lived stream: send a
+        headers-only response (no Content-Length — the client reads
+        until close), mark the connection so the parser and the idle
+        reaper leave it alone, and hand the publisher its handle. Read
+        interest stays on so a client disconnect is noticed promptly
+        (recv EOF → close → the handle's next send returns False)."""
+        conn.stream = True
+        conn.inbuf.clear()
+        conn.scan_from = 0
+        head = (
+            f"HTTP/1.1 {status} {_REASONS.get(status, 'OK')}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            "Cache-Control: no-store\r\n"
+            "Connection: close\r\n"
+            "\r\n"
+        ).encode("latin-1")
+        self._enqueue_write(conn, head, False)
+        self.streams_opened += 1
+        if attach is not None:
+            try:
+                attach(StreamHandle(self, conn))
+            except Exception:
+                self._enqueue_write(conn, b"", True)
 
     def _pre_answer(self, method, target, headers, keep) -> bytes | None:
         """IO-thread fast path for one parsed request: an inline answer

@@ -55,6 +55,7 @@ _OPENMETRICS = "application/openmetrics-text; version=1.0.0; charset=utf-8"
 _ENDPOINTS = (
     "/healthz", "/metrics", "/debug/decisions", "/debug/lifecycle",
     "/debug/trace", "/v1/score", "/v1/assign", "/v1/refresh",
+    "/v1/replica/status", "/v1/replication/status",
 )
 
 
@@ -64,8 +65,13 @@ class ServiceRouter:
     ``headers`` keys are lower-cased."""
 
     def __init__(self, service: ScoringService, health=None,
-                 admission=None, brownout=None):
+                 admission=None, brownout=None, replica=None,
+                 replication=None):
         self.service = service
+        # ISSUE 16: a ServingReplica (status surface for router health /
+        # lag gating) and/or a DeltaPublisher (primary-side feed status)
+        self.replica = replica
+        self.replication = replication
         # HealthRegistry (ISSUE 8): /healthz serves its aggregated
         # snapshot — overall worst-of state plus per-component reasons —
         # instead of an unconditional "ok"
@@ -155,15 +161,22 @@ class ServiceRouter:
 
     def handle_inline(self, method, target, headers):
         """The async front end's IO-thread fast path: answer what must
-        never wait on a worker slot. Only ``GET /healthz`` — the whole
-        point is a green probe while the pool is saturated or wedged.
-        Returns None for everything else (normal worker path)."""
+        never wait on a worker slot. ``GET /healthz`` — the whole point
+        is a green probe while the pool is saturated or wedged — plus
+        the replica/replication status surfaces (ISSUE 16): the router's
+        health/lag gating must keep seeing a replica's lag WHILE that
+        replica's workers are saturated, or a storm would read as an
+        outage. Returns None for everything else (normal worker path)."""
         path, _, _ = target.partition("?")
-        if method == "GET" and path == "/healthz":
+        if method == "GET" and path in (
+            "/healthz", "/v1/replica/status", "/v1/replication/status",
+        ):
             try:
-                return self._route_get("/healthz", headers)
+                answered = self._route_get(path, headers)
             except Exception:
                 return None
+            if answered is not None and answered[0] != 404:
+                return answered
         return None
 
     @staticmethod
@@ -250,6 +263,14 @@ class ServiceRouter:
             return self._json(200, lc.snapshot(limit=limit))
         if path == "/debug/trace":
             return self._json(200, service.telemetry.export_chrome_trace())
+        if path == "/v1/replica/status":
+            if self.replica is None:
+                return self._json(404, {"error": "not a replica"})
+            return self._json(200, self.replica.status())
+        if path == "/v1/replication/status":
+            if self.replication is None:
+                return self._json(404, {"error": "no publisher"})
+            return self._json(200, self.replication.status())
         return self._json(404, {"error": "not found"})
 
     def _route_post(self, target, body):
@@ -353,6 +374,8 @@ class ScoringHTTPServer:
         admission=None,
         brownout=None,
         idle_timeout_s: float | None = 30.0,
+        replica=None,
+        replication=None,
     ):
         if frontend is None:
             frontend = os.environ.get("CRANE_SERVICE_FRONTEND", "async")
@@ -363,7 +386,13 @@ class ScoringHTTPServer:
             # the serve-stale brownout path lives in the service
             service.brownout = brownout
         self.router = ServiceRouter(
-            service, health=health, admission=admission, brownout=brownout
+            service, health=health, admission=admission, brownout=brownout,
+            replica=replica, replication=replication,
+        )
+        # primary-side delta feed (ISSUE 16): GET /v1/replication/feed
+        # upgrades to a long-lived stream on the async front end
+        stream_handler = (
+            replication.stream_handler if replication is not None else None
         )
         self.httpd = None  # the threaded front end's stdlib server
         self._async = None
@@ -383,6 +412,7 @@ class ScoringHTTPServer:
                 inline_handler=self.router.handle_inline,
                 admission=admission,
                 idle_timeout_s=idle_timeout_s,
+                stream_handler=stream_handler,
             )
 
     @property

@@ -139,10 +139,17 @@ class _ResponseCache:
     ``latest()`` is the brownout escape hatch (ISSUE 13): the newest
     rendered body regardless of key, as long as it is younger than the
     caller's relaxed staleness budget — under overload a slightly stale
-    answer beats a shed one."""
+    answer beats a shed one.
 
-    def __init__(self, capacity: int = 16):
+    The staleness clock is INJECTED (``mono_clock``, default
+    ``time.monotonic``): the brownout budget is an elapsed-time bound,
+    and an NTP step on the wall clock must not be able to serve an
+    over-stale body or prematurely expire a fresh one (ISSUE 16
+    satellite). Tests inject a fake monotonic clock to prove it."""
+
+    def __init__(self, capacity: int = 16, mono_clock=time.monotonic):
         self._capacity = capacity
+        self._mono = mono_clock
         self._lock = threading.Lock()
         self._entries: dict = {}
         self._latest: tuple[bytes, float] | None = None  # (body, mono_at)
@@ -160,18 +167,18 @@ class _ResponseCache:
         with self._lock:
             self._entries.pop(key, None)
             self._entries[key] = body
-            self._latest = (body, time.monotonic())
+            self._latest = (body, self._mono())
             while len(self._entries) > self._capacity:
                 self._entries.pop(next(iter(self._entries)))
 
     def latest(self, max_age_s: float) -> bytes | None:
         """The most recently rendered body if it is at most
-        ``max_age_s`` old (monotonic clock), else None."""
+        ``max_age_s`` old (the injected monotonic clock), else None."""
         with self._lock:
             if self._latest is None:
                 return None
             body, at = self._latest
-        if time.monotonic() - at > max_age_s:
+        if self._mono() - at > max_age_s:
             return None
         return body
 
@@ -232,6 +239,8 @@ class ScoringService:
         now_bucket_s: float = 0.25,
         device_breaker=None,
         degraded=None,
+        mono_clock=time.monotonic,
+        version_source=None,
     ):
         import jax.numpy as jnp
 
@@ -269,7 +278,14 @@ class ScoringService:
         self.now_bucket_s = now_bucket_s
         self._score_flight = _SingleFlight()
         self._refresh_flight = _SingleFlight()
-        self._resp_cache = _ResponseCache()
+        self._resp_cache = _ResponseCache(mono_clock=mono_clock)
+        # replica mode (ISSUE 16): when set, ``version_source()`` is the
+        # mirror's applied version fence and responses render
+        # DETERMINISTICALLY — version-stamped, sorted keys, no local
+        # wall-clock staleness — so two replicas at the same
+        # (applied_version, store.version, now) produce byte-identical
+        # bodies regardless of when each one refreshed.
+        self._version_source = version_source
         # cluster node_version the store last ingested (None = never):
         # the single-flight refresh's version gate
         self._refreshed_cluster_version = None
@@ -550,6 +566,8 @@ class ScoringService:
         if refresh:
             self.refresh_coalesced()
         now_val = self._resolve_now(now)
+        if self._version_source is not None:
+            return self._score_response_replica(now_val)
         key = (self.store.version, self.stats.last_refresh_at, now_val)
         body = self._resp_cache.get(key)
         if body is not None:
@@ -581,6 +599,47 @@ class ScoringService:
                     ),
                     rendered,
                 )
+            return rendered
+
+        body, leader = self._score_flight.run(key, compute)
+        if not leader:
+            with self._stats_lock:
+                self.stats.coalesced_scores += 1
+            self._m_coalesced.labels(kind="score").inc()
+        return body
+
+    def _score_response_replica(self, now_val: float) -> bytes:
+        """Replica-mode render: a pure function of (content at the
+        applied version fence, ``now``). The key swaps the local
+        wall-clock ``last_refresh_at`` for the mirror's applied version;
+        the body stamps that version, sorts every key (snapshot-booted
+        and delta-fed mirrors ingest rows in different orders), and
+        drops wall-clock staleness — so any two replicas at the same
+        version key return byte-identical verdicts."""
+        applied = self._version_source()
+        key = (applied, self.store.version, now_val)
+        body = self._resp_cache.get(key)
+        if body is not None:
+            with self._stats_lock:
+                self.stats.response_cache_hits += 1
+            self._m_resp_cache_hits.inc()
+            return body
+        _deadline.check("dispatch")
+
+        def compute() -> bytes:
+            verdicts = self.score_batch(now=now_val)
+            rendered = json.dumps(
+                {
+                    "backend": verdicts.backend,
+                    "version": applied,
+                    "schedulable": verdicts.schedulable,
+                    "scores": verdicts.scores,
+                },
+                sort_keys=True,
+                separators=(",", ":"),
+            ).encode()
+            if verdicts.backend == "tpu":
+                self._resp_cache.put(key, rendered)
             return rendered
 
         body, leader = self._score_flight.run(key, compute)

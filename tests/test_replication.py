@@ -1,0 +1,547 @@
+"""Replicated scoring tier (ISSUE 16): delta-stream mirror replication,
+shared-nothing serving replicas, and the consistent-hash router.
+
+The contract under test: a delta frame applies to a mirror whole or
+not at all (torn tails stay buffered, corruption poisons the stream,
+never the mirror); a version gap is detected and healed by a cursor
+resume (ring replay or snapshot — the mirror is always AT a published
+version); a restarted replica catches up from its cursor; two replicas
+at the same applied version render BYTE-IDENTICAL verdicts under a
+concurrent storm; the router only routes to healthy, caught-up
+replicas, forwards the REMAINING deadline budget, and ejects a dead
+replica without losing goodput; the idle reaper exempts quiet feed
+streams; and the brownout response-cache staleness budget rides the
+injected monotonic clock, immune to wall-clock steps.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from crane_scheduler_tpu.cluster import ClusterState, Node
+from crane_scheduler_tpu.cluster.replication import (
+    DeltaDecoder,
+    DeltaPublisher,
+    FrameError,
+    ReplicaMirror,
+    VersionGapError,
+    encode_frame,
+)
+from crane_scheduler_tpu.policy import DEFAULT_POLICY
+from crane_scheduler_tpu.service import ReplicaRouter, ServingReplica
+from crane_scheduler_tpu.service.frontend import AsyncHTTPServer
+from crane_scheduler_tpu.service.scoring import _ResponseCache
+from crane_scheduler_tpu.sim import SimConfig, Simulator
+
+
+def _cluster(n=4, prefix="n"):
+    c = ClusterState()
+    for i in range(n):
+        c.add_node(Node(name=f"{prefix}{i}", annotations={"cpu": f"0.{i}"}))
+    return c
+
+
+def _collector():
+    frames = []
+
+    def send(data: bytes) -> bool:
+        frames.append(data)
+        return True
+
+    return frames, send
+
+
+def _decode_all(blobs):
+    dec = DeltaDecoder()
+    out = []
+    for b in blobs:
+        out.extend(dec.feed(b))
+    return out
+
+
+# -- frame codec ---------------------------------------------------------
+
+
+class TestFrameCodec:
+    def test_roundtrip(self):
+        payload = {"from": 3, "v": 7, "nodes": {"a": {"x": "1"}, "b": None}}
+        frames = DeltaDecoder().feed(encode_frame(payload))
+        assert frames == [payload]
+
+    def test_torn_tail_mid_delta_buffers_until_complete(self):
+        blob = encode_frame({"from": 0, "v": 1, "nodes": {"a": {"k": "v"}}})
+        dec = DeltaDecoder()
+        # drip the frame in kernel-torn pieces: nothing yields until the
+        # final byte lands, then the WHOLE frame yields — a torn tail
+        # can never half-apply
+        assert dec.feed(blob[:10]) == []
+        assert dec.pending_bytes == 10
+        assert dec.feed(blob[10 : len(blob) - 1]) == []
+        frames = dec.feed(blob[len(blob) - 1 :])
+        assert len(frames) == 1
+        assert frames[0]["v"] == 1
+        assert dec.pending_bytes == 0
+
+    def test_two_frames_plus_torn_third(self):
+        f1 = encode_frame({"from": 0, "v": 1, "nodes": {}})
+        f2 = encode_frame({"from": 1, "v": 2, "nodes": {}})
+        f3 = encode_frame({"from": 2, "v": 3, "nodes": {}})
+        dec = DeltaDecoder()
+        frames = dec.feed(f1 + f2 + f3[:7])
+        assert [f["v"] for f in frames] == [1, 2]
+        assert dec.feed(f3[7:]) == [{"from": 2, "v": 3, "nodes": {}}]
+
+    def test_crc_corruption_raises(self):
+        blob = bytearray(encode_frame({"from": 0, "v": 1, "nodes": {}}))
+        blob[-1] ^= 0xFF
+        with pytest.raises(FrameError):
+            DeltaDecoder().feed(bytes(blob))
+
+    def test_bad_magic_raises(self):
+        with pytest.raises(FrameError):
+            DeltaDecoder().feed(b"XXXX" + b"\x00" * 20)
+
+    def test_deterministic_encoding(self):
+        a = encode_frame({"v": 1, "from": 0, "nodes": {"b": None, "a": None}})
+        b = encode_frame({"from": 0, "nodes": {"a": None, "b": None}, "v": 1})
+        assert a == b
+
+
+# -- publisher / mirror --------------------------------------------------
+
+
+class TestPublisherMirror:
+    def test_window_ships_only_changes(self):
+        cluster = _cluster(3)
+        pub = DeltaPublisher(cluster)
+        frames, send = _collector()
+        pub.publish_window()
+        pub.subscribe(send, pub.published_version)
+        cluster.patch_node_annotation("n1", "cpu", "0.9")
+        assert pub.publish_window() == 1
+        (frame,) = _decode_all(frames)
+        assert set(frame["nodes"]) == {"n1"}
+        assert frame["nodes"]["n1"]["cpu"] == "0.9"
+
+    def test_delete_ships_null(self):
+        cluster = _cluster(3)
+        pub = DeltaPublisher(cluster)
+        pub.publish_window()
+        frames, send = _collector()
+        pub.subscribe(send, pub.published_version)
+        cluster.delete_node("n2")
+        pub.publish_window()
+        (frame,) = _decode_all(frames)
+        assert frame["nodes"] == {"n2": None}
+
+    def test_quiet_window_ships_nothing(self):
+        cluster = _cluster(2)
+        pub = DeltaPublisher(cluster)
+        pub.publish_window()
+        frames, send = _collector()
+        pub.subscribe(send, pub.published_version)
+        assert pub.publish_window() == 0
+        assert frames == []
+
+    def test_mirror_tracks_primary_through_churn(self):
+        cluster = _cluster(4)
+        pub = DeltaPublisher(cluster)
+        mirror = ReplicaMirror()
+        frames, send = _collector()
+        pub.publish_window()
+        pub.subscribe(send, -1)  # fresh consumer: snapshot
+        for frame in _decode_all(frames):
+            mirror.apply_frame(frame)
+        frames.clear()
+        for round_ in range(5):
+            cluster.patch_node_annotation(f"n{round_ % 4}",
+                                          "cpu", f"1.{round_}")
+            if round_ == 2:
+                cluster.add_node(Node(name="late", annotations={"cpu": "9"}))
+            pub.publish_window()
+        for frame in _decode_all(frames):
+            mirror.apply_frame(frame)
+        assert mirror.applied_version == pub.published_version
+        want = {n.name: dict(n.annotations) for n in cluster.list_nodes()}
+        got = {n.name: dict(n.annotations)
+               for n in mirror.cluster.list_nodes()}
+        assert got == want
+
+    def test_version_gap_detected_then_cursor_resume(self):
+        cluster = _cluster(3)
+        pub = DeltaPublisher(cluster)
+        mirror = ReplicaMirror()
+        frames, send = _collector()
+        pub.publish_window()
+        pub.subscribe(send, -1)
+        for frame in _decode_all(frames):
+            mirror.apply_frame(frame)
+        pub.unsubscribe(send)
+        cursor = mirror.applied_version
+        # two windows pass while the consumer is detached
+        cluster.patch_node_annotation("n0", "cpu", "0.8")
+        pub.publish_window()
+        cluster.patch_node_annotation("n1", "cpu", "0.7")
+        pub.publish_window()
+        # applying the LATEST frame alone is a gap — must not tear
+        latest = _decode_all([pub._ring[-1][2]])[0]
+        with pytest.raises(VersionGapError):
+            mirror.apply_frame(latest)
+        assert mirror.applied_version == cursor  # untouched
+        assert mirror.stats["gaps"] == 1
+        # cursor resume: re-subscribe from the fence → ring replay
+        frames2, send2 = _collector()
+        pub.subscribe(send2, cursor)
+        for frame in _decode_all(frames2):
+            mirror.apply_frame(frame)
+        assert mirror.applied_version == pub.published_version
+        # the resume was pure ring replay — never a snapshot (the ring
+        # still covers genesis, so even the initial attach was deltas)
+        assert mirror.stats["snapshots"] == 0
+
+    def test_restart_catchup_out_of_ring_gets_snapshot(self):
+        cluster = _cluster(3)
+        pub = DeltaPublisher(cluster, ring_frames=2)
+        pub.publish_window()
+        for i in range(6):  # push the early windows out of the ring
+            cluster.patch_node_annotation("n0", "cpu", f"0.{i}")
+            pub.publish_window()
+        mirror = ReplicaMirror()  # "restarted" replica, cursor -1
+        frames, send = _collector()
+        pub.subscribe(send, -1)
+        decoded = _decode_all(frames)
+        assert decoded[0].get("snap") is True
+        for frame in decoded:
+            mirror.apply_frame(frame)
+        assert mirror.applied_version == pub.published_version
+        want = {n.name: dict(n.annotations) for n in cluster.list_nodes()}
+        got = {n.name: dict(n.annotations)
+               for n in mirror.cluster.list_nodes()}
+        assert got == want
+        assert pub.stats["snapshots_sent"] == 1
+
+    def test_restart_catchup_in_ring_replays_deltas(self):
+        cluster = _cluster(3)
+        pub = DeltaPublisher(cluster, ring_frames=64)
+        pub.publish_window()
+        mirror = ReplicaMirror()
+        frames, send = _collector()
+        pub.subscribe(send, -1)
+        for frame in _decode_all(frames):
+            mirror.apply_frame(frame)
+        pub.unsubscribe(send)
+        cursor = mirror.applied_version
+        cluster.patch_node_annotation("n2", "cpu", "0.5")
+        pub.publish_window()
+        frames2, send2 = _collector()
+        pub.subscribe(send2, cursor)
+        decoded = _decode_all(frames2)
+        assert decoded and all(not f.get("snap") for f in decoded)
+        for frame in decoded:
+            mirror.apply_frame(frame)
+        assert mirror.applied_version == pub.published_version
+
+    def test_dead_consumer_dropped_on_publish(self):
+        cluster = _cluster(2)
+        pub = DeltaPublisher(cluster)
+        pub.publish_window()
+        pub.subscribe(lambda data: False, pub.published_version)
+        assert pub.consumer_count == 1
+        cluster.patch_node_annotation("n0", "cpu", "0.3")
+        pub.publish_window()
+        assert pub.consumer_count == 0
+
+
+# -- response-cache monotonic clock (satellite bugfix) --------------------
+
+
+class TestResponseCacheClock:
+    def test_latest_uses_injected_monotonic_clock(self):
+        t = [100.0]
+        cache = _ResponseCache(mono_clock=lambda: t[0])
+        cache.put(("k",), b"body")
+        assert cache.latest(10.0) == b"body"
+        t[0] = 109.0
+        assert cache.latest(10.0) == b"body"
+        t[0] = 111.0
+        assert cache.latest(10.0) is None
+
+    def test_wall_clock_steps_cannot_expire_or_revive(self, monkeypatch):
+        # an NTP step moves time.time and (hypothetically) monotonic-
+        # derived wall readings; the injected clock is the ONLY input
+        t = [0.0]
+        cache = _ResponseCache(mono_clock=lambda: t[0])
+        cache.put(("k",), b"fresh")
+        monkeypatch.setattr(time, "time", lambda: 1e9)  # huge NTP jump
+        monkeypatch.setattr(time, "monotonic", lambda: 1e9)
+        assert cache.latest(5.0) == b"fresh"  # injected clock says age 0
+        t[0] = 6.0
+        assert cache.latest(5.0) is None  # and only it can expire
+
+
+# -- wire: replicas, router, storms --------------------------------------
+
+
+@pytest.fixture(scope="module")
+def topology():
+    """Primary (16-node sim + publisher) + 2 wire-fed replicas."""
+    from crane_scheduler_tpu.service import ScoringHTTPServer, ScoringService
+
+    sim = Simulator(SimConfig(n_nodes=16, seed=11))
+    sim.sync_metrics()
+    svc = ScoringService(sim.cluster, DEFAULT_POLICY)
+    svc.refresh()
+    pub = DeltaPublisher(sim.cluster, window_s=0.02)
+    server = ScoringHTTPServer(svc, port=0, frontend="async",
+                               replication=pub)
+    server.start()
+    pub.publish_window()
+    replicas = [
+        ServingReplica(
+            DEFAULT_POLICY, name=f"replica-{i}",
+            feed=("127.0.0.1", server.port), workers=2,
+        )
+        for i in range(2)
+    ]
+    for r in replicas:
+        r.start()
+    for r in replicas:
+        assert r.wait_caught_up(pub.published_version, timeout_s=30)
+    yield sim, pub, server, replicas
+    for r in replicas:
+        r.stop()
+    pub.stop()
+    server.stop()
+
+
+def _post_score(port, now, tenant=None, deadline_ms=None, timeout=30):
+    body = json.dumps({"now": now, "refresh": True}).encode()
+    headers = {"content-type": "application/json"}
+    if tenant:
+        headers["crane-tenant"] = tenant
+    if deadline_ms is not None:
+        headers["crane-deadline-ms"] = str(deadline_ms)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/score", data=body, headers=headers
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+class TestReplicaWire:
+    def test_feed_client_catches_up_and_reports_status(self, topology):
+        sim, pub, server, replicas = topology
+        sim.clock.advance(1.0)
+        sim.sync_metrics()
+        pub.publish_window()
+        for r in replicas:
+            assert r.wait_caught_up(pub.published_version, timeout_s=30)
+            s = r.status()
+            assert s["appliedVersion"] == pub.published_version
+            assert s["feedConnected"] is True
+            assert s["gaps"] == 0
+
+    def test_byte_identity_at_same_version_key(self, topology):
+        sim, pub, server, replicas = topology
+        pub.publish_window()
+        for r in replicas:
+            assert r.wait_caught_up(pub.published_version, timeout_s=30)
+        now = 12345.0
+        bodies = [_post_score(r.port, now)[1] for r in replicas]
+        assert bodies[0] == bodies[1]
+        rendered = json.loads(bodies[0])
+        assert rendered["backend"] == "tpu"
+        assert rendered["version"] == pub.published_version
+        assert "stalenessSeconds" not in rendered  # wall clock excluded
+
+    def test_concurrent_storm_byte_identity_through_router(self, topology):
+        """Two replicas + router under a concurrent storm: every
+        response carrying the same version key is byte-identical, no
+        matter which replica served it."""
+        sim, pub, server, replicas = topology
+        pub.publish_window()
+        for r in replicas:
+            assert r.wait_caught_up(pub.published_version, timeout_s=30)
+        router = ReplicaRouter(
+            [(r.name, "127.0.0.1", r.port) for r in replicas],
+            primary=("127.0.0.1", server.port), mode="hash", port=0,
+            probe_interval_s=0.05,
+        )
+        router.start()
+        try:
+            now = 777.0
+            results: list[bytes] = []
+            errors: list[Exception] = []
+            lock = threading.Lock()
+
+            def storm(tenant):
+                try:
+                    for _ in range(5):
+                        _, body = _post_score(router.port, now,
+                                              tenant=tenant)
+                        with lock:
+                            results.append(body)
+                except Exception as exc:  # pragma: no cover
+                    with lock:
+                        errors.append(exc)
+
+            threads = [
+                threading.Thread(target=storm, args=(f"tenant-{i}",))
+                for i in range(6)
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=60)
+            assert not errors
+            assert len(results) == 30
+            by_version: dict = {}
+            for body in results:
+                v = json.loads(body)["version"]
+                by_version.setdefault(v, set()).add(body)
+            for v, distinct in by_version.items():
+                assert len(distinct) == 1, f"version {v} rendered 2 ways"
+            assert router.stats["requests"] == 30
+        finally:
+            router.stop()
+
+    def test_router_forwards_remaining_deadline(self, topology):
+        sim, pub, server, replicas = topology
+        router = ReplicaRouter(
+            [(r.name, "127.0.0.1", r.port) for r in replicas],
+            primary=("127.0.0.1", server.port), port=0,
+            probe_interval_s=0.05,
+        )
+        router.start()
+        try:
+            # an expired budget dies AT the router (no replica hop)
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                _post_score(router.port, 5.0, deadline_ms=0)
+            assert exc_info.value.code == 504
+            assert router.stats["requests"] == 0
+            # a healthy budget reaches a replica and serves
+            status, _ = _post_score(router.port, 5.0, deadline_ms=30000)
+            assert status == 200
+        finally:
+            router.stop()
+
+    def test_router_ejects_dead_replica_and_goodput_continues(self, topology):
+        sim, pub, server, replicas = topology
+        # one real replica + one port that answers nothing
+        dead_sock = socket.socket()
+        dead_sock.bind(("127.0.0.1", 0))
+        dead_sock.listen(1)
+        dead_port = dead_sock.getsockname()[1]
+        dead_sock.close()  # now it refuses connections
+        router = ReplicaRouter(
+            [("replica-0", "127.0.0.1", replicas[0].port),
+             ("ghost", "127.0.0.1", dead_port)],
+            primary=("127.0.0.1", server.port), mode="rr", port=0,
+            probe_interval_s=0.05,
+        )
+        router.start()
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                st = {r["name"]: r for r in router.status()["replicas"]}
+                if not st["ghost"]["routable"] and \
+                        st["replica-0"]["routable"]:
+                    break
+                time.sleep(0.02)
+            st = {r["name"]: r for r in router.status()["replicas"]}
+            assert st["ghost"]["routable"] is False
+            assert st["replica-0"]["routable"] is True
+            for i in range(4):  # rr would alternate; all must serve
+                status, _ = _post_score(router.port, 99.0 + i)
+                assert status == 200
+        finally:
+            router.stop()
+
+    def test_lag_gated_replica_not_routable(self, topology):
+        """Catch-up gating: a replica pinned behind the published
+        version beyond the lag budget is ejected until it catches up."""
+        sim, pub, server, replicas = topology
+        laggard = ServingReplica(DEFAULT_POLICY, name="laggard",
+                                 feed=None, workers=1)
+        laggard.server.start()
+        try:
+            # mirror pinned at version 0 while the primary is far ahead
+            laggard.mirror.apply_frame(
+                {"snap": True, "from": -1, "v": 0,
+                 "nodes": {"n0": {"cpu": "0.1"}}}
+            )
+            router = ReplicaRouter(
+                [("replica-0", "127.0.0.1", replicas[0].port),
+                 ("laggard", "127.0.0.1", laggard.port)],
+                primary=("127.0.0.1", server.port),
+                lag_budget_versions=4, port=0, probe_interval_s=0.05,
+            )
+            router.probe_once()
+            st = {r["name"]: r for r in router.status()["replicas"]}
+            assert st["laggard"]["healthy"] is True
+            assert st["laggard"]["routable"] is False
+            assert st["laggard"]["lagVersions"] > 4
+            assert st["replica-0"]["routable"] is True
+        finally:
+            laggard.server.stop()
+
+
+# -- idle reaper exemption (satellite bugfix) ----------------------------
+
+
+class TestStreamIdleExemption:
+    def test_quiet_feed_stream_outlives_idle_window(self):
+        """Regression stub: a replication-feed connection that goes
+        quiet between version windows must NOT be reaped, while a
+        plain idle connection on the same server still is."""
+        attached = []
+
+        def stream_handler(method, target, headers):
+            if target.startswith("/v1/replication/feed"):
+                return 200, "application/x-crane-delta-stream", \
+                    attached.append  # attach = keep the handle
+            return None
+
+        server = AsyncHTTPServer(
+            lambda *a: (200, "application/json", b"{}"),
+            idle_timeout_s=0.2, stream_handler=stream_handler,
+        )
+        server.start()
+        try:
+            feed = socket.create_connection(("127.0.0.1", server.port),
+                                            timeout=5)
+            feed.sendall(b"GET /v1/replication/feed?from=-1 HTTP/1.1\r\n"
+                         b"Host: x\r\n\r\n")
+            feed.settimeout(5)
+            head = b""
+            while b"\r\n\r\n" not in head:
+                head += feed.recv(4096)
+            assert b"200" in head.split(b"\r\n", 1)[0]
+            assert len(attached) == 1
+            idle = socket.create_connection(("127.0.0.1", server.port),
+                                            timeout=5)
+            idle.settimeout(5)
+            # several idle windows pass: the plain connection is
+            # reaped (EOF), the quiet stream stays open
+            deadline = time.monotonic() + 5
+            reaped = False
+            while time.monotonic() < deadline and not reaped:
+                try:
+                    reaped = idle.recv(1024) == b""
+                except socket.timeout:
+                    break
+            assert reaped, "plain idle connection was never reaped"
+            assert server.idle_closed >= 1
+            # the stream handle still delivers after the idle windows
+            assert attached[0].alive
+            assert attached[0].send(b"PING")
+            got = feed.recv(4096)
+            assert got == b"PING"
+            feed.close()
+        finally:
+            server.stop()

@@ -1,0 +1,258 @@
+"""Replicated-tier smoke gate (``make replica-smoke``): boot a primary
+publishing the delta-stream feed, two shared-nothing serving replicas
+fed over the real wire, and the consistent-hash router in front; then
+assert the replication contract end to end:
+
+- both replicas catch up to the published version fence and stay
+  caught up under annotation churn (lag <= the router's budget);
+- the primary's slowloris reaper does NOT reap the (quiet) replication
+  feed connections — the replicas stay feed-connected across idle
+  windows shorter than the reaper's timeout;
+- two replicas at the same version key render byte-identical verdicts;
+- killing one replica mid-storm ejects it at the router and goodput
+  continues on the survivor (zero client-visible 5xx after the
+  ejection settles);
+- ``crane_replica_lag_versions``, ``crane_replica_deltas_applied_total``
+  (replica /metrics) and ``crane_router_requests_total{replica=...}``
+  (router /metrics) strict-parse under the exposition parser.
+
+Exit 0 = every check passed; any violation prints the failure and
+exits nonzero.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    from crane_scheduler_tpu.cluster.replication import DeltaPublisher
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+    from crane_scheduler_tpu.service import (
+        ReplicaRouter,
+        ScoringHTTPServer,
+        ScoringService,
+        ServingReplica,
+    )
+    from crane_scheduler_tpu.sim import SimConfig, Simulator
+    from crane_scheduler_tpu.telemetry.expfmt import (
+        ExpositionError,
+        parse_exposition,
+    )
+
+    failures = 0
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        nonlocal failures
+        mark = "ok" if ok else "FAIL"
+        print(f"[replica-smoke] {name}: {mark}"
+              f"{' — ' + detail if detail else ''}")
+        if not ok:
+            failures += 1
+
+    lag_budget = 16
+
+    sim = Simulator(SimConfig(n_nodes=32, seed=5))
+    sim.sync_metrics()
+    svc = ScoringService(sim.cluster, DEFAULT_POLICY)
+    svc.refresh()
+    pub = DeltaPublisher(sim.cluster, window_s=0.05, telemetry=svc.telemetry)
+    # idle timeout shorter than the run: a reaped feed would show up as
+    # a disconnect below — the stream exemption is what this exercises
+    server = ScoringHTTPServer(
+        svc, port=0, frontend="async", replication=pub, idle_timeout_s=1.0
+    )
+    server.start()
+    pub.start()
+
+    replicas = [
+        ServingReplica(
+            DEFAULT_POLICY, name=f"replica-{i}",
+            feed=("127.0.0.1", server.port),
+        )
+        for i in range(2)
+    ]
+    router = None
+    try:
+        for r in replicas:
+            r.start()
+        deadline = time.time() + 10.0
+        while (pub.published_version < sim.cluster.node_version
+               and time.time() < deadline):
+            time.sleep(0.02)
+        caught = all(
+            r.wait_caught_up(pub.published_version, timeout_s=10.0)
+            for r in replicas
+        )
+        check("replicas catch up to published fence",
+              caught and pub.published_version >= 0,
+              f"v{pub.published_version}")
+
+        router = ReplicaRouter(
+            [(r.name, "127.0.0.1", r.port) for r in replicas],
+            primary=("127.0.0.1", server.port),
+            lag_budget_versions=lag_budget, port=0,
+        )
+        router.start()
+        check("router boots with both replicas routable",
+              len([b for b in router.status()["replicas"] if b["routable"]])
+              == 2)
+
+        def post(port, now, tenant="smoke"):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/score",
+                data=json.dumps({"now": now, "refresh": True}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json",
+                         "crane-tenant": tenant,
+                         "crane-deadline-ms": "10000"},
+            )
+            with urllib.request.urlopen(req, timeout=10.0) as resp:
+                return resp.status, resp.read()
+
+        # byte identity at the same version key, directly per replica
+        now_same = sim.clock.now() + 7.0
+        _, body_a = post(replicas[0].port, now_same)
+        _, body_b = post(replicas[1].port, now_same)
+        check("byte-identical verdicts at the same version key",
+              body_a == body_b and len(body_a) > 2,
+              f"{len(body_a)} B")
+
+        # annotation churn: the feed must carry deltas, not just the
+        # bootstrap snapshot/replay
+        names = [n.name for n in sim.cluster.list_nodes()]
+        for j, name in enumerate(names[:8]):
+            sim.cluster.patch_node_annotation(
+                name, "crane.io/smoke-churn", str(j)
+            )
+        deadline = time.time() + 10.0
+        while (pub.published_version < sim.cluster.node_version
+               and time.time() < deadline):
+            time.sleep(0.02)
+        caught = all(
+            r.wait_caught_up(pub.published_version, timeout_s=10.0)
+            for r in replicas
+        )
+        lags = [max(0, pub.published_version - r.applied_version)
+                for r in replicas]
+        check("churn deltas applied within the lag budget",
+              caught and max(lags) <= lag_budget,
+              f"lags {lags} vs budget {lag_budget}")
+
+        # idle window longer than the primary's 1 s reaper timeout: the
+        # feed connections are exempt and must survive it
+        time.sleep(1.6)
+        check("feed connections survive the idle reaper",
+              all(r.status()["feedConnected"] for r in replicas))
+
+        # storm through the router; kill replica-1 mid-storm
+        stop_at = time.time() + 3.0
+        kill_at = time.time() + 1.0
+        results = []
+        res_lock = threading.Lock()
+        counter = [0]
+
+        def client(tenant):
+            while time.time() < stop_at:
+                with res_lock:
+                    counter[0] += 1
+                    now = now_same + counter[0] * 1e-3
+                try:
+                    status, _ = post(router.port, now, tenant=tenant)
+                except urllib.error.HTTPError as e:
+                    e.read()
+                    status = e.code
+                except OSError:
+                    status = -1
+                with res_lock:
+                    results.append((time.time(), status))
+
+        threads = [
+            threading.Thread(target=client, args=(f"tenant-{i}",))
+            for i in range(6)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(max(0.0, kill_at - time.time()))
+        replicas[1].stop()
+        killed_at = time.time()
+        for t in threads:
+            t.join()
+
+        after = [s for ts, s in results if ts > killed_at + 0.5]
+        check("goodput continues after killing a replica mid-storm",
+              len(after) >= 3 and all(s == 200 for s in after),
+              f"{len(after)} post-kill requests, "
+              f"statuses {sorted(set(after))}")
+        st = router.status()
+        dead = next(b for b in st["replicas"] if b["name"] == "replica-1")
+        check("router ejected the killed replica",
+              not dead["routable"] and st["stats"].get("ejections", 0) >= 1,
+              f"ejections {st['stats'].get('ejections')}")
+        check("router total served matches client view",
+              st["stats"].get("requests", 0) >= len(results) - len(after))
+
+        # strict-parse the metric families named in the runbooks
+        def fetch_families(port):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/metrics",
+                headers={"Accept": "text/plain; version=0.0.4"},
+            )
+            with urllib.request.urlopen(req, timeout=10.0) as resp:
+                return parse_exposition(resp.read().decode())
+
+        try:
+            fam = fetch_families(replicas[0].port)
+            lag_ok = "crane_replica_lag_versions" in fam
+            applied = sum(
+                v for _, _, v in
+                fam["crane_replica_deltas_applied_total"]["samples"]
+            )
+            check("replica families strict-parse",
+                  lag_ok and applied >= 1,
+                  f"deltas_applied {applied:.0f}")
+        except (ExpositionError, KeyError) as e:
+            check("replica families strict-parse", False, repr(e))
+        try:
+            fam = fetch_families(router.port)
+            served = {
+                labels[0][1]: v
+                for _, labels, v in
+                fam["crane_router_requests_total"]["samples"]
+            }
+            check("router families strict-parse",
+                  sum(served.values()) >= 1 and served.get("replica-0", 0) >= 1,
+                  f"requests {served}")
+        except (ExpositionError, KeyError) as e:
+            check("router families strict-parse", False, repr(e))
+    finally:
+        if router is not None:
+            router.stop()
+        for r in replicas:
+            try:
+                r.stop()
+            except Exception:
+                pass  # replica-1 was already killed mid-storm
+        pub.stop()
+        server.stop()
+
+    print(f"[replica-smoke] {'PASS' if failures == 0 else 'FAIL'} "
+          f"({failures} failures)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
