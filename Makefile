@@ -10,7 +10,7 @@ IMAGE_SCHEDULER := $(REGISTRY)/crane-scheduler-tpu:$(GIT_VERSION)
 
 .PHONY: all native test test-fast bench sim e2e metrics-smoke \
 	desched-smoke chaos-smoke recovery-smoke trace-smoke drip-smoke \
-	shard-smoke overload-smoke replica-smoke dashboards \
+	shard-smoke overload-smoke replica-smoke fleet-smoke dashboards \
 	clean images image-annotator image-scheduler push-images
 
 all: native test
@@ -87,6 +87,14 @@ overload-smoke:
 replica-smoke:
 	$(PYTHON) tools/replica_smoke.py
 
+# the fleet observability plane: primary + 2 replicas + router + a
+# scheduler-role health sidecar federated on /fleet/metrics — strict
+# parse with role labels, a forced counter reset merged without a
+# negative rate, and crane-top --snapshot returning the full table —
+# see doc/observability.md "Fleet plane"
+fleet-smoke:
+	$(PYTHON) tools/fleet_smoke.py
+
 # one pod traced end to end over a live stub apiserver (traceparent on
 # the bind POST, lifecycle record in the flight ring), then replayed
 # through crane_trace.py explain/slo
@@ -97,6 +105,7 @@ trace-smoke:
 # family list (deterministic; CI diffs it against the committed JSON)
 dashboards:
 	$(PYTHON) tools/gen_dashboard.py --out deploy/dashboards/placement-slo.json
+	$(PYTHON) tools/gen_dashboard.py --fleet --out deploy/dashboards/fleet-slo.json
 
 # -- images (one parameterized Dockerfile per binary, like the
 # reference's ARG PKGNAME build; ref: Makefile images target) ----------

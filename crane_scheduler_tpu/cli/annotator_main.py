@@ -85,6 +85,10 @@ def main(argv=None) -> int:
     # resilience spine (ISSUE 8): per-fault-domain breakers feeding one
     # health registry; /healthz serves its aggregated snapshot
     tel = active_telemetry()
+    if tel is not None:
+        from ..telemetry.fleet import register_build_info
+
+        register_build_info(tel.registry, "annotator")
     health_reg = HealthRegistry(telemetry=tel)
     prom_breaker = CircuitBreaker("prometheus", telemetry=tel)
     health_reg.watch_breaker(prom_breaker)

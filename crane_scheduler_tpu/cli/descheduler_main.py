@@ -111,6 +111,9 @@ def main(argv=None) -> int:
         else DEFAULT_POLICY
     )
     telemetry = telemetry_mod.enable()
+    from ..telemetry.fleet import register_build_info
+
+    register_build_info(telemetry.registry, "descheduler")
     health_reg = HealthRegistry(telemetry=telemetry)
 
     if args.master:

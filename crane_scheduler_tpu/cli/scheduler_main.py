@@ -317,6 +317,10 @@ def main(argv=None) -> int:
             from .. import telemetry as _tel
 
             telemetry = _tel.active()
+        if telemetry is not None:
+            from ..telemetry.fleet import register_build_info
+
+            register_build_info(telemetry.registry, "scheduler")
 
         journal = None
         recovery = None

@@ -100,6 +100,18 @@ def main(argv=None) -> int:
                         help="router catch-up gate: a replica behind the "
                              "published version by more than this many "
                              "versions is not routable")
+    # fleet observability plane (ISSUE 17): federate every process's
+    # /metrics under role/process labels on /fleet/metrics, burn-rate
+    # SLO alerting on /v1/slo — doc/observability.md
+    parser.add_argument("--fleet-scrape", default=None,
+                        metavar="[ROLE@]HOST:PORT[/PATH],...",
+                        help="extra fleet scrape targets to federate "
+                             "(e.g. scheduler@127.0.0.1:8090); the "
+                             "--replicas/--router topology is federated "
+                             "automatically")
+    parser.add_argument("--fleet-interval", type=float, default=1.0,
+                        help="federation scrape/SLO-tick interval in "
+                             "seconds")
     parser.add_argument("--flight-dir", default=None,
                         help="directory for the crash-safe flight recorder "
                              "(lifecycle records + spans as a bounded JSONL "
@@ -146,6 +158,8 @@ def main(argv=None) -> int:
         else DEFAULT_POLICY
     )
 
+    from ..telemetry.fleet import register_build_info
+
     if args.replica_feed:
         # replica mode: no local cluster source — the mirror IS the
         # cluster, fed by the primary's delta stream
@@ -161,6 +175,7 @@ def main(argv=None) -> int:
             now_bucket_s=args.now_bucket,
             idle_timeout_s=args.idle_timeout or None,
         )
+        register_build_info(replica.telemetry.registry, "replica")
         replica.start()
         print(
             f"serving replica on :{replica.port} "
@@ -198,6 +213,7 @@ def main(argv=None) -> int:
         cluster, policy, dtype=jnp.float32 if args.f32 else jnp.float64,
         now_bucket_s=args.now_bucket,
     )
+    register_build_info(service.telemetry.registry, "scorer")
     service.refresh()
     admission = brownout = None
     if args.admission_limit > 0:
@@ -224,6 +240,22 @@ def main(argv=None) -> int:
             cluster, window_s=args.replication_window,
             telemetry=service.telemetry,
         )
+    # fleet plane (ISSUE 17): federate the local registry plus the
+    # replica/router topology below plus any explicit --fleet-scrape
+    # targets; /fleet/metrics and /v1/slo serve from this primary
+    fleet = None
+    if args.fleet_scrape or args.replicas > 0:
+        from ..telemetry.fleet import FleetPlane, parse_scrape_flag
+
+        fleet = FleetPlane(
+            parse_scrape_flag(args.fleet_scrape)
+            if args.fleet_scrape else (),
+            registry=service.telemetry.registry,
+            local_registry=service.telemetry.registry,
+            local_role="scorer",
+            local_name="primary",
+            interval_s=args.fleet_interval,
+        )
     # primary port: --port unless the router takes it (replica topology)
     primary_port = 0 if args.replicas > 0 else args.port
     server = ScoringHTTPServer(
@@ -232,6 +264,7 @@ def main(argv=None) -> int:
         admission=admission, brownout=brownout,
         idle_timeout_s=args.idle_timeout or None,
         replication=publisher,
+        fleet=fleet,
     )
     server.start()
     if publisher is not None:
@@ -261,6 +294,9 @@ def main(argv=None) -> int:
                 now_bucket_s=args.now_bucket,
                 idle_timeout_s=args.idle_timeout or None,
             )
+            register_build_info(
+                replica.telemetry.registry, "replica", set_role=False
+            )
             replica.start()
             replicas.append(replica)
         router = ReplicaRouter(
@@ -270,6 +306,9 @@ def main(argv=None) -> int:
             lag_budget_versions=args.lag_budget,
             port=args.port,
         )
+        register_build_info(
+            router.telemetry.registry, "router", set_role=False
+        )
         router.start()
         print(
             f"router on :{router.port} [{args.router}] -> "
@@ -277,10 +316,32 @@ def main(argv=None) -> int:
             flush=True,
         )
 
+    if fleet is not None:
+        from ..telemetry.fleet import ScrapeTarget
+
+        for r in replicas:
+            fleet.federator.add_target(ScrapeTarget(
+                name=r.name, port=r.port, role="replica",
+            ))
+        if router is not None:
+            fleet.federator.add_target(ScrapeTarget(
+                name="router", port=router.port, role="router",
+            ))
+        fleet.start()
+        print(
+            f"fleet plane: federating "
+            f"{len(fleet.federator.targets)} targets every "
+            f"{args.fleet_interval:g}s "
+            "(/fleet/metrics /v1/slo on the primary)",
+            flush=True,
+        )
+
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait(timeout=args.run_seconds or None)
+    if fleet is not None:
+        fleet.stop()
     if router is not None:
         router.stop()
     for replica in replicas:

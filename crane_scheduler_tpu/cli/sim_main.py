@@ -58,6 +58,14 @@ def main(argv=None) -> int:
     sim = Simulator(SimConfig(n_nodes=args.nodes, seed=args.seed), policy=policy)
     sim.sync_metrics()
 
+    from .. import telemetry as telemetry_mod
+
+    tel = telemetry_mod.active()
+    if tel is not None:
+        from ..telemetry.fleet import register_build_info
+
+        register_build_info(tel.registry, "sim")
+
     dtype = jnp.float32 if args.f32 else jnp.float64
     latencies = []
 

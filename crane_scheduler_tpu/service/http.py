@@ -45,6 +45,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..telemetry import fleet as fleet_mod
 from ..telemetry import tracing
 from . import deadline as _deadline
 from .deadline import DeadlineExpiredError
@@ -56,6 +57,7 @@ _ENDPOINTS = (
     "/healthz", "/metrics", "/debug/decisions", "/debug/lifecycle",
     "/debug/trace", "/v1/score", "/v1/assign", "/v1/refresh",
     "/v1/replica/status", "/v1/replication/status",
+    "/fleet/metrics", "/v1/slo",
 )
 
 
@@ -66,12 +68,15 @@ class ServiceRouter:
 
     def __init__(self, service: ScoringService, health=None,
                  admission=None, brownout=None, replica=None,
-                 replication=None):
+                 replication=None, fleet=None):
         self.service = service
         # ISSUE 16: a ServingReplica (status surface for router health /
         # lag gating) and/or a DeltaPublisher (primary-side feed status)
         self.replica = replica
         self.replication = replication
+        # ISSUE 17: a FleetPlane — /fleet/metrics re-exposes the
+        # federated union, /v1/slo the burn-rate/anomaly verdict
+        self.fleet = fleet
         # HealthRegistry (ISSUE 8): /healthz serves its aggregated
         # snapshot — overall worst-of state plus per-component reasons —
         # instead of an unconditional "ok"
@@ -240,6 +245,18 @@ class ServiceRouter:
                     service.render_prometheus().encode(),
                 )
             return self._json(200, service.metrics())
+        if path == "/fleet/metrics":
+            if self.fleet is None:
+                return self._json(404, {"error": "no fleet plane"})
+            return (
+                200,
+                "text/plain; version=0.0.4; charset=utf-8",
+                self.fleet.render_metrics().encode(),
+            )
+        if path == "/v1/slo":
+            if self.fleet is None:
+                return self._json(404, {"error": "no fleet plane"})
+            return self._json(200, self.fleet.slo_status())
         if path == "/debug/decisions":
             ok, limit = self._parse_limit(query)
             if not ok:
@@ -257,12 +274,21 @@ class ServiceRouter:
                 return self._json(
                     400, {"error": "n must be a non-negative integer"}
                 )
+            # role in the envelope (ISSUE 17): lifecycle dumps from N
+            # fleet processes must stay distinguishable after the fact
+            role = fleet_mod.process_role()
             lc = getattr(service.telemetry, "lifecycle", None)
             if lc is None:
-                return self._json(200, {"stats": {}, "records": []})
-            return self._json(200, lc.snapshot(limit=limit))
+                return self._json(
+                    200, {"role": role, "stats": {}, "records": []}
+                )
+            doc = dict(lc.snapshot(limit=limit))
+            doc["role"] = role
+            return self._json(200, doc)
         if path == "/debug/trace":
-            return self._json(200, service.telemetry.export_chrome_trace())
+            doc = dict(service.telemetry.export_chrome_trace())
+            doc["role"] = fleet_mod.process_role()
+            return self._json(200, doc)
         if path == "/v1/replica/status":
             if self.replica is None:
                 return self._json(404, {"error": "not a replica"})
@@ -376,6 +402,7 @@ class ScoringHTTPServer:
         idle_timeout_s: float | None = 30.0,
         replica=None,
         replication=None,
+        fleet=None,
     ):
         if frontend is None:
             frontend = os.environ.get("CRANE_SERVICE_FRONTEND", "async")
@@ -387,7 +414,7 @@ class ScoringHTTPServer:
             service.brownout = brownout
         self.router = ServiceRouter(
             service, health=health, admission=admission, brownout=brownout,
-            replica=replica, replication=replication,
+            replica=replica, replication=replication, fleet=fleet,
         )
         # primary-side delta feed (ISSUE 16): GET /v1/replication/feed
         # upgrades to a long-lived stream on the async front end

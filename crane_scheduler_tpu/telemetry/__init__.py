@@ -36,10 +36,28 @@ from .registry import (
     MetricsRegistry,
     log_buckets,
 )
+from .fleet import (
+    FleetAnomalies,
+    FleetPlane,
+    MetricsFederator,
+    ScrapeTarget,
+    SLOEngine,
+    process_role,
+    register_build_info,
+    set_process_role,
+)
 from .spans import SpanRecorder
 
 __all__ = [
     "Telemetry",
+    "FleetPlane",
+    "MetricsFederator",
+    "SLOEngine",
+    "FleetAnomalies",
+    "ScrapeTarget",
+    "register_build_info",
+    "set_process_role",
+    "process_role",
     "MetricsRegistry",
     "SpanRecorder",
     "DecisionTraceBuffer",

@@ -1,0 +1,656 @@
+"""Fleet observability plane (ISSUE 17): the metrics federator's
+merge/reset/quarantine semantics, the SLO burn-rate engine's alert
+state machines under an injected clock, the anomaly detectors'
+determinism, the crane-top snapshot table, and the ``/fleet/metrics`` /
+``/v1/slo`` / role-stamped debug surfaces on the service router.
+
+Everything here is socket-free where possible: scrape targets use the
+``fetch`` callable override (a registry's own ``render``), and every
+time-dependent assertion goes through ``tick(now)`` with a synthetic
+clock, so the alert sequences are exact, not racy.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+from crane_scheduler_tpu.policy import DEFAULT_POLICY
+from crane_scheduler_tpu.sim import SimConfig, Simulator
+from crane_scheduler_tpu.telemetry import MetricsRegistry, Telemetry
+from crane_scheduler_tpu.telemetry.expfmt import parse_exposition
+from crane_scheduler_tpu.telemetry.fleet import (
+    DwellDetector,
+    FlapDetector,
+    FleetAnomalies,
+    FleetPlane,
+    MetricsFederator,
+    ScrapeTarget,
+    SLOEngine,
+    SLOObjective,
+    TrendDetector,
+    parse_scrape_flag,
+    register_build_info,
+)
+
+_TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+def _load_crane_top():
+    spec = importlib.util.spec_from_file_location(
+        "crane_top", os.path.join(_TOOLS, "crane_top.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _target(name, registry, role=None):
+    return ScrapeTarget(name=name, role=role, fetch=registry.render)
+
+
+# -- federator: merge ---------------------------------------------------------
+
+
+def test_federator_merges_fleet_under_role_process_labels():
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    for reg, n in ((r1, 3), (r2, 5)):
+        c = reg.counter("t_served_total", "served", ("endpoint",))
+        c.labels(endpoint="/v1/score").inc(n)
+    fed = MetricsFederator([
+        _target("primary", r1, role="scorer"),
+        _target("replica-0", r2, role="replica"),
+    ])
+    summary = fed.scrape_once()
+    assert summary["ok"] == ["primary", "replica-0"]
+    assert summary["failed"] == {}
+    assert fed.availability() == (2, 2)
+
+    # the union strict-parses and carries both meta labels on top of
+    # the original label set
+    families = parse_exposition(fed.render())
+    samples = families["t_served_total"]["samples"]
+    labelsets = [dict(labels) for _, labels, _ in samples]
+    assert all(ls["endpoint"] == "/v1/score" for ls in labelsets)
+    assert {ls["role"] for ls in labelsets} == {"scorer", "replica"}
+    assert {ls["process"] for ls in labelsets} == {"primary", "replica-0"}
+    assert fed.counter_total("t_served_total") == 8
+    assert fed.counter_total("t_served_total", process="primary") == 3
+
+
+def test_federator_learns_role_from_build_info():
+    reg = MetricsRegistry()
+    register_build_info(reg, "scheduler", set_role=False)
+    reg.counter("t_binds_total", "binds").inc(2)
+    fed = MetricsFederator([_target("sched-1", reg, role=None)])
+    fed.scrape_once()
+    families = parse_exposition(fed.render())
+    roles = {
+        dict(labels)["role"]
+        for _, labels, _ in families["t_binds_total"]["samples"]
+    }
+    assert roles == {"scheduler"}
+    # crane_build_info itself is federated too (version label intact)
+    info = families["crane_build_info"]["samples"]
+    assert any(dict(l).get("version") for _, l, _ in info)
+
+
+def test_federator_counter_reset_stays_monotone():
+    text = ["# TYPE t_req_total counter\nt_req_total 10\n"]
+    fed = MetricsFederator([
+        ScrapeTarget(name="replica-0", fetch=lambda: text[0])
+    ])
+    fed.scrape_once()
+    assert fed.counter_total("t_req_total") == 10
+    # the process restarts: the raw counter drops to 3 — the adjusted
+    # series folds the pre-reset total into an offset instead of
+    # producing a negative rate
+    text[0] = "# TYPE t_req_total counter\nt_req_total 3\n"
+    fed.scrape_once()
+    assert fed.counter_total("t_req_total") == 13
+    assert fed.reset_count() == 1
+    text[0] = "# TYPE t_req_total counter\nt_req_total 4\n"
+    fed.scrape_once()
+    assert fed.counter_total("t_req_total") == 14
+    assert fed.reset_count() == 1
+    assert parse_exposition(fed.render())  # still strictly valid
+
+
+def test_federator_type_conflict_quarantines_never_silent():
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    r1.gauge("t_mode", "mode").set(1)
+    r2.counter("t_mode", "mode??").inc()
+    host = MetricsRegistry()
+    fed = MetricsFederator(
+        [_target("a", r1, role="x"), _target("b", r2, role="y")],
+        registry=host,
+    )
+    summary = fed.scrape_once()
+    assert summary["quarantined"] == ["t_mode"]
+    assert "type conflict" in fed.quarantined["t_mode"]
+    # the family vanishes from the union but is counted, not dropped
+    # silently: the host registry's quarantine gauge reports it
+    assert "t_mode" not in parse_exposition(fed.render())
+    text = host.render()
+    assert "crane_fleet_quarantined_families 1" in text
+    assert fed.status()["quarantined"] == dict(fed.quarantined)
+
+
+def test_federator_failed_scrape_keeps_stale_samples():
+    state = {"up": True}
+    reg = MetricsRegistry()
+    reg.counter("t_req_total", "req").inc(7)
+
+    def fetch():
+        if not state["up"]:
+            raise ConnectionRefusedError("down")
+        return reg.render()
+
+    fed = MetricsFederator([ScrapeTarget(name="replica-0", fetch=fetch)])
+    fed.scrape_once()
+    assert fed.availability() == (1, 1)
+    state["up"] = False
+    summary = fed.scrape_once()
+    assert summary["failed"] == {
+        "replica-0": "scrape: ConnectionRefusedError"
+    }
+    assert fed.availability() == (0, 1)
+    # stale beats absent for cumulative series: the last-known value
+    # keeps serving while the target is reported down
+    assert fed.counter_total("t_req_total") == 7
+
+
+def test_federator_invalid_payload_counts_as_failed():
+    fed = MetricsFederator([
+        ScrapeTarget(name="bad", fetch=lambda: "no type decl 1\n")
+    ])
+    summary = fed.scrape_once()
+    assert list(summary["failed"]) == ["bad"]
+    assert summary["failed"]["bad"].startswith("parse:")
+
+
+def test_federator_histogram_bucketwise_merge_and_render():
+    regs = []
+    for observations in ((0.004, 0.2), (0.9, 3.0)):
+        reg = MetricsRegistry()
+        h = reg.histogram(
+            "t_lat_seconds", "latency",
+            buckets=(0.01, 0.5, 1.0),
+        )
+        for v in observations:
+            h.observe(v)
+        regs.append(reg)
+    fed = MetricsFederator([
+        _target(f"p{i}", reg, role="replica")
+        for i, reg in enumerate(regs)
+    ])
+    fed.scrape_once()
+    # per-process series survive with their own labels...
+    families = parse_exposition(fed.render())
+    assert families["t_lat_seconds"]["type"] == "histogram"
+    # ...and the fleet-level aggregate merges bucket-wise
+    buckets, total_sum, count = fed.histogram_agg("t_lat_seconds")
+    assert count == 4
+    by_le = dict(buckets)
+    assert by_le[0.01] == 1
+    assert by_le[0.5] == 2
+    assert by_le[float("inf")] == 4
+    assert total_sum == pytest.approx(0.004 + 0.2 + 0.9 + 3.0)
+
+
+def test_federator_drops_vanished_series_for_a_process():
+    text = [
+        "# TYPE t_lag gauge\n"
+        't_lag{replica="a"} 1\nt_lag{replica="b"} 2\n'
+    ]
+    fed = MetricsFederator([
+        ScrapeTarget(name="router", fetch=lambda: text[0])
+    ])
+    fed.scrape_once()
+    assert len(fed.gauge_values("t_lag")) == 2
+    text[0] = "# TYPE t_lag gauge\n" 't_lag{replica="a"} 1\n'
+    fed.scrape_once()
+    # the ejected replica's series must not linger in the union
+    assert len(fed.gauge_values("t_lag")) == 1
+
+
+# -- SLO engine ---------------------------------------------------------------
+
+
+def _engine(sample, **obj_kwargs):
+    fed = MetricsFederator([])
+    obj = SLOObjective("t_obj", sample, **obj_kwargs)
+    return SLOEngine(
+        fed, [obj],
+        fast_windows=(5.0, 15.0), slow_windows=(30.0, 60.0),
+    )
+
+
+def test_slo_burn_rates_and_alert_round_trip():
+    events = {"good": 0.0, "bad": 0.0}
+    eng = _engine(
+        lambda: (events["good"], events["bad"]),
+        objective=0.99, warn_burn=1.0, page_burn=10.0,
+        clear_ticks=3, clear_ratio=0.5,
+    )
+    now = 1000.0
+    for _ in range(16):  # saturate every window with good events
+        now += 1.0
+        events["good"] += 4
+        eng.tick(now)
+    assert eng.alert_state("t_obj") == "ok"
+
+    # first 100% bad tick: the 5s window burns past warn_burn but the
+    # 15s window still dilutes below page_burn -> warning, not page
+    now += 1.0
+    events["bad"] += 4
+    eng.tick(now)
+    assert eng.alert_state("t_obj") == "warning"
+    # keep burning: once both fast windows clear page_burn it escalates
+    for _ in range(16):
+        now += 1.0
+        events["bad"] += 4
+        eng.tick(now)
+    assert eng.alert_state("t_obj") == "page"
+
+    # heal: good events only; hysteresis steps DOWN one level per
+    # clear_ticks quiet ticks, never straight to ok
+    states = []
+    for _ in range(40):
+        now += 1.0
+        events["good"] += 4
+        eng.tick(now)
+        states.append(eng.alert_state("t_obj"))
+        if states[-1] == "ok":
+            break
+    assert states[-1] == "ok"
+    assert "warning" in states[:states.index("ok")]
+    assert eng.timeline() == [
+        ("t_obj", "ok", "warning"),
+        ("t_obj", "warning", "page"),
+        ("t_obj", "page", "warning"),
+        ("t_obj", "warning", "ok"),
+    ]
+
+
+def test_slo_partial_window_blip_does_not_page():
+    events = {"good": 0.0, "bad": 0.0}
+    eng = _engine(
+        lambda: (events["good"], events["bad"]),
+        objective=0.99, warn_burn=1.0, page_burn=10.0,
+    )
+    now = 1000.0
+    for _ in range(16):
+        now += 1.0
+        events["good"] += 4
+        eng.tick(now)
+    # one bad tick: the short fast window heats but the longer one
+    # dilutes below page_burn — multi-window alerting absorbs blips
+    now += 1.0
+    events["bad"] += 4
+    status = eng.tick(now)
+    assert eng.alert_state("t_obj") != "page"
+    burns = status["objectives"]["t_obj"]["burnRates"]
+    assert burns["5s"] > burns["15s"] > 0
+
+
+def test_slo_status_exports_gauges_and_budget():
+    events = {"good": 100.0, "bad": 0.0}
+    fed = MetricsFederator([])
+    host = MetricsRegistry()
+    eng = SLOEngine(
+        fed,
+        [SLOObjective("t_obj", lambda: (events["good"], events["bad"]))],
+        registry=host,
+        fast_windows=(5.0, 15.0), slow_windows=(30.0, 60.0),
+    )
+    now = 1000.0
+    for _ in range(3):
+        now += 1.0
+        events["good"] += 10
+        eng.tick(now)
+    status = eng.status()
+    obj = status["objectives"]["t_obj"]
+    assert obj["state"] == "ok"
+    assert obj["budgetRemaining"] == pytest.approx(1.0)
+    assert status["fastWindows"] == ["5s", "15s"]
+    text = host.render()
+    assert 'crane_slo_alert_state{objective="t_obj"} 0' in text
+    assert 'crane_slo_burn_rate{objective="t_obj",window="5s"} 0' in text
+    assert parse_exposition(text)
+
+
+def test_slo_scrape_availability_kill_and_heal():
+    reg = MetricsRegistry()
+    reg.counter("t_req_total", "req").inc()
+    state = {"up": True}
+
+    def fetch():
+        if not state["up"]:
+            raise OSError("down")
+        return reg.render()
+
+    fed = MetricsFederator([
+        _target("primary", MetricsRegistry(), role="scorer"),
+        ScrapeTarget(name="replica-0", fetch=fetch),
+    ])
+    eng = SLOEngine(fed, fast_windows=(5.0, 15.0), slow_windows=(30.0, 60.0))
+    now = 1000.0
+
+    def tick():
+        nonlocal now
+        now += 1.0
+        fed.scrape_once()
+        eng.tick(now)
+
+    for _ in range(16):
+        tick()
+    assert eng.alert_state("scrape_availability") == "ok"
+    state["up"] = False
+    flipped_at = None
+    for i in range(6):
+        tick()
+        if eng.alert_state("scrape_availability") != "ok":
+            flipped_at = i + 1
+            break
+    assert flipped_at is not None and flipped_at <= 5
+    state["up"] = True
+    for _ in range(40):
+        tick()
+        if eng.alert_state("scrape_availability") == "ok":
+            break
+    assert eng.alert_state("scrape_availability") == "ok"
+    assert ("scrape_availability", "ok", "warning") in eng.timeline()
+
+
+def test_slo_history_is_bounded_by_the_slow_horizon():
+    events = {"good": 0.0}
+    eng = _engine(lambda: (events["good"], 0.0))
+    now = 1000.0
+    for _ in range(500):
+        now += 1.0
+        events["good"] += 1
+        eng.tick(now)
+    hist = eng._states["t_obj"].history
+    # one pre-horizon anchor plus the 60s slow window
+    assert len(hist) <= 62
+
+
+# -- anomaly detectors --------------------------------------------------------
+
+
+def test_flap_detector_counts_transitions_in_window():
+    det = FlapDetector(window_s=10.0, max_flaps=3)
+    now, cum = 0.0, 0.0
+    for _ in range(5):
+        now += 1.0
+        det.update(now, cum)
+    assert not det.anomalous
+    # 4 transitions inside 10s -> flapping
+    for _ in range(4):
+        now += 1.0
+        cum += 1.0
+        det.update(now, cum)
+    assert det.anomalous
+    # quiet period: the window drains and the detector clears
+    for _ in range(15):
+        now += 1.0
+        det.update(now, cum)
+    assert not det.anomalous
+
+
+def test_dwell_detector_requires_consecutive_raise():
+    det = DwellDetector(max_dwell_s=5.0)
+    assert not det.update(0.0, True)
+    assert not det.update(4.0, True)
+    assert det.update(6.0, True)
+    assert det.dwell_s == 6.0
+    # a single clear tick resets the accumulator entirely
+    assert not det.update(7.0, False)
+    assert not det.update(12.0, True)
+
+
+def test_trend_detector_fires_on_sustained_slope_only():
+    det = TrendDetector(alpha=0.5, slope_per_s=1.0, min_ticks=3)
+    fired = [det.update(float(t), 0.0) for t in range(5)]
+    assert not any(fired)
+    # lag growing 5 versions/s: slope EWMA crosses 1.0 and stays there
+    value, now = 0.0, 5.0
+    fired = []
+    for _ in range(6):
+        now += 1.0
+        value += 5.0
+        fired.append(det.update(now, value))
+    assert fired[-1]
+    # plateau: slope decays, the streak breaks
+    for _ in range(8):
+        now += 1.0
+        det.update(now, value)
+    assert not det.anomalous
+
+
+def test_fleet_anomalies_from_federated_families():
+    text = [
+        "# TYPE crane_breaker_transitions_total counter\n"
+        "crane_breaker_transitions_total 0\n"
+        "# TYPE crane_degraded_mode gauge\ncrane_degraded_mode 0\n"
+        "# TYPE crane_replica_lag_versions gauge\n"
+        "crane_replica_lag_versions 0\n"
+    ]
+    fed = MetricsFederator([
+        ScrapeTarget(name="scorer", fetch=lambda: text[0])
+    ])
+    host = MetricsRegistry()
+    anom = FleetAnomalies(
+        fed, registry=host,
+        breaker_window_s=10.0, breaker_max_flaps=3,
+        degraded_max_dwell_s=5.0, lag_slope_per_s=1.0, lag_min_ticks=2,
+    )
+    now = 0.0
+
+    def tick(transitions, degraded, lag):
+        nonlocal now
+        now += 1.0
+        text[0] = (
+            "# TYPE crane_breaker_transitions_total counter\n"
+            f"crane_breaker_transitions_total {transitions}\n"
+            "# TYPE crane_degraded_mode gauge\n"
+            f"crane_degraded_mode {degraded}\n"
+            "# TYPE crane_replica_lag_versions gauge\n"
+            f"crane_replica_lag_versions {lag}\n"
+        )
+        fed.scrape_once()
+        return anom.tick(now)
+
+    status = tick(0, 0, 0)
+    assert not any(status[k]["firing"] for k in FleetAnomalies.KINDS)
+    # breaker flapping: 5 transitions in 5 ticks inside the 10s window
+    for t in range(1, 6):
+        status = tick(t, 0, 0)
+    assert status["breaker_flapping"]["firing"]
+    assert 'crane_fleet_anomaly{kind="breaker_flapping"} 1' in host.render()
+    # degraded dwell: raised for > 5 consecutive seconds
+    for _ in range(7):
+        status = tick(5, 1, 0)
+    assert status["degraded_dwell"]["firing"]
+    # replication lag trend: lag growing 10 versions/tick
+    lag = 0
+    for _ in range(5):
+        lag += 10
+        status = tick(5, 1, lag)
+    assert status["replication_lag_trend"]["firing"]
+    # the breaker window drained during the quiet ticks: the flap
+    # detector cleared while the other two kept firing
+    text_out = host.render()
+    assert 'crane_fleet_anomaly{kind="breaker_flapping"} 0' in text_out
+    assert 'crane_fleet_anomaly{kind="degraded_dwell"} 1' in text_out
+
+
+# -- the plane + HTTP surfaces ------------------------------------------------
+
+
+def _make_service():
+    from crane_scheduler_tpu.service import ScoringService
+
+    sim = Simulator(SimConfig(n_nodes=4, seed=0))
+    sim.sync_metrics()
+    svc = ScoringService(sim.cluster, DEFAULT_POLICY)
+    svc.refresh()
+    return svc
+
+
+def test_service_router_serves_fleet_metrics_and_slo():
+    from crane_scheduler_tpu.service.http import ServiceRouter
+
+    svc = _make_service()
+    register_build_info(svc.telemetry.registry, "scorer", set_role=False)
+    plane = FleetPlane(
+        registry=svc.telemetry.registry,
+        local_registry=svc.telemetry.registry,
+        local_role="scorer", local_name="primary",
+        slo_kwargs={"fast_windows": (5.0, 15.0),
+                    "slow_windows": (30.0, 60.0)},
+    )
+    router = ServiceRouter(svc, fleet=plane)
+    plane.tick(now=1000.0)
+
+    status, ctype, body = router.handle("GET", "/fleet/metrics", {}, b"")
+    assert status == 200
+    assert ctype.startswith("text/plain")
+    families = parse_exposition(body.decode())
+    roles = {
+        dict(labels).get("role")
+        for doc in families.values()
+        for _, labels, _ in doc["samples"]
+        if dict(labels).get("role")
+    }
+    assert roles == {"scorer"}
+
+    status, ctype, body = router.handle("GET", "/v1/slo", {}, b"")
+    assert status == 200
+    doc = json.loads(body)
+    assert set(doc) == {"role", "slo", "anomalies", "federation"}
+    assert "scrape_availability" in doc["slo"]["objectives"]
+    assert doc["federation"]["targets"][0]["name"] == "primary"
+
+
+def test_service_router_fleet_endpoints_404_without_plane():
+    from crane_scheduler_tpu.service.http import ServiceRouter
+
+    router = ServiceRouter(_make_service())
+    for path in ("/fleet/metrics", "/v1/slo"):
+        status, _, body = router.handle("GET", path, {}, b"")
+        assert status == 404
+        assert json.loads(body)["error"] == "no fleet plane"
+
+
+def test_debug_envelopes_carry_the_process_role():
+    from crane_scheduler_tpu.service.http import ServiceRouter
+    from crane_scheduler_tpu.telemetry import fleet as fleet_mod
+
+    old = fleet_mod.process_role()
+    fleet_mod.set_process_role("scorer")
+    try:
+        router = ServiceRouter(_make_service())
+        for path in ("/debug/lifecycle", "/debug/trace"):
+            status, _, body = router.handle("GET", path, {}, b"")
+            assert status == 200
+            assert json.loads(body)["role"] == "scorer"
+    finally:
+        fleet_mod.set_process_role(old)
+
+
+def test_parse_scrape_flag_topology():
+    targets = parse_scrape_flag(
+        "scheduler@127.0.0.1:8090,10.0.0.2:9100/custom, ,replica@:7000"
+    )
+    assert [(t.name, t.host, t.port, t.path, t.role) for t in targets] == [
+        ("scheduler-0", "127.0.0.1", 8090, "/metrics", "scheduler"),
+        ("target-1", "10.0.0.2", 9100, "/custom", None),
+        ("replica-3", "127.0.0.1", 7000, "/metrics", "replica"),
+    ]
+
+
+# -- crane-top ----------------------------------------------------------------
+
+
+def test_crane_top_rows_and_snapshot_from_union():
+    crane_top = _load_crane_top()
+    reg = MetricsRegistry()
+    register_build_info(reg, "replica", set_role=False)
+    h = reg.histogram(
+        "crane_service_request_seconds", "req",
+        labelnames=("endpoint",), buckets=(0.01, 0.1, 1.0),
+    )
+    for v in (0.005, 0.05, 0.05, 0.5):
+        h.labels(endpoint="/v1/score").observe(v)
+    reg.gauge("crane_service_inflight", "inflight").set(2)
+    reg.gauge("crane_service_brownout_tier", "tier").set(1)
+    reg.gauge(
+        "crane_breaker_state", "state", ("target",)
+    ).labels(target="prometheus").set(2)
+    reg.gauge("crane_replica_lag_versions", "lag").set(12)
+
+    fed = MetricsFederator([_target("replica-0", reg, role=None)])
+    fed.scrape_once()
+    families = parse_exposition(fed.render())
+    rows = crane_top.build_rows(families, lag_budget=8)
+    assert len(rows) == 1
+    row = rows[0]
+    assert (row["process"], row["role"]) == ("replica-0", "replica")
+    assert row["requests"] == 4
+    assert 100.0 <= row["p99_ms"] <= 1000.0
+    assert row["inflight"] == 2
+    assert row["brownout_tier"] == 1
+    assert row["breakers"] == {"prometheus": "open"}
+    assert row["lag_versions"] == 12
+    assert row["lag_over_budget"] is True
+
+    slo_status = {
+        "slo": {
+            "objectives": {
+                "serving_goodput": {
+                    "state": "warning",
+                    "transitions": [
+                        {"objective": "serving_goodput", "from": "ok",
+                         "to": "warning", "tick": 4, "at": 1004.0},
+                    ],
+                },
+            },
+        },
+        "federation": {"quarantined": {}},
+    }
+    snap = crane_top.snapshot(families, slo_status, lag_budget=8)
+    assert snap["alerts"] == [{
+        "kind": "slo", "objective": "serving_goodput",
+        "state": "warning", "budgetRemaining": None,
+    }]
+    assert snap["timeline"] == [["serving_goodput", "ok", "warning"]]
+    # the snapshot is pure data: JSON round-trips deterministically
+    assert json.loads(json.dumps(snap, sort_keys=True)) == json.loads(
+        json.dumps(snap, sort_keys=True)
+    )
+
+
+def test_fleet_plane_tick_is_deterministic_same_inputs():
+    def build():
+        reg = MetricsRegistry()
+        register_build_info(reg, "scorer", set_role=False)
+        reg.counter("t_req_total", "req").inc(5)
+        plane = FleetPlane(
+            targets=[_target("primary", reg, role=None)],
+            slo_kwargs={"fast_windows": (5.0, 15.0),
+                        "slow_windows": (30.0, 60.0)},
+        )
+        for i in range(20):
+            plane.tick(now=1000.0 + i)
+        return plane
+
+    a, b = build(), build()
+    assert a.slo.timeline() == b.slo.timeline()
+    assert a.render_metrics() == b.render_metrics()
+    sa, sb = a.slo.status(), b.slo.status()
+    assert sa == sb

@@ -152,6 +152,85 @@ def test_strict_parser_rejects_malformed_payloads():
         )
 
 
+# -- strict-parser edge cases (pinned for the fleet federator) ----------
+
+
+def test_parser_escaped_label_values_round_trip():
+    # every 0.0.4 escape in one value: backslash, quote, newline
+    text = (
+        "# TYPE t gauge\n"
+        't{path="a\\\\b\\"c\\nd"} 1\n'
+    )
+    families = parse_exposition(text)
+    ((_, labels, value),) = families["t"]["samples"]
+    assert dict(labels)["path"] == 'a\\b"c\nd'
+    assert value == 1
+    with pytest.raises(ExpositionError):  # \t is not a legal escape
+        parse_exposition('# TYPE t gauge\nt{p="a\\tb"} 1\n')
+    with pytest.raises(ExpositionError):  # dangling escape at EOL
+        parse_exposition('# TYPE t gauge\nt{p="a\\\n')
+
+
+def test_parser_inf_and_nan_values():
+    import math as _math
+
+    families = parse_exposition(
+        "# TYPE t gauge\n"
+        't{k="a"} +Inf\nt{k="b"} -Inf\nt{k="c"} NaN\n'
+    )
+    values = {
+        dict(l)["k"]: v for _, l, v in families["t"]["samples"]
+    }
+    assert values["a"] == _math.inf
+    assert values["b"] == -_math.inf
+    assert _math.isnan(values["c"])
+    # counters must stay finite and non-negative — all three rejected
+    for bad in ("+Inf", "-Inf", "NaN"):
+        with pytest.raises(ExpositionError):
+            parse_exposition(f"# TYPE c counter\nc {bad}\n")
+
+
+def test_parser_exemplars_only_on_histogram_buckets():
+    # an OpenMetrics exemplar on a bucket sample is captured
+    text = (
+        "# TYPE h histogram\n"
+        'h_bucket{le="0.1"} 1 # {trace_id="abc"} 0.05\n'
+        'h_bucket{le="+Inf"} 1\nh_sum 0.05\nh_count 1\n'
+    )
+    families = parse_exposition(text)
+    ((name, labels, ex_labels, ex_value, ex_ts),) = \
+        families["h"]["exemplars"]
+    assert name == "h_bucket"
+    assert dict(ex_labels) == {"trace_id": "abc"}
+    assert ex_value == 0.05
+    assert ex_ts is None
+    # pinned: an exemplar on a counter _total sample is REJECTED — the
+    # strict parser only admits them on histogram buckets
+    with pytest.raises(ExpositionError, match="non-bucket"):
+        parse_exposition(
+            "# TYPE c_total counter\n"
+            'c_total 3 # {trace_id="abc"} 1\n'
+        )
+    with pytest.raises(ExpositionError):  # non-finite exemplar value
+        parse_exposition(
+            "# TYPE h histogram\n"
+            'h_bucket{le="+Inf"} 1 # {t="x"} +Inf\n'
+            "h_sum 1\nh_count 1\n"
+        )
+
+
+def test_parser_rejects_duplicate_and_late_family_declarations():
+    with pytest.raises(ExpositionError, match="duplicate TYPE"):
+        parse_exposition(
+            "# TYPE t counter\nt 1\n# TYPE t counter\n"
+        )
+    with pytest.raises(ExpositionError, match="no preceding TYPE"):
+        # declaring the family after its samples can't rescue them
+        parse_exposition(
+            "t_other 2\n# TYPE t_other gauge\n"
+        )
+
+
 # -- spans --------------------------------------------------------------
 
 

@@ -1,0 +1,375 @@
+"""crane-top: the live fleet console.
+
+Renders one table row per fleet process from the federated union
+(``/fleet/metrics``, ISSUE 17): role, requests, req/s, p99 latency,
+inflight, brownout tier, breaker states, replica lag vs budget, shard
+conflict %, plus the active SLO alerts and anomaly detectors from
+``/v1/slo``.
+
+Two modes:
+
+- live (default): poll ``--fleet`` (the primary serving the fleet
+  plane) every ``--interval`` seconds, compute req/s from successive
+  polls, redraw in place (ANSI home+clear);
+- ``--snapshot``: one poll, print the whole table as JSON and exit —
+  the CI/bench surface. The snapshot embeds the SLO transition
+  ``timeline`` (objective, from, to — timestamps stripped), which is
+  what bench config 20 compares across same-seed runs.
+
+Without a fleet plane, ``--targets role@host:port,...`` federates the
+listed processes in-process (one scrape pass, no SLO engine).
+
+Pure stdlib; importable as a library (``build_rows`` / ``snapshot`` /
+``render_table``) — tests and bench_suite drive the same code paths.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from crane_scheduler_tpu.telemetry.expfmt import parse_exposition  # noqa: E402
+
+_BREAKER_NAMES = {0: "closed", 1: "half-open", 2: "open"}
+
+
+# ---------------------------------------------------------------------------
+# sample indexing
+# ---------------------------------------------------------------------------
+
+
+def _samples(families: dict, family: str, sample: str | None = None):
+    """Yield ``(labels_dict, value)`` for one family's samples (the
+    family itself by default, or a child like ``_bucket``)."""
+    doc = families.get(family)
+    if not doc:
+        return
+    want = sample or family
+    for name, labels, value in doc["samples"]:
+        if name == want:
+            yield dict(labels), value
+
+
+def _processes(families: dict) -> list[tuple[str, str]]:
+    """Every (role, process) pair present anywhere in the union,
+    deterministically ordered."""
+    seen = set()
+    for doc in families.values():
+        for _, labels, _ in doc["samples"]:
+            d = dict(labels)
+            proc = d.get("process")
+            if proc is not None:
+                seen.add((d.get("role", "?"), proc))
+    return sorted(seen)
+
+
+def _sum_for(families, family, proc, sample=None, **extra) -> float | None:
+    total = None
+    for labels, value in _samples(families, family, sample):
+        if labels.get("process") != proc:
+            continue
+        if any(labels.get(k) != v for k, v in extra.items()):
+            continue
+        total = (total or 0.0) + value
+    return total
+
+
+def _p99_ms(families, proc, family="crane_service_request_seconds"):
+    """Bucket-quantile p99 (linear interpolation inside the winning
+    bucket) over all endpoints of one process."""
+    buckets: dict[float, float] = {}
+    for labels, value in _samples(families, family, family + "_bucket"):
+        if labels.get("process") != proc:
+            continue
+        le = labels.get("le")
+        if le is None:
+            continue
+        bound = math.inf if le in ("+Inf", "Inf") else float(le)
+        buckets[bound] = buckets.get(bound, 0.0) + value
+    if not buckets:
+        return None
+    ordered = sorted(buckets.items())
+    total = ordered[-1][1]
+    if total <= 0:
+        return None
+    rank = 0.99 * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for bound, cum in ordered:
+        if cum >= rank:
+            if math.isinf(bound):
+                return prev_bound * 1e3
+            span = cum - prev_cum
+            frac = (rank - prev_cum) / span if span > 0 else 1.0
+            return (prev_bound + (bound - prev_bound) * frac) * 1e3
+        prev_bound, prev_cum = bound, cum
+    return ordered[-1][0] * 1e3 if math.isfinite(ordered[-1][0]) else None
+
+
+# ---------------------------------------------------------------------------
+# rows
+# ---------------------------------------------------------------------------
+
+
+def build_rows(families: dict, lag_budget: int = 8) -> list[dict]:
+    """One dict per fleet process from a parsed federated union."""
+    rows = []
+    for role, proc in _processes(families):
+        requests = _sum_for(
+            families, "crane_service_request_seconds", proc, sample="crane_service_request_seconds_count"
+        )
+        if requests is None:
+            requests = _sum_for(families, "crane_router_requests_total", proc)
+        breakers = {}
+        for labels, value in _samples(families, "crane_breaker_state"):
+            if labels.get("process") == proc:
+                breakers[labels.get("target", "?")] = _BREAKER_NAMES.get(
+                    int(value), str(value)
+                )
+        lag = _sum_for(families, "crane_replica_lag_versions", proc)
+        if lag is None:
+            # router view: worst lag it sees across its replicas
+            lags = [
+                v for labels, v in _samples(
+                    families, "crane_router_replica_lag_versions"
+                ) if labels.get("process") == proc
+            ]
+            lag = max(lags) if lags else None
+        conflicts = _sum_for(families, "crane_shard_conflicts_total", proc)
+        binds = _sum_for(families, "crane_shard_binds_total", proc)
+        conflict_pct = None
+        if conflicts is not None and binds is not None:
+            attempts = binds + conflicts
+            if attempts > 0:
+                conflict_pct = 100.0 * conflicts / attempts
+        tier = _sum_for(families, "crane_service_brownout_tier", proc)
+        rows.append({
+            "process": proc,
+            "role": role,
+            "requests": requests,
+            "rps": None,  # live mode fills from successive polls
+            "p99_ms": _p99_ms(families, proc),
+            "inflight": _sum_for(families, "crane_service_inflight", proc),
+            "brownout_tier": tier,
+            "breakers": breakers,
+            "lag_versions": lag,
+            "lag_budget": lag_budget,
+            "lag_over_budget": (
+                None if lag is None else bool(lag > lag_budget)
+            ),
+            "shard_conflict_pct": conflict_pct,
+        })
+    return rows
+
+
+def active_alerts(slo_status: dict | None) -> list[dict]:
+    """Non-ok objectives + firing anomaly detectors from /v1/slo."""
+    alerts = []
+    if not slo_status:
+        return alerts
+    objectives = (slo_status.get("slo") or {}).get("objectives", {})
+    for name in sorted(objectives):
+        obj = objectives[name]
+        if obj.get("state") not in (None, "ok"):
+            alerts.append({
+                "kind": "slo",
+                "objective": name,
+                "state": obj["state"],
+                "budgetRemaining": obj.get("budgetRemaining"),
+            })
+    anomalies = slo_status.get("anomalies") or {}
+    for kind in sorted(anomalies):
+        if anomalies[kind].get("firing"):
+            alerts.append({"kind": "anomaly", "detector": kind})
+    return alerts
+
+
+def slo_timeline(slo_status: dict | None) -> list[list[str]]:
+    """The deterministic transition sequence (objective, from, to)
+    across all objectives, in tick order, timestamps stripped."""
+    if not slo_status:
+        return []
+    events = []
+    objectives = (slo_status.get("slo") or {}).get("objectives", {})
+    for name in sorted(objectives):
+        for tr in objectives[name].get("transitions", []):
+            events.append(
+                (tr.get("tick", 0), name, tr.get("from"), tr.get("to"))
+            )
+    events.sort()
+    return [[o, f, t] for _, o, f, t in events]
+
+
+def snapshot(families: dict, slo_status: dict | None = None,
+             lag_budget: int = 8) -> dict:
+    """The --snapshot payload: full table + alerts + timeline."""
+    return {
+        "rows": build_rows(families, lag_budget=lag_budget),
+        "alerts": active_alerts(slo_status),
+        "timeline": slo_timeline(slo_status),
+        "quarantined": sorted(
+            ((slo_status or {}).get("federation") or {})
+            .get("quarantined", {})
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+_COLUMNS = (
+    ("PROCESS", "process", "{}"),
+    ("ROLE", "role", "{}"),
+    ("REQS", "requests", "{:.0f}"),
+    ("REQ/S", "rps", "{:.1f}"),
+    ("P99MS", "p99_ms", "{:.1f}"),
+    ("INFL", "inflight", "{:.0f}"),
+    ("TIER", "brownout_tier", "{:.0f}"),
+    ("BREAKERS", "breakers", "{}"),
+    ("LAG", "lag_versions", "{:.0f}"),
+    ("CONFL%", "shard_conflict_pct", "{:.1f}"),
+)
+
+
+def render_table(rows: list[dict], alerts: list[dict] | None = None) -> str:
+    lines = []
+    cells = [[title for title, _, _ in _COLUMNS]]
+    for row in rows:
+        out = []
+        for _, key, fmt in _COLUMNS:
+            value = row.get(key)
+            if value is None:
+                out.append("-")
+            elif key == "breakers":
+                out.append(
+                    ",".join(
+                        f"{t}:{s}" for t, s in sorted(value.items())
+                    ) or "-"
+                )
+            elif key == "lag_versions":
+                mark = "!" if row.get("lag_over_budget") else ""
+                out.append(fmt.format(value) + mark)
+            else:
+                out.append(fmt.format(value))
+        cells.append(out)
+    widths = [
+        max(len(r[i]) for r in cells) for i in range(len(_COLUMNS))
+    ]
+    for r in cells:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+    if alerts:
+        lines.append("")
+        lines.append("ALERTS:")
+        for a in alerts:
+            if a["kind"] == "slo":
+                lines.append(
+                    f"  [{a['state']:>7}] {a['objective']} "
+                    f"(budget {a.get('budgetRemaining')})"
+                )
+            else:
+                lines.append(f"  [anomaly] {a['detector']}")
+    else:
+        lines.append("")
+        lines.append("ALERTS: none")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# data sources
+# ---------------------------------------------------------------------------
+
+
+def fetch_fleet(base_url: str, timeout_s: float = 5.0):
+    """(families, slo_status) from a fleet-plane-serving primary."""
+    req = urllib.request.Request(
+        base_url.rstrip("/") + "/fleet/metrics",
+        headers={"Accept": "text/plain;version=0.0.4"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        families = parse_exposition(resp.read().decode("utf-8"))
+    slo_status = None
+    try:
+        with urllib.request.urlopen(
+            base_url.rstrip("/") + "/v1/slo", timeout=timeout_s
+        ) as resp:
+            slo_status = json.loads(resp.read())
+    except Exception:
+        pass  # plane without SLO surface: table still renders
+    return families, slo_status
+
+
+def federate_targets(spec: str):
+    """One in-process federation pass over ``role@host:port,...``."""
+    from crane_scheduler_tpu.telemetry.fleet import (
+        MetricsFederator,
+        parse_scrape_flag,
+    )
+
+    fed = MetricsFederator(parse_scrape_flag(spec))
+    fed.scrape_once()
+    return parse_exposition(fed.render()), None
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="crane-top", description=__doc__)
+    parser.add_argument("--fleet", default=None, metavar="URL",
+                        help="fleet-plane base URL, e.g. "
+                             "http://127.0.0.1:8080")
+    parser.add_argument("--targets", default=None,
+                        metavar="[ROLE@]HOST:PORT,...",
+                        help="federate these processes directly "
+                             "(no fleet plane required)")
+    parser.add_argument("--lag-budget", type=int, default=8)
+    parser.add_argument("--interval", type=float, default=1.0)
+    parser.add_argument("--snapshot", action="store_true",
+                        help="one poll, JSON to stdout, exit")
+    args = parser.parse_args(argv)
+    if not args.fleet and not args.targets:
+        parser.error("one of --fleet or --targets is required")
+
+    def poll():
+        if args.fleet:
+            return fetch_fleet(args.fleet)
+        return federate_targets(args.targets)
+
+    if args.snapshot:
+        families, slo_status = poll()
+        print(json.dumps(
+            snapshot(families, slo_status, lag_budget=args.lag_budget),
+            indent=1, sort_keys=True,
+        ))
+        return 0
+
+    prev: dict[str, tuple[float, float]] = {}
+    try:
+        while True:
+            t = time.monotonic()
+            families, slo_status = poll()
+            rows = build_rows(families, lag_budget=args.lag_budget)
+            for row in rows:
+                reqs = row["requests"]
+                last = prev.get(row["process"])
+                if reqs is not None and last is not None and t > last[0]:
+                    row["rps"] = max(0.0, (reqs - last[1]) / (t - last[0]))
+                if reqs is not None:
+                    prev[row["process"]] = (t, reqs)
+            sys.stdout.write("\x1b[H\x1b[2J")
+            print(f"crane-top  {time.strftime('%H:%M:%S')}  "
+                  f"({len(rows)} processes)")
+            print()
+            print(render_table(rows, active_alerts(slo_status)))
+            sys.stdout.flush()
+            time.sleep(max(0.0, args.interval - (time.monotonic() - t)))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -13,6 +13,13 @@ written by any crane process. This tool replays it:
 - ``slo [--target S]`` — p50/p99 per stage and e2e compliance / burn
   rate against a latency target, computed from raw records (the
   cross-check for the ``crane_placement_*`` histograms).
+- ``stitch [--fleet ROOT] [DIR ...]`` — merge flight segments across
+  every fleet process's ``--flight-dir`` (ISSUE 17): ``--fleet``
+  auto-discovers flight directories under a root, each record is
+  tagged with its source directory, and ``--pod`` joins one
+  placement's spans ACROSS processes (the annotator's sync spans and
+  the scorer's cycle spans live in different rings — the merged view
+  is the only one that shows the whole hop chain).
 
 Pure stdlib; importable as a library (``load_flight`` / ``stitch`` /
 ``explain_lines``) — the e2e tests drive the same code paths.
@@ -197,6 +204,90 @@ def explain_lines(joined: dict) -> list[str]:
     return lines
 
 
+def discover_flight_dirs(root: str) -> list[str]:
+    """Every directory under ``root`` (inclusive) holding flight
+    recorder segments — the ``stitch --fleet`` auto-discovery."""
+    found = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        if any(
+            f.startswith("flight-") and f.endswith(".jsonl")
+            for f in filenames
+        ):
+            found.append(dirpath)
+    return sorted(found)
+
+
+def merge_flights(dirs: list[str]) -> tuple[dict, dict]:
+    """Merge several flight directories into one partitioned view.
+    Every record gains a ``flight_dir`` tag (which process's ring it
+    came from); spans are ts-sorted so the merged stream reads as one
+    timeline. Returns ``(merged, per_dir_counts)``."""
+    merged: dict[str, list] = {"lifecycle": [], "span": [], "decision": []}
+    per_dir: dict[str, dict] = {}
+    for d in dirs:
+        flight = load_flight(d)
+        per_dir[d] = {k: len(v) for k, v in flight.items() if v}
+        for kind, records in flight.items():
+            bucket = merged.setdefault(kind, [])
+            for rec in records:
+                rec = dict(rec)
+                rec["flight_dir"] = d
+                bucket.append(rec)
+    merged["span"].sort(key=lambda s: (s.get("ts_us") or 0.0,
+                                       s.get("dur_us") or 0.0))
+    return merged, per_dir
+
+
+def cmd_stitch(args) -> int:
+    dirs = list(args.dirs)
+    if args.fleet:
+        dirs.extend(discover_flight_dirs(args.fleet))
+    if not dirs:
+        dirs = [args.flight_dir]
+    # dedupe, order-preserving: an explicit DIR repeated by --fleet
+    # discovery must not double its records
+    seen: set[str] = set()
+    dirs = [
+        os.path.normpath(d) for d in dirs
+        if not (os.path.normpath(d) in seen or seen.add(os.path.normpath(d)))
+    ]
+    merged, per_dir = merge_flights(dirs)
+    if args.pod:
+        rec = find_record(merged["lifecycle"], args.pod)
+        if rec is None:
+            print(f"pod {args.pod!r} not found across {len(dirs)} "
+                  f"flight dirs ({len(merged['lifecycle'])} records)")
+            return 2
+        joined = stitch(rec, merged["span"], merged["decision"])
+        for line in explain_lines(joined):
+            print(line)
+        touched = sorted({
+            s.get("flight_dir") for group in
+            ("pod_spans", "cycle_spans", "annotator_spans")
+            for s in joined[group] if s.get("flight_dir")
+        })
+        print(f"  stitched across {len(touched)} flight dirs: "
+              + ", ".join(touched))
+        if args.export:
+            trace = stitched_trace(rec, merged["span"], merged["decision"])
+            with open(args.export, "w") as f:
+                json.dump(trace, f, indent=1)
+            print(f"  exported {len(trace['traceEvents'])} spans -> "
+                  f"{args.export}")
+        return 0
+    pods = sorted({
+        r.get("pod") for r in merged["lifecycle"] if r.get("pod")
+    })
+    print(json.dumps({
+        "dirs": per_dir,
+        "lifecycle": len(merged["lifecycle"]),
+        "spans": len(merged["span"]),
+        "decisions": len(merged["decision"]),
+        "pods": len(pods),
+    }, indent=2, sort_keys=True))
+    return 0
+
+
 def cmd_explain(args) -> int:
     flight = load_flight(args.flight_dir)
     rec = find_record(flight["lifecycle"], args.pod)
@@ -255,6 +346,20 @@ def main(argv=None) -> int:
     p_slo.add_argument("--max-burn-rate", type=float, default=None,
                        help="exit 1 when the burn rate exceeds this")
     p_slo.set_defaults(fn=cmd_slo)
+    p_stitch = sub.add_parser(
+        "stitch", help="merge flight dirs across the fleet"
+    )
+    p_stitch.add_argument("dirs", nargs="*",
+                          help="explicit flight dirs to merge")
+    p_stitch.add_argument("--fleet", default=None, metavar="ROOT",
+                          help="auto-discover flight dirs under this root")
+    p_stitch.add_argument("--pod", default=None,
+                          help="join this pod's placement across all "
+                               "merged rings")
+    p_stitch.add_argument("--export", default=None,
+                          help="write the stitched Chrome trace JSON here "
+                               "(with --pod)")
+    p_stitch.set_defaults(fn=cmd_stitch)
     args = parser.parse_args(argv)
     return args.fn(args)
 

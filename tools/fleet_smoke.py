@@ -1,0 +1,344 @@
+"""Fleet observability smoke gate (``make fleet-smoke``): boot the
+real fleet topology against the kube stub — a scoring primary mirroring
+the stub apiserver, two delta-fed serving replicas, the consistent-hash
+router, and a scheduler-role process — federate all of them through the
+FleetPlane, then assert the observability contract end to end:
+
+- ``/fleet/metrics`` (served by the primary's ServiceRouter) strict-
+  parses under the exposition parser and every fleet role appears in
+  the ``role`` labels;
+- a forced counter reset (replica killed and rebooted on the same
+  port) merges WITHOUT the federated counter going backward, and the
+  federator counts the reset;
+- the replica kill flips the ``scrape_availability`` SLO objective out
+  of ``ok`` within one fast window, and the heal clears it back;
+- ``crane-top --snapshot`` (the real CLI, subprocess) returns the full
+  table: one row per process with role/requests/p99 populated.
+
+Exit 0 = every check passed; any violation prints the failure and
+exits nonzero.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+_STUB = os.path.join(_REPO, "tests", "kube_stub.py")
+
+
+def _load_stub():
+    spec = importlib.util.spec_from_file_location("kube_stub", _STUB)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    from crane_scheduler_tpu.annotator import AnnotatorConfig, NodeAnnotator
+    from crane_scheduler_tpu.cluster.kube import KubeClusterClient
+    from crane_scheduler_tpu.cluster.replication import DeltaPublisher
+    from crane_scheduler_tpu.metrics import FakeMetricsSource
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+    from crane_scheduler_tpu.service import (
+        ReplicaRouter,
+        ScoringHTTPServer,
+        ScoringService,
+        ServingReplica,
+    )
+    from crane_scheduler_tpu.service.http import HealthServer
+    from crane_scheduler_tpu.telemetry import Telemetry
+    from crane_scheduler_tpu.telemetry.expfmt import (
+        ExpositionError,
+        parse_exposition,
+    )
+    from crane_scheduler_tpu.telemetry.fleet import (
+        FleetPlane,
+        ScrapeTarget,
+        register_build_info,
+    )
+
+    failures = 0
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        nonlocal failures
+        mark = "ok" if ok else "FAIL"
+        print(f"[fleet-smoke] {name}: {mark}"
+              f"{' — ' + detail if detail else ''}")
+        if not ok:
+            failures += 1
+
+    kube_stub = _load_stub()
+    stub = kube_stub.KubeStubServer().start()
+    clients = []
+    replicas = []
+    router = plane = server = pub = sched_health = None
+    try:
+        for i in range(6):
+            stub.state.add_node(f"node-{i}", f"10.0.0.{i + 1}")
+        # annotator pass so the scorer has fresh scores to serve
+        fake = FakeMetricsSource()
+        for metric in {sp.name for sp in DEFAULT_POLICY.spec.sync_period}:
+            for i in range(6):
+                fake.set(metric, f"10.0.0.{i + 1}", 0.1 * (i + 1), by="ip")
+        client_ann = KubeClusterClient(stub.url)
+        client_ann.start()
+        clients.append(client_ann)
+        NodeAnnotator(
+            client_ann, fake, DEFAULT_POLICY, AnnotatorConfig()
+        ).sync_all_once_bulk(time.time())
+
+        # the scoring primary, mirroring the stub apiserver
+        client = KubeClusterClient(stub.url)
+        client.start()
+        clients.append(client)
+        svc = ScoringService(client, DEFAULT_POLICY)
+        register_build_info(svc.telemetry.registry, "scorer")
+        svc.refresh()
+        pub = DeltaPublisher(client, window_s=0.05, telemetry=svc.telemetry)
+
+        # a scheduler-role process: its own bundle + health sidecar
+        tel_sched = Telemetry()
+        register_build_info(
+            tel_sched.registry, "scheduler", set_role=False
+        )
+        sched_health = HealthServer(port=0, telemetry=tel_sched)
+        sched_health.start()
+
+        # the fleet plane rides in the primary; manual ticks with an
+        # injected clock keep the SLO assertions deterministic — short
+        # burn windows so kill/heal resolves in smoke time
+        plane = FleetPlane(
+            registry=svc.telemetry.registry,
+            local_registry=svc.telemetry.registry,
+            local_role="scorer",
+            local_name="primary",
+            slo_kwargs={"fast_windows": (5.0, 15.0),
+                        "slow_windows": (30.0, 60.0)},
+        )
+        server = ScoringHTTPServer(
+            svc, port=0, frontend="async", replication=pub, fleet=plane
+        )
+        server.start()
+        pub.start()
+
+        for i in range(2):
+            r = ServingReplica(
+                DEFAULT_POLICY, name=f"replica-{i}",
+                feed=("127.0.0.1", server.port),
+            )
+            register_build_info(
+                r.telemetry.registry, "replica", set_role=False
+            )
+            r.start()
+            replicas.append(r)
+        deadline = time.time() + 10.0
+        while (pub.published_version < client.node_version
+               and time.time() < deadline):
+            time.sleep(0.02)
+        caught = all(
+            r.wait_caught_up(pub.published_version, timeout_s=10.0)
+            for r in replicas
+        )
+        check("replicas catch up to the published fence", caught,
+              f"v{pub.published_version}")
+
+        router = ReplicaRouter(
+            [(r.name, "127.0.0.1", r.port) for r in replicas],
+            primary=("127.0.0.1", server.port), port=0,
+        )
+        register_build_info(
+            router.telemetry.registry, "router", set_role=False
+        )
+        router.start()
+
+        for r in replicas:
+            plane.federator.add_target(ScrapeTarget(
+                name=r.name, port=r.port, role=None,  # role from build_info
+            ))
+        plane.federator.add_target(ScrapeTarget(
+            name="router", port=router.port, role=None,
+        ))
+        plane.federator.add_target(ScrapeTarget(
+            name="scheduler", port=sched_health.port, role=None,
+        ))
+
+        def post(port, now):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/score",
+                data=json.dumps({"now": now, "refresh": True}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10.0) as resp:
+                return resp.status, resp.read()
+
+        base_now = time.time() + 5.0
+        for j in range(3):
+            post(replicas[1].port, base_now + j * 1e-3)
+        for j in range(2):
+            post(router.port, base_now + (10 + j) * 1e-3)
+
+        clock = [1000.0]
+
+        def tick():
+            clock[0] += 1.0
+            return plane.tick(now=clock[0])
+
+        for _ in range(3):
+            tick()
+
+        # 1) /fleet/metrics over the real wire, strict-parsed
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/fleet/metrics",
+            headers={"Accept": "text/plain; version=0.0.4"},
+        )
+        with urllib.request.urlopen(req, timeout=10.0) as resp:
+            text = resp.read().decode()
+        try:
+            families = parse_exposition(text)
+            check("/fleet/metrics strict-parses",
+                  len(families) > 5, f"{len(families)} families")
+        except ExpositionError as e:
+            families = {}
+            check("/fleet/metrics strict-parses", False, repr(e))
+
+        roles = set()
+        for doc in families.values():
+            for _, labels, _ in doc["samples"]:
+                role = dict(labels).get("role")
+                if role:
+                    roles.add(role)
+        want = {"scorer", "replica", "router", "scheduler"}
+        check("all fleet roles labeled in the union",
+              want <= roles, f"roles {sorted(roles)}")
+        check("no families quarantined",
+              not plane.federator.quarantined,
+              str(plane.federator.quarantined))
+
+        def federated_count(proc):
+            fam = families.get("crane_service_request_seconds")
+            total = 0.0
+            for name, labels, value in (fam or {"samples": []})["samples"]:
+                if (name == "crane_service_request_seconds_count"
+                        and dict(labels).get("process") == proc):
+                    total += value
+            return total
+
+        before = federated_count("replica-1")
+        check("replica-1 counters federated before the kill",
+              before >= 3, f"count {before:.0f}")
+
+        # 2) kill replica-1: scrapes fail -> scrape_availability burns
+        old_port = replicas[1].port
+        replicas[1].stop()
+        state = "ok"
+        for _ in range(6):  # one fast window (5 ticks) + margin
+            tick()
+            state = plane.slo.alert_state("scrape_availability")
+            if state != "ok":
+                break
+        check("replica kill flips scrape_availability within one "
+              "fast window", state != "ok", f"state {state}")
+
+        # 3) heal on the SAME port: the fresh process's counters start
+        # at zero — the forced reset the merge must absorb
+        healed = ServingReplica(
+            DEFAULT_POLICY, name="replica-1",
+            feed=("127.0.0.1", server.port), port=old_port,
+        )
+        register_build_info(
+            healed.telemetry.registry, "replica", set_role=False
+        )
+        healed.start()
+        replicas[1] = healed
+        healed.wait_caught_up(pub.published_version, timeout_s=10.0)
+        post(healed.port, base_now + 0.5)
+        for _ in range(30):
+            tick()
+            if plane.slo.alert_state("scrape_availability") == "ok":
+                break
+        check("scrape_availability clears back to ok after heal",
+              plane.slo.alert_state("scrape_availability") == "ok")
+
+        families = parse_exposition(plane.render_metrics())
+        after = federated_count("replica-1")
+        check("counter reset merged without going backward",
+              after >= before and plane.federator.reset_count() >= 1,
+              f"{before:.0f} -> {after:.0f}, "
+              f"{plane.federator.reset_count()} resets")
+
+        timeline = plane.slo.timeline()
+        check("SLO timeline records the kill/heal transitions",
+              ("scrape_availability", "ok", "warning") in timeline
+              or ("scrape_availability", "warning", "page") in timeline,
+              str(timeline))
+
+        # 4) the real crane-top CLI, snapshot mode
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "crane_top.py"),
+             "--fleet", f"http://127.0.0.1:{server.port}", "--snapshot"],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        try:
+            snap = json.loads(proc.stdout)
+        except ValueError:
+            snap = {}
+        rows = snap.get("rows", [])
+        row_roles = {r["role"] for r in rows}
+        with_p99 = [r for r in rows if r.get("p99_ms") is not None]
+        check("crane-top --snapshot returns the full table",
+              proc.returncode == 0 and len(rows) >= 5
+              and want <= row_roles and len(with_p99) >= 2,
+              f"rc {proc.returncode}, {len(rows)} rows, "
+              f"roles {sorted(row_roles)}"
+              + (f", stderr: {proc.stderr.strip()[-200:]}"
+                 if proc.returncode else ""))
+        check("snapshot timeline present",
+              isinstance(snap.get("timeline"), list)
+              and len(snap["timeline"]) >= 1,
+              str(snap.get("timeline"))[:120])
+    finally:
+        if plane is not None:
+            plane.stop()
+        if router is not None:
+            router.stop()
+        for r in replicas:
+            try:
+                r.stop()
+            except Exception:
+                pass
+        if sched_health is not None:
+            sched_health.stop()
+        if pub is not None:
+            pub.stop()
+        if server is not None:
+            server.stop()
+        for c in clients:
+            try:
+                c.stop()
+            except Exception:
+                pass
+        stub.stop()
+
+    print(f"[fleet-smoke] {'PASS' if failures == 0 else 'FAIL'} "
+          f"({failures} failures)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

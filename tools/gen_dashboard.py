@@ -146,6 +146,159 @@ def _family_panels(families) -> list[dict]:
     return panels
 
 
+# the SLO objectives the fleet plane exports (telemetry/fleet.py
+# SLOEngine defaults) — the fleet dashboard enumerates them statically
+# so a plane that hasn't alerted yet still renders every row
+_FLEET_OBJECTIVES = (
+    "placement_latency",
+    "serving_goodput",
+    "replication_lag",
+    "shard_conflicts",
+    "scrape_availability",
+)
+
+
+def build_fleet_dashboard() -> dict:
+    """The fleet-SLO dashboard (ISSUE 17): burn rates, budget, alert
+    states and anomaly detectors from the fleet plane's own families,
+    plus per-role traffic panels over the federated union's
+    ``role``/``process`` labels."""
+    panels = []
+
+    def add(title, exprs, unit="none", description=""):
+        panels.append(_panel(len(panels) + 1, title, exprs, unit, description))
+
+    add(
+        "SLO burn rate (fast windows)",
+        [
+            (f'crane_slo_burn_rate{{objective="{o}",window="5m"}}', o)
+            for o in _FLEET_OBJECTIVES
+        ],
+        description="Error-budget burn per objective over the 5m fast "
+                    "window; 1.0 = consuming the budget exactly, "
+                    "sustained > warn threshold moves the alert state "
+                    "machine.",
+    )
+    add(
+        "SLO burn rate (slow windows)",
+        [
+            (f'crane_slo_burn_rate{{objective="{o}",window="6h"}}', o)
+            for o in _FLEET_OBJECTIVES
+        ],
+        description="The 6h slow window guards against slow leaks the "
+                    "fast windows average away.",
+    )
+    add(
+        "Error budget remaining",
+        [
+            (f'crane_slo_budget_remaining{{objective="{o}"}}', o)
+            for o in _FLEET_OBJECTIVES
+        ],
+        description="Fraction of the error budget left over the "
+                    "longest window (negative = overspent).",
+    )
+    add(
+        "Alert state (0 ok / 1 warning / 2 page)",
+        [
+            (f'crane_slo_alert_state{{objective="{o}"}}', o)
+            for o in _FLEET_OBJECTIVES
+        ],
+        description="Per-objective state machine: ok -> warning -> "
+                    "page, hysteresis on clear.",
+    )
+    add(
+        "Anomaly detectors",
+        [("crane_fleet_anomaly", "{{kind}}")],
+        description="breaker_flapping, degraded_dwell, "
+                    "replication_lag_trend (1 = firing).",
+    )
+    add(
+        "Federation health",
+        [
+            ("sum(rate(crane_fleet_scrapes_total[5m])) by (outcome)",
+             "{{outcome}}"),
+            ("crane_fleet_quarantined_families", "quarantined families"),
+        ],
+        description="Scrape outcomes per pass and type-conflict "
+                    "quarantines (never silently dropped).",
+    )
+    add(
+        "Fleet request rate by role",
+        [
+            ("sum(rate(crane_service_request_seconds_count[5m])) by (role)",
+             "{{role}}"),
+        ],
+        unit="ops",
+        description="Served request rate per fleet role from the "
+                    "federated union (/fleet/metrics).",
+    )
+    add(
+        "Fleet p99 by process",
+        [
+            ("histogram_quantile(0.99, sum(rate("
+             "crane_service_request_seconds_bucket[5m])) "
+             "by (le, process))", "{{process}}"),
+        ],
+        unit="s",
+        description="Per-process request latency across the fleet "
+                    "(reset-adjusted by the federator).",
+    )
+    add(
+        "Replica lag vs budget",
+        [
+            ("crane_router_replica_lag_versions", "{{replica}}"),
+            ("crane_replica_lag_versions", "{{process}}"),
+        ],
+        description="Versions behind the published delta stream; the "
+                    "router stops routing past the lag budget.",
+    )
+    add(
+        "Shard conflict ratio",
+        [
+            ("sum(rate(crane_shard_conflicts_total[5m])) / "
+             "(sum(rate(crane_shard_binds_total[5m])) + "
+             "sum(rate(crane_shard_conflicts_total[5m])))",
+             "conflict fraction"),
+        ],
+        description="Optimistic-bind conflict fraction across all "
+                    "schedulers.",
+    )
+    return {
+        "__inputs": [
+            {
+                "name": "datasource",
+                "label": "Prometheus",
+                "type": "datasource",
+                "pluginId": "prometheus",
+            }
+        ],
+        "title": "Crane fleet SLO",
+        "uid": "crane-fleet-slo",
+        "tags": ["crane-scheduler-tpu", "slo", "fleet", "generated"],
+        "timezone": "utc",
+        "schemaVersion": 39,
+        "version": 1,
+        "refresh": "30s",
+        "time": {"from": "now-6h", "to": "now"},
+        "templating": {
+            "list": [
+                {
+                    "name": "datasource",
+                    "type": "datasource",
+                    "query": "prometheus",
+                }
+            ]
+        },
+        "annotations": {"list": []},
+        "panels": panels,
+        "description": (
+            "Generated by tools/gen_dashboard.py --fleet from the fleet "
+            "plane's SLO/anomaly families — edit the generator, not "
+            "this file (make dashboards)."
+        ),
+    }
+
+
 def build_dashboard() -> dict:
     families = registered_families()
     return {
@@ -190,8 +343,12 @@ def main(argv=None) -> int:
                         help="write here (default: stdout)")
     parser.add_argument("--check", action="store_true",
                         help="exit 1 if --out differs from regeneration")
+    parser.add_argument("--fleet", action="store_true",
+                        help="emit the fleet-SLO dashboard instead of "
+                             "the placement one")
     args = parser.parse_args(argv)
-    text = json.dumps(build_dashboard(), indent=1, sort_keys=True) + "\n"
+    dashboard = build_fleet_dashboard() if args.fleet else build_dashboard()
+    text = json.dumps(dashboard, indent=1, sort_keys=True) + "\n"
     if args.out is None:
         sys.stdout.write(text)
         return 0
