@@ -10,7 +10,8 @@ IMAGE_SCHEDULER := $(REGISTRY)/crane-scheduler-tpu:$(GIT_VERSION)
 
 .PHONY: all native test test-fast bench sim e2e metrics-smoke \
 	desched-smoke chaos-smoke recovery-smoke trace-smoke drip-smoke \
-	shard-smoke overload-smoke replica-smoke fleet-smoke dashboards \
+	shard-smoke reshard-smoke overload-smoke replica-smoke fleet-smoke \
+	dashboards \
 	clean images image-annotator image-scheduler push-images
 
 all: native test
@@ -57,6 +58,15 @@ drip-smoke:
 # doc/sharding.md
 shard-smoke:
 	$(PYTHON) tools/shard_smoke.py
+
+# TRUE multi-process --shards soak: two scheduler PROCESSES over the
+# wire stub under a shared consistent-hash ring file, with a SIGKILL +
+# intent-journal failover AND one ring move landing mid-storm — per-pod
+# bind_posts == 1, zero duplicate POSTs, live reshard adoption, and the
+# crane_dirty_journal_* / crane_reshard_* families must strict-parse —
+# see doc/sharding.md "Dynamic resharding"
+reshard-smoke:
+	$(PYTHON) tools/reshard_smoke.py
 
 # scripted prometheus outage through the breaker + degraded-mode
 # controller + health registry; strict-parses the resilience families

@@ -4028,12 +4028,351 @@ def config20(dtype, rtt, n_nodes=4_000, n_replicas=2):
         server.stop()
 
 
+def config21(dtype, rtt, n_nodes=1_000_000):
+    """Round-16 tentpole gate: the O(dirty) shard plane at a 1M-node
+    mirror (annotation columns only, no pod bodies) on a dynamic
+    consistent-hash ring.
+
+    Legs (one in-process 1M-node ``ClusterState`` under a
+    ``HashRing(4)`` keyspace unless noted):
+
+      dirty    — 4-ring-shard plane; per shard the first probe pays the
+                 column build over its ~250k-row slice, then ONE named
+                 annotation patch and a re-probe: the owning shard
+                 patches exactly one row end to end (journal replay ->
+                 store/fit/drip row patch -> device-side scatter of the
+                 dirty column row), the other shards' fences never
+                 moved. The dirty-patched columns are then asserted
+                 bit-identical to a from-scratch scheduler built over
+                 the same view;
+      sweep    — the SAME patch shape with journal coverage dropped
+                 (``forget_dirty_names`` = what a relist does): the
+                 owning shard pays the pre-journal identity-gated sweep
+                 over its whole slice — the in-run baseline the O(dirty)
+                 path is gated against;
+      reshard  — one-token vs eight-token ring moves through the live
+                 mirror: migration bookkeeping must price per MOVED
+                 name, not per node (the crc index bisects the moved
+                 arcs); after the small move the dirtied shards refresh
+                 by splicing only the migrated rows;
+      storm    — 4 schedulers x 512 pods, static crc keyspace (config
+                 18's plane) vs the ring keyspace over the same mirror:
+                 dynamic sharding must not tax steady-state throughput;
+      wire     — 2 ring-sharded schedulers over the wire stub with a
+                 ring move landing MID-storm: every pod still binds
+                 exactly once (per-pod bind POST oracle, zero
+                 duplicates).
+
+    Gates: dirty refresh of the patched shard < 5 ms at 1M nodes and
+    >= 20x faster than the in-run identity sweep; untouched shards
+    < 5 ms (fences never moved); dirty-patched columns bit-identical
+    to the from-scratch rebuild; per-moved-name reshard cost of the
+    8-token move <= 3x the 1-token move's; ring storm throughput
+    >= 0.9x the static keyspace's; zero duplicate binding POSTs and
+    bind_posts == pods across the mid-storm move."""
+    import os
+    import threading
+
+    from crane_scheduler_tpu.cluster import (
+        ClusterState,
+        Container,
+        Node,
+        Pod,
+        ResourceRequirements,
+    )
+    from crane_scheduler_tpu.cluster.kube import KubeClusterClient
+    from crane_scheduler_tpu.cluster.shards import HashRing
+    from crane_scheduler_tpu.fit import FitTracker, ResourceFitPlugin
+    from crane_scheduler_tpu.framework.scheduler import Scheduler
+    from crane_scheduler_tpu.framework.shardplane import ShardedPlacementPlane
+    from crane_scheduler_tpu.plugins import DynamicPlugin
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+    from crane_scheduler_tpu.utils import format_local_time, parse_local_time
+
+    now = parse_local_time("2026-07-30T00:00:00Z") + 30.0
+    metric_names = [sp.name for sp in DEFAULT_POLICY.spec.sync_period]
+    alloc = {"cpu": "64", "memory": "256Gi",
+             "ephemeral-storage": "100Gi", "pods": "1100"}
+
+    # -- the 1M-node mirror: annotation columns only, no pod bodies.
+    # Eight shared annotation dicts (patches copy on write) keep the
+    # node table itself the only O(n) cost.
+    ts = format_local_time(now - 20.0)
+    variants = [
+        {m: f"{0.20 + 0.01 * ((j + k) % 11):.5f},{ts}"
+         for k, m in enumerate(metric_names)}
+        for j in range(8)
+    ]
+    t0 = time.perf_counter()
+    # journal sized for the scale: a reshard notes every moved name
+    # (arcs run ~n/tokens names), so a 4096-cap journal would overrun
+    # on every token move at 1M nodes and degrade moves to sweeps
+    cluster = ClusterState(dirty_journal_cap=65536)
+    cluster.replace_nodes(
+        Node(name=f"node-{i:07d}", annotations=variants[i % 8],
+             allocatable=alloc)
+        for i in range(n_nodes)
+    )
+    mirror_s = time.perf_counter() - t0
+    log(f"config21: {n_nodes} nodes mirrored in {mirror_s:.1f}s")
+
+    def factory(view):
+        sched = Scheduler(view, clock=lambda: now, columnar=True)
+        sched.register(ResourceFitPlugin(FitTracker(view)), weight=1)
+        sched.register(DynamicPlugin(DEFAULT_POLICY, clock=lambda: now),
+                       weight=3)
+        return sched
+
+    def make_pods(tag, count, cpu="100m"):
+        pods = [
+            Pod(name=f"p21-{tag}-{i:04d}", namespace="default",
+                containers=(Container("c", ResourceRequirements(
+                    requests={"cpu": cpu, "memory": "128Mi"},
+                )),))
+            for i in range(count)
+        ]
+        cluster.add_pods(pods)
+        return pods
+
+    def drip_of(sched):
+        rec = sched._recognition()
+        drip = sched._ensure_drip(rec)
+        drip.ensure(now)
+        return drip
+
+    # -- leg 1: dirty vs identity-sweep refresh at 1M ------------------------
+    ring = HashRing(4, vnodes=64)
+    plane = ShardedPlacementPlane(cluster, 4, layout=ring)
+    scheds = plane.add_scheduler(factory)
+    probes = make_pods("probe", 16, cpu="100000")  # infeasible: no binds
+    build_s = []
+    for i, sched in enumerate(scheds):
+        t0 = time.perf_counter()
+        r = sched.schedule_one(probes[i])
+        build_s.append(time.perf_counter() - t0)
+        assert r.node is None, "infeasible probe placed?!"
+    victim = next(f"node-{i:07d}" for i in range(n_nodes)
+                  if ring.owners(f"node-{i:07d}") == (0,))
+    assert cluster.patch_node_annotation(
+        victim, metric_names[0], f"0.90000,{ts}")
+    refresh_s = []
+    for i, sched in enumerate(scheds):
+        t0 = time.perf_counter()
+        sched.schedule_one(probes[4 + i])
+        refresh_s.append(time.perf_counter() - t0)
+    build_total = sum(build_s)
+    dirty_ms = refresh_s[0] * 1e3
+    log(f"config21[dirty]: 4-ring-shard build {build_total * 1e3:.0f} ms "
+        f"({'/'.join(f'{s * 1e3:.0f}' for s in build_s)}), refresh after "
+        f"1 named patch {'/'.join(f'{s * 1e3:.2f}' for s in refresh_s)} ms "
+        f"(shard 0 dirty-patched {dirty_ms:.2f} ms)")
+    d_stats = scheds[0].drip_stats()
+    assert d_stats["dirty_patches"] >= 1, d_stats
+    # bit-identity: the dirty-patched columns == a from-scratch build
+    patched = drip_of(scheds[0])
+    fresh = drip_of(factory(plane.views[0]))
+    assert patched.names == fresh.names
+    for col in ("schedulable", "fail_entry", "weighted"):
+        assert np.array_equal(getattr(patched, col), getattr(fresh, col)), \
+            f"dirty-patched column {col} != from-scratch rebuild"
+    log("config21[dirty]: patched columns bit-identical to rebuild")
+
+    # -- leg 2: in-run identity-sweep baseline (journal coverage dropped,
+    # exactly the pre-journal relist path) -----------------------------------
+    assert cluster.patch_node_annotation(
+        victim, metric_names[0], f"0.10000,{ts}")
+    cluster.forget_dirty_names()
+    t0 = time.perf_counter()
+    scheds[0].schedule_one(probes[8])
+    sweep_ms = (time.perf_counter() - t0) * 1e3
+    speedup = sweep_ms / max(dirty_ms, 1e-6)
+    log(f"config21[sweep]: identity-sweep baseline {sweep_ms:.1f} ms "
+        f"-> O(dirty) {dirty_ms:.2f} ms = {speedup:.0f}x")
+
+    # -- leg 3: reshard cost prices per moved name ---------------------------
+    # warm the one-time sorted crc index (O(n log n), amortized across
+    # every later move) with a token moved there and back, so the timed
+    # moves measure the steady-state per-moved-name bisection
+    points, owners = ring.tokens()
+    w = next(i for i, s in enumerate(owners) if s == 1)
+    t0 = time.perf_counter()
+    cluster.reshard(ring.with_moves([(w, 2)]))
+    cluster.reshard(ring.with_moves([(w, 1)]))
+    index_warm_ms = (time.perf_counter() - t0) * 1e3
+    points, owners = ring.tokens()
+    one = [next(i for i, s in enumerate(owners) if s == 0)]
+    t0 = time.perf_counter()
+    moved_small = cluster.reshard(ring.with_moves([(i, 1) for i in one]))
+    reshard_small_ms = (time.perf_counter() - t0) * 1e3
+    # the dirtied shards refresh by splicing only the migrated rows
+    t0 = time.perf_counter()
+    for i, sched in enumerate(scheds):
+        sched.schedule_one(probes[12 + i])
+    reshard_refresh_ms = (time.perf_counter() - t0) * 1e3
+    points, owners = ring.tokens()
+    eight = [i for i, s in enumerate(owners) if s == 0][:8]
+    t0 = time.perf_counter()
+    moved_large = cluster.reshard(ring.with_moves([(i, 1) for i in eight]))
+    reshard_large_ms = (time.perf_counter() - t0) * 1e3
+    per_small = reshard_small_ms / max(len(moved_small), 1)
+    per_large = reshard_large_ms / max(len(moved_large), 1)
+    log(f"config21[reshard]: index warm {index_warm_ms:.0f} ms; "
+        f"1 token = {len(moved_small)} names in "
+        f"{reshard_small_ms:.1f} ms ({per_small * 1e3:.1f} us/name), "
+        f"8 tokens = {len(moved_large)} names in {reshard_large_ms:.1f} ms "
+        f"({per_large * 1e3:.1f} us/name); post-move refresh "
+        f"{reshard_refresh_ms:.1f} ms")
+
+    # -- leg 4: storm throughput, static keyspace vs the ring ----------------
+    total_pods, window = 512, 128
+
+    def storm_leg(tag, layout):
+        plane = ShardedPlacementPlane(cluster, 4, overlap=0.0, layout=layout)
+        plane.add_scheduler(factory)
+        warm = [make_pods(f"w{tag}-{i}", window, cpu="100000")
+                for i in range(4)]
+        for res in plane.run_storm(warm, window=window, threaded=False):
+            assert all(r.node is None for r in res), "warm pod placed"
+        queues = [make_pods(f"s{tag}-{i}", total_pods // 4)
+                  for i in range(4)]
+        t0 = time.perf_counter()
+        results = plane.run_storm(queues, window=window, threaded=True)
+        wall_s = time.perf_counter() - t0
+        for i, res in enumerate(results):
+            for r in res:
+                assert r.node is not None, f"shard {i} unplaced: {r.reason}"
+        assert not plane.conflict_stats(), plane.conflict_stats()
+        return {
+            "pods": total_pods,
+            "wall_ms": round(wall_s * 1e3, 1),
+            "pods_per_sec": round(total_pods / wall_s, 1),
+        }
+
+    static_leg = storm_leg("st", None)
+    ring_leg = storm_leg("rg", HashRing(4, vnodes=64))
+    ring_vs_static = round(
+        ring_leg["pods_per_sec"] / static_leg["pods_per_sec"], 3)
+    log(f"config21[storm]: static {static_leg['pods_per_sec']:,.0f} pods/s "
+        f"vs ring {ring_leg['pods_per_sec']:,.0f} pods/s "
+        f"({ring_vs_static}x)")
+
+    # -- leg 5: mid-storm ring move over the wire stub -----------------------
+    kube_stub = _load_kube_stub()
+    stub_nodes, stub_pods = 4_000, 800
+    server = kube_stub.KubeStubSubprocess()
+    try:
+        server.seed(stub_nodes, "node-", metrics=metric_names,
+                    allocatable={"cpu": "16", "memory": "64Gi",
+                                 "ephemeral-storage": "100Gi",
+                                 "pods": "110"})
+        client = KubeClusterClient(server.url, list_page_limit=2000)
+        client.start()
+        assert len(client.list_nodes()) == stub_nodes
+        wire_ring = HashRing(2, vnodes=32)
+        wire_plane = ShardedPlacementPlane(client, 2, layout=wire_ring)
+        wire_plane.add_scheduler(factory)
+        half = stub_pods // 2
+        queues = []
+        for i in range(2):
+            pods = [
+                Pod(name=f"c21-{i}-{j:04d}", namespace="default",
+                    containers=(Container("c", ResourceRequirements(
+                        requests={"cpu": "100m", "memory": "128Mi"},
+                    )),))
+                for j in range(half)
+            ]
+            for pod in pods:
+                client.add_pod(pod)
+            queues.append(pods)
+        moved_mid: list = []
+
+        def move_mid_storm():
+            pts, own = wire_ring.tokens()
+            idx = next(i for i, s in enumerate(own) if s == 0)
+            moved_mid.extend(
+                wire_plane.reshard(wire_ring.with_moves([(idx, 1)])))
+
+        timer = threading.Timer(0.3, move_mid_storm)
+        timer.start()
+        results = wire_plane.run_storm(queues, window=16, threaded=True)
+        timer.join()
+        for i, res in enumerate(results):
+            for r in res:
+                assert r.node is not None, f"shard {i} unplaced: {r.reason}"
+        stats = server.stats()
+        assert stats["duplicate_binds"] == 0, "double-POSTed bind!"
+        assert stats["bind_posts"] == stub_pods, \
+            f"bind POSTs {stats['bind_posts']} != {stub_pods} pods"
+        assert moved_mid, "mid-storm ring move moved no names"
+        wire_conflicts = wire_plane.conflict_stats()
+        client.stop()
+    finally:
+        server.stop()
+    log(f"config21[wire]: {stub_pods} pods across a mid-storm ring move "
+        f"of {len(moved_mid)} nodes: conflicts {wire_conflicts or '{}'}, "
+        f"per-pod bind POST oracle ok")
+
+    emit({"config": 21,
+          "schedulers": 4,
+          "desc": "O(dirty) shard plane: 1M-node mirror on a "
+                  "consistent-hash ring, dirty-name journal refresh vs "
+                  "in-run identity sweep, per-moved-name resharding, "
+                  "mid-storm ring move over the wire",
+          "n_nodes": n_nodes,
+          "mirror_build_s": round(mirror_s, 1),
+          "column_build_ms": round(build_total * 1e3, 1),
+          "dirty_refresh_ms": round(dirty_ms, 3),
+          "untouched_refresh_ms": [round(s * 1e3, 3)
+                                   for s in refresh_s[1:]],
+          "identity_sweep_ms": round(sweep_ms, 1),
+          "dirty_speedup": round(speedup, 1),
+          "reshard": {
+              "index_warm_ms": round(index_warm_ms, 1),
+              "small": {"moved": len(moved_small),
+                        "ms": round(reshard_small_ms, 1),
+                        "us_per_name": round(per_small * 1e3, 2)},
+              "large": {"moved": len(moved_large),
+                        "ms": round(reshard_large_ms, 1),
+                        "us_per_name": round(per_large * 1e3, 2)},
+              "post_move_refresh_ms": round(reshard_refresh_ms, 1),
+          },
+          "storm": {"static": static_leg, "ring": ring_leg,
+                    "ring_vs_static": ring_vs_static},
+          "wire": {"nodes": stub_nodes, "pods": stub_pods,
+                   "moved_mid_storm": len(moved_mid),
+                   "outcomes": wire_conflicts},
+          "note": "gates: named-patch refresh of the owning shard <5 ms "
+                  "at 1M nodes and >=20x over the in-run identity "
+                  "sweep (journal coverage dropped, the pre-journal "
+                  "relist path); untouched shards <5 ms (fences never "
+                  "moved); dirty-patched columns bit-identical to a "
+                  "from-scratch rebuild; 8-token reshard per-moved-name "
+                  "cost <=3x the 1-token move's (migration bisects the "
+                  "moved arcs, never rehashes the table); ring storm "
+                  ">=0.9x static keyspace throughput; zero duplicate "
+                  "binding POSTs and bind_posts == pods across a "
+                  "mid-storm ring move"})
+    assert dirty_ms < 5.0, \
+        f"O(dirty) gate: patched shard refreshed in {dirty_ms:.2f} ms"
+    for i, s in enumerate(refresh_s[1:], start=1):
+        assert s < 0.005, \
+            f"O(dirty) gate: untouched shard {i} re-probed in " \
+            f"{s * 1e3:.1f} ms (fence must not have moved)"
+    assert speedup >= 20.0, \
+        f"O(dirty) gate: {speedup:.1f}x < 20x vs the identity sweep"
+    assert per_large <= per_small * 3.0, \
+        f"reshard gate: 8-token move {per_large * 1e3:.1f} us/name > " \
+        f"3x 1-token move {per_small * 1e3:.1f} us/name"
+    assert ring_vs_static >= 0.9, \
+        f"storm gate: ring keyspace {ring_vs_static}x < 0.9x static"
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--device", choices=["cpu", "default"], default="default")
     parser.add_argument(
         "--configs",
-        default="1,2,3,4,5,6,7,7b,8,9,10,11,12,13,14,15,16,17,18,19,20",
+        default="1,2,3,4,5,6,7,7b,8,9,10,11,12,13,14,15,16,17,18,19,20,21",
     )
     parser.add_argument("--f64", action="store_true")
     args = parser.parse_args(argv)
@@ -4096,6 +4435,8 @@ def main(argv=None) -> int:
         config19(dtype, rtt)
     if 20 in todo:
         config20(dtype, rtt)
+    if 21 in todo:
+        config21(dtype, rtt)
     if _METER is not None:
         _METER.stop()
     return 0

@@ -38,19 +38,74 @@ def _shard_mode(args, cluster):
     deterministic, so no two processes ever POST the same bind."""
     if args.shards <= 1:
         return cluster, None
-    from ..cluster.shards import ShardSpec, shard_of
+    from ..cluster.shards import HashRing, ShardSpec, shard_of
     from ..framework.shardplane import ShardView
 
-    cluster.configure_shards(args.shards, args.shard_overlap)
+    layout = None
+    ring_file = getattr(args, "shard_ring", None)
+    if ring_file:
+        with open(ring_file) as f:
+            layout = HashRing.from_spec(json.load(f))
+        if layout.count != args.shards:
+            raise SystemExit(
+                f"--shard-ring has {layout.count} shards, "
+                f"--shards says {args.shards}"
+            )
+    cluster.configure_shards(args.shards, args.shard_overlap,
+                             layout=layout)
     view = ShardView(
         cluster,
-        ShardSpec(args.shard_index, args.shards, args.shard_overlap),
+        ShardSpec(args.shard_index, args.shards, args.shard_overlap,
+                  layout=layout),
     )
 
     def pod_filter(key: str) -> bool:
         return shard_of(key, args.shards) == args.shard_index
 
     return view, pod_filter
+
+
+def _ring_sync(args, cluster):
+    """Serve-loop hook for ``--shard-ring``: re-read the ring file when
+    it changes and adopt any HIGHER-versioned layout via
+    ``cluster.reshard`` — the mirror journals every moved name as
+    membership-dirty, so the live view and its columns patch O(moved)
+    rows mid-storm without a restart. All cooperating processes poll
+    the same file; the version check makes adoption idempotent and
+    order-safe."""
+    ring_file = getattr(args, "shard_ring", None)
+    if not ring_file or args.shards <= 1:
+        return None
+    from ..cluster.shards import HashRing
+
+    last = {"mtime": os.path.getmtime(ring_file)}
+
+    def sync():
+        try:
+            mtime = os.path.getmtime(ring_file)
+        except OSError:
+            return  # mid-rename; next poll sees the new file
+        if mtime == last["mtime"]:
+            return
+        last["mtime"] = mtime
+        try:
+            with open(ring_file) as f:
+                target = HashRing.from_spec(json.load(f))
+        except (OSError, ValueError, KeyError):
+            return
+        live = cluster.shard_keyspace()
+        if live is not None and target.version > live.version:
+            moved = cluster.reshard(target)
+            print(
+                json.dumps({
+                    "event": "reshard",
+                    "ring_version": target.version,
+                    "moved_nodes": len(moved),
+                }),
+                flush=True,
+            )
+
+    return sync
 
 
 def _placement_mesh(args):
@@ -119,6 +174,7 @@ def _serve(args, cluster, config, policy, journal, recovery,
         cluster.attach_intent_journal(journal)
 
     sched_cluster, pod_filter = _shard_mode(args, cluster)
+    ring_sync = _ring_sync(args, cluster)
     sched = build_scheduler_from_config(
         sched_cluster, config, nrt_lister=cluster.nrt_lister,
         policy=policy, tie_break_seed=args.tie_break_seed,
@@ -156,6 +212,8 @@ def _serve(args, cluster, config, policy, journal, recovery,
     while not stop.is_set():
         if deadline is not None and time.monotonic() >= deadline:
             break
+        if ring_sync is not None:
+            ring_sync()
         live = cluster.list_pods()
         offered &= {p.key() for p in live}  # deleted pods may return
         progressed = 0
@@ -169,6 +227,12 @@ def _serve(args, cluster, config, policy, journal, recovery,
             progressed += 1
         _harvest()
         if not progressed:
+            if len(queue):
+                # idle flush: a half-filled window must not wait for
+                # more arrivals (or SIGTERM) — the tail of a burst
+                # schedules on the next quiet poll
+                queue.drain()
+                _harvest()
             stop.wait(0.05)
     # the drain: dispatch-or-flush whatever the signal interrupted
     drained = queue.drain()
@@ -256,6 +320,13 @@ def main(argv=None) -> int:
                         help="fraction of the keyspace co-owned with "
                              "the ring-successor shard (optimistic "
                              "conflict mode; 0 = disjoint)")
+    parser.add_argument("--shard-ring", default=None,
+                        help="consistent-hash ring spec (JSON file, "
+                             "HashRing.spec_dict format) replacing the "
+                             "static crc32 modulo keyspace; --serve "
+                             "polls the file and adopts higher-"
+                             "versioned layouts live (O(moved) "
+                             "migration; doc/sharding.md)")
     parser.add_argument("--window", type=int, default=32,
                         help="--serve: drip dispatch window size")
     parser.add_argument("--bind-watermark-pods", type=int, default=0,

@@ -2324,14 +2324,30 @@ class KubeClusterClient:
     def pod_changes_since(self, version: int):
         return self._mirror.pod_changes_since(version)
 
-    def configure_shards(self, count: int, overlap: float = 0.0) -> None:
-        self._mirror.configure_shards(count, overlap)
+    def configure_shards(self, count: int, overlap: float = 0.0,
+                         layout=None) -> None:
+        self._mirror.configure_shards(count, overlap, layout=layout)
 
     def shard_layout(self):
         return self._mirror.shard_layout()
 
+    def shard_keyspace(self):
+        return self._mirror.shard_keyspace()
+
+    def reshard(self, target):
+        return self._mirror.reshard(target)
+
     def shard_versions(self, index: int) -> tuple[int, int, int]:
         return self._mirror.shard_versions(index)
+
+    def dirty_nodes_since(self, version: int, shard: int | None = None):
+        return self._mirror.dirty_nodes_since(version, shard)
+
+    def dirty_journal_stats(self) -> dict[str, int]:
+        return self._mirror.dirty_journal_stats()
+
+    def has_node(self, name: str) -> bool:
+        return self._mirror.has_node(name)
 
     def list_nodes(self):
         return self._mirror.list_nodes()

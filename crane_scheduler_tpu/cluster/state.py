@@ -17,6 +17,7 @@ this interface.
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from collections import deque
@@ -151,10 +152,55 @@ class _PodBurst:
         return pod
 
 
+class _DirtyJournal:
+    """Bounded (version, name, membership) journal keyed on a node
+    fence. ``since(v)`` replays the tail: the set of names written
+    after ``v`` plus a membership-changed flag, or ``None`` when the
+    interval is not covered — a name-less bulk write (relist, columnar
+    sweep) reset the floor, the deque overran its cap, or ``v``
+    predates the journal. ``None`` costs the caller exactly ONE
+    identity sweep; every covered interval is O(dirty)."""
+
+    __slots__ = ("log", "floor", "overruns", "bulk_marks")
+
+    def __init__(self, cap: int, floor: int = 0):
+        self.log: deque[tuple[int, str, bool]] = deque(maxlen=cap)
+        self.floor = floor  # versions < floor are NOT covered
+        self.overruns = 0  # cap evictions (bounded-journal overflow)
+        self.bulk_marks = 0  # name-less bulk writes (relist / sweep)
+
+    def note(self, version: int, name: str, membership: bool = False) -> None:
+        log = self.log
+        if len(log) == log.maxlen:
+            evicted = log[0][0]
+            if evicted > self.floor:
+                self.floor = evicted
+            self.overruns += 1
+        log.append((version, name, membership))
+
+    def mark_bulk(self, version: int) -> None:
+        if version > self.floor:
+            self.floor = version
+        self.bulk_marks += 1
+        self.log.clear()
+
+    def since(self, version: int):
+        if version < self.floor:
+            return None
+        names: set[str] = set()
+        membership = False
+        for v, name, m in self.log:
+            if v > version:
+                names.add(name)
+                if m:
+                    membership = True
+        return (names, membership)
+
+
 class ClusterState:
     """Thread-safe cluster model with event subscription."""
 
-    def __init__(self, max_events: int = 4096):
+    def __init__(self, max_events: int = 4096, dirty_journal_cap: int = 4096):
         self._lock = threading.RLock()
         self._nodes: dict[str, Node] = {}
         # Lazy annotation overlay: columnar patches append SEGMENTS —
@@ -234,6 +280,26 @@ class ClusterState:
         self._shard_pod: list[int] = []
         self._shard_node: list[int] = []
         self._shard_owner_cache: dict[str, tuple[int, ...]] = {}
+        # dirty-name journals (O(dirty) refresh): every NAMED node write
+        # appends (node_fence_after, name, membership?) to the global
+        # journal and — when a shard layout is configured — to each
+        # observing shard's journal; name-less bulk writes reset the
+        # floor instead. Consumers (store ingest, FitTracker,
+        # DripColumns, the device column cache) replay the tail to
+        # patch only dirty rows; an uncovered interval costs exactly
+        # one identity sweep (counted via overruns/bulk_marks).
+        self._dirty_cap = int(dirty_journal_cap)
+        self._dirty_global = _DirtyJournal(self._dirty_cap)
+        self._shard_dirty: list[_DirtyJournal] = []
+        # pluggable shard keyspace (None = static crc32 modulo): a
+        # HashRing here makes ownership dynamic — reshard() migrates
+        # only the moved names' rows (membership-dirty journal entries
+        # on both the old and the new owner's journals).
+        self._shard_keyspace = None
+        # sorted (crc32, name) index over the node table, built lazily
+        # for ring resharding: moved names are found by bisecting the
+        # moved arcs instead of re-hashing the whole name set.
+        self._crc_index: tuple[list[int], list[str]] | None = None
 
     @property
     def sched_version(self) -> int:
@@ -267,23 +333,43 @@ class ClusterState:
 
     # -- per-shard watch fences (sharded placement plane) ------------------
 
-    def configure_shards(self, count: int, overlap: float = 0.0) -> None:
+    def configure_shards(
+        self, count: int, overlap: float = 0.0, layout=None
+    ) -> None:
         """Enable per-shard version fences for a ``count``-way node
-        partition (``cluster.shards.shard_owners`` ownership). Each
-        shard's (sched, pod, node) counters start at the global values
-        and from then on move only when a write touches a node that
-        shard observes — the O(dirty) refresh gate for N concurrent
-        drip schedulers. Reconfiguring resets the fences."""
+        partition (``cluster.shards.shard_owners`` ownership, or a
+        ``layout`` object — e.g. ``shards.HashRing`` — answering
+        ``owners(name)``). Each shard's (sched, pod, node) counters
+        start at the global values and from then on move only when a
+        write touches a node that shard observes — the O(dirty)
+        refresh gate for N concurrent drip schedulers. Reconfiguring
+        resets the fences and the dirty journals."""
         from .shards import shard_owners  # noqa: F401  (validates import)
 
         if count < 1:
             raise ValueError(f"shard count must be >= 1, got {count}")
+        if layout is not None and layout.count != count:
+            raise ValueError(
+                f"layout has {layout.count} shards, expected {count}"
+            )
         with self._lock:
             self._shard_layout = (int(count), float(overlap))
+            self._shard_keyspace = layout
             self._shard_sched = [self._sched_version] * count
             self._shard_pod = [self._pod_version] * count
             self._shard_node = [self._node_version] * count
             self._shard_owner_cache = {}
+            # fresh journals: nothing before the current fence is covered
+            self._shard_dirty = [
+                _DirtyJournal(self._dirty_cap, floor=self._node_version)
+                for _ in range(count)
+            ]
+
+    def shard_keyspace(self):
+        """The pluggable keyspace object (``shards.HashRing``), or None
+        when ownership is the static crc32 modulo."""
+        with self._lock:
+            return self._shard_keyspace
 
     def shard_layout(self) -> tuple[int, float] | None:
         with self._lock:
@@ -301,7 +387,8 @@ class ClusterState:
                     self._shard_node[index])
 
     def _bump_shards_locked(
-        self, name: str | None, pod: bool = False, node: bool = False
+        self, name: str | None, pod: bool = False, node: bool = False,
+        member: bool = False,
     ) -> None:
         layout = self._shard_layout
         if layout is None:
@@ -312,9 +399,12 @@ class ClusterState:
         else:
             owners = self._shard_owner_cache.get(name)  # type: ignore[assignment]
             if owners is None:
-                from .shards import shard_owners
+                if self._shard_keyspace is not None:
+                    owners = self._shard_keyspace.owners(name)
+                else:
+                    from .shards import shard_owners
 
-                owners = shard_owners(name, count, overlap)
+                    owners = shard_owners(name, count, overlap)
                 cache = self._shard_owner_cache
                 if len(cache) > 2_000_000:  # churn backstop
                     cache.clear()
@@ -325,6 +415,12 @@ class ClusterState:
                 self._shard_pod[s] += 1
             if node:
                 self._shard_node[s] += 1
+                if name is None:
+                    self._shard_dirty[s].mark_bulk(self._shard_node[s])
+                else:
+                    self._shard_dirty[s].note(
+                        self._shard_node[s], name, member
+                    )
 
     def pod_changes_since(self, version: int):
         """Node names with bound-pod changes after ``version``, or None
@@ -336,6 +432,163 @@ class ClusterState:
             return {
                 name for v, name in self._pod_change_log if v > version
             }
+
+    def dirty_nodes_since(self, version: int, shard: int | None = None):
+        """Replay the dirty-name journal: ``(names, membership_changed)``
+        for node writes after node-fence ``version``, or None when the
+        interval is not covered (bulk relist/sweep, journal overrun, or
+        a pre-journal version) — the caller then does exactly one
+        identity sweep. ``shard`` selects the per-shard journal (keyed
+        on that shard's node fence) when a layout is configured."""
+        with self._lock:
+            if shard is not None and self._shard_layout is not None:
+                return self._shard_dirty[shard].since(version)
+            return self._dirty_global.since(version)
+
+    def dirty_journal_stats(self) -> dict:
+        """Aggregate journal health for telemetry: cap overruns,
+        name-less bulk floor resets, and current/max depth."""
+        with self._lock:
+            js = [self._dirty_global] + list(self._shard_dirty)
+            return {
+                "overruns": sum(j.overruns for j in js),
+                "bulk_marks": sum(j.bulk_marks for j in js),
+                "depth": max(len(j.log) for j in js),
+                "cap": self._dirty_cap,
+            }
+
+    def forget_dirty_names(self) -> None:
+        """Drop dirty-name coverage exactly as a name-less bulk write
+        (relist / columnar sweep) does: every journal's floor moves to
+        its current fence, so the NEXT consumer refresh pays the one
+        identity-sweep fallback. Bench/test hook for measuring that
+        fallback against the O(dirty) path in the same process."""
+        with self._lock:
+            self._dirty_global.mark_bulk(self._node_version)
+            for s, j in enumerate(self._shard_dirty):
+                j.mark_bulk(self._shard_node[s])
+
+    def _note_dirty_locked(
+        self, version: int, name: str, member: bool = False
+    ) -> None:
+        self._dirty_global.note(version, name, member)
+
+    # -- dynamic resharding (consistent-hash ring keyspace) ----------------
+
+    def _ensure_crc_index_locked(self):
+        """Sorted (crc32, name) parallel lists over the node table —
+        built once (O(n log n)), then maintained incrementally by
+        add/delete while a ring keyspace is active, so a reshard finds
+        the names inside the moved arcs by bisecting instead of
+        re-hashing every name."""
+        idx = self._crc_index
+        if idx is None:
+            from .shards import name_point
+
+            pairs = sorted(
+                (name_point(name), name) for name in self._nodes
+            )
+            idx = ([p for p, _ in pairs], [n for _, n in pairs])
+            self._crc_index = idx
+        return idx
+
+    def _crc_index_add_locked(self, name: str) -> None:
+        idx = self._crc_index
+        if idx is None:
+            return
+        from .shards import name_point
+
+        point = name_point(name)
+        crcs, names = idx
+        i = bisect.bisect_left(crcs, point)
+        # same-crc collisions: keep names sorted within the run so
+        # add/remove agree on position
+        while i < len(crcs) and crcs[i] == point and names[i] < name:
+            i += 1
+        if i < len(crcs) and crcs[i] == point and names[i] == name:
+            return
+        crcs.insert(i, point)
+        names.insert(i, name)
+
+    def _crc_index_remove_locked(self, name: str) -> None:
+        idx = self._crc_index
+        if idx is None:
+            return
+        from .shards import name_point
+
+        point = name_point(name)
+        crcs, names = idx
+        i = bisect.bisect_left(crcs, point)
+        while i < len(crcs) and crcs[i] == point:
+            if names[i] == name:
+                del crcs[i]
+                del names[i]
+                return
+            i += 1
+
+    def _names_in_arcs_locked(self, arcs) -> list[str]:
+        """Names whose hash lies inside any ``(lo, hi]`` ring arc
+        (lo > hi wraps around zero)."""
+        crcs, names = self._ensure_crc_index_locked()
+        out: list[str] = []
+        for lo, hi in arcs:
+            if lo <= hi:
+                a = bisect.bisect_right(crcs, lo)
+                b = bisect.bisect_right(crcs, hi)
+                out.extend(names[a:b])
+            else:  # wraparound arc
+                a = bisect.bisect_right(crcs, lo)
+                out.extend(names[a:])
+                b = bisect.bisect_right(crcs, hi)
+                out.extend(names[:b])
+        return out
+
+    def reshard(self, target) -> list[str]:
+        """Swap the ring keyspace for ``target`` (a ``shards.HashRing``
+        with the same shard count), migrating ONLY the moved names:
+        each name whose observation set changed gets a membership-dirty
+        journal entry (and fence bumps) on both its old and new owners'
+        journals, so incremental consumers add/drop exactly those rows
+        — full-sweep invalidation never fires. The live ring object is
+        updated in place (atomic state swap), so every ShardView /
+        ShardSpec holding it re-reads the new ownership immediately.
+        Returns the moved names."""
+        with self._lock:
+            ring = self._shard_keyspace
+            if ring is None or self._shard_layout is None:
+                raise ValueError(
+                    "reshard requires a HashRing keyspace "
+                    "(configure_shards(..., layout=HashRing(...)))"
+                )
+            if target.count != ring.count:
+                raise ValueError(
+                    f"reshard cannot change the shard count in place "
+                    f"({ring.count} -> {target.count}); reconfigure the "
+                    f"plane instead"
+                )
+            arcs = ring.moved_arcs(target)
+            candidates = self._names_in_arcs_locked(arcs)
+            cache = self._shard_owner_cache
+            moved: list[str] = []
+            for name in candidates:
+                old_owners = ring.owners(name)
+                new_owners = target.owners(name)
+                if old_owners == new_owners:
+                    continue
+                moved.append(name)
+                cache.pop(name, None)
+                touched = set(old_owners) | set(new_owners)
+                self._sched_version += 1
+                self._node_version += 1
+                self._note_dirty_locked(self._node_version, name, True)
+                for s in touched:
+                    self._shard_sched[s] += 1
+                    self._shard_node[s] += 1
+                    self._shard_dirty[s].note(
+                        self._shard_node[s], name, True
+                    )
+            ring.adopt(target)
+            return moved
 
     @property
     def node_set_version(self) -> int:
@@ -424,7 +677,11 @@ class ClusterState:
             self._nodes[node.name] = node
             self._sched_version += 1
             self._node_version += 1
-            self._bump_shards_locked(node.name, node=True)
+            member = prev is None
+            self._note_dirty_locked(self._node_version, node.name, member)
+            self._bump_shards_locked(node.name, node=True, member=member)
+            if member:
+                self._crc_index_add_locked(node.name)
             # annotation-only updates (e.g. a kube mirror echoing the
             # annotator's own patches as MODIFIED events) must not defeat
             # (name, ip) pair caches keyed on node_set_version
@@ -435,15 +692,19 @@ class ClusterState:
 
     def delete_node(self, name: str) -> None:
         with self._lock:
-            if name in self._nodes:
+            existed = name in self._nodes
+            if existed:
                 self._note_pod_change_locked(name)
             self._nodes.pop(name, None)
             self._drop_overlay_locked(name)
             self._sched_version += 1
             self._node_version += 1
+            self._note_dirty_locked(self._node_version, name, existed)
             self._node_set_version += 1
-            self._bump_shards_locked(name, node=True)
+            self._bump_shards_locked(name, node=True, member=existed)
             self._shard_owner_cache.pop(name, None)
+            if existed:
+                self._crc_index_remove_locked(name)
 
     def get_node(self, name: str) -> Node | None:
         with self._lock:
@@ -471,6 +732,13 @@ class ClusterState:
         with self._lock:
             return list(self._nodes)
 
+    def has_node(self, name: str) -> bool:
+        """Membership test without materializing the node (the dirty
+        journal's add/remove classifier; a ShardView overrides this
+        with ring observation)."""
+        with self._lock:
+            return name in self._nodes
+
     # -- bulk transactions (relist / coalesced watch apply) ----------------
     #
     # The kube mirror's read path lands whole relists and drained watch
@@ -483,26 +751,32 @@ class ClusterState:
     # when any changed), the second journals per-node changes for the
     # incremental NUMA path, which needs every entry.
 
-    def _apply_node_change_locked(self, change_type: str, node: Node) -> bool:
+    def _apply_node_change_locked(self, change_type: str, node: Node):
         """One watch-shaped node change (caller holds the lock). Returns
-        True when the node SET (membership/addresses) changed."""
+        ``(set_changed, member)``: whether the node SET (membership or
+        addresses) changed, and whether the NAME set changed (the
+        narrower membership bit the dirty journal carries)."""
         name = node.name
         if change_type == "DELETED":
-            if name in self._nodes:
+            existed = name in self._nodes
+            if existed:
                 self._note_pod_change_locked(name)
+                self._crc_index_remove_locked(name)
             self._nodes.pop(name, None)
             self._drop_overlay_locked(name)
             self._sched_version += 1
-            self._bump_shards_locked(name, node=True)
-            return True
+            self._bump_shards_locked(name, node=True, member=existed)
+            return True, existed
         prev = self._nodes.get(name)
         self._drop_overlay_locked(name)
         self._nodes[name] = node
         self._sched_version += 1
-        self._bump_shards_locked(name, node=True)
-        if prev is None:
+        member = prev is None
+        self._bump_shards_locked(name, node=True, member=member)
+        if member:
             self._note_pod_change_locked(name)
-        return prev is None or prev.addresses != node.addresses
+            self._crc_index_add_locked(name)
+        return member or prev.addresses != node.addresses, member
 
     def apply_node_changes(self, changes) -> None:
         """Coalesced watch apply: an ordered batch of ``(change_type,
@@ -511,12 +785,20 @@ class ClusterState:
         with self._lock:
             v0 = self._sched_version
             set_changed = False
+            dirty: list[tuple[str, bool]] = []
             for change_type, node in changes:
-                if self._apply_node_change_locked(change_type, node):
+                changed, member = self._apply_node_change_locked(
+                    change_type, node
+                )
+                if changed:
                     set_changed = True
+                dirty.append((node.name, member))
             if self._sched_version > v0:
                 self._sched_version = v0 + 1
                 self._node_version += 1
+                v = self._node_version
+                for name, member in dirty:
+                    self._note_dirty_locked(v, name, member)
             if set_changed:
                 self._node_set_version += 1
 
@@ -569,6 +851,9 @@ class ClusterState:
             self._nodes = new
             self._sched_version += 1
             self._node_version += 1
+            self._dirty_global.mark_bulk(self._node_version)
+            if set_changed:
+                self._crc_index = None  # rebuilt lazily at next reshard
             self._bump_shards_locked(None, node=True)  # relist: all fences
             if set_changed:
                 self._node_set_version += 1
@@ -605,6 +890,7 @@ class ClusterState:
             self._nodes[name] = replace(node, annotations=anno)
             self._sched_version += 1
             self._node_version += 1
+            self._note_dirty_locked(self._node_version, name)
             self._bump_shards_locked(name, node=True)
             return True
 
@@ -617,6 +903,7 @@ class ClusterState:
         with self._lock:
             nodes = self._nodes
             has_overlay = bool(self._anno_segments)
+            patched_names: list[str] = []
             for name, kv in per_node.items():
                 node = nodes.get(name)
                 if node is None:
@@ -637,8 +924,12 @@ class ClusterState:
                 self._sched_version += 1
                 self._bump_shards_locked(name, node=True)
                 patched += 1
+                patched_names.append(name)
             if patched:
                 self._node_version += 1
+                v = self._node_version
+                for name in patched_names:
+                    self._note_dirty_locked(v, name)
         return patched
 
     def patch_node_annotations_columns(
@@ -668,6 +959,9 @@ class ClusterState:
                     self._fold_overlay_locked()
             self._sched_version += len(names)
             self._node_version += 1
+            # the sweep rewrites every listed row — journal coverage
+            # would be the whole shard, so reset the floor instead
+            self._dirty_global.mark_bulk(self._node_version)
             self._bump_shards_locked(None, node=True)  # sweep: all fences
         return len(names)
 

@@ -159,7 +159,13 @@ class FitTracker:
         self._scalar_req: dict[str, dict[str, int]] = {}
         self._full_recounts = 0
         self._incremental_recounts = 0
+        self._node_patches = 0  # journal-driven O(dirty) node refreshes
         self._req_dirty = True  # requested columns not yet counted
+        # bumps only when capacity state actually moved (membership or
+        # an allocatable row) — annotation patches bump node_version
+        # without touching it, so free_matrix consumers can skip the
+        # O(n) aligned copy entirely
+        self.alloc_version = 0
         # name->row gathers cached per (names list identity, index
         # epoch): the drip column cache, the gang solver's capacity rows
         # and the descheduler's landing mask all re-pass the SAME list
@@ -179,6 +185,12 @@ class FitTracker:
                 "crane_fit_tracked_nodes",
                 "Nodes with reported allocatable under fit accounting.",
             )
+            self._m_dirty_rows = reg.counter(
+                "crane_dirty_rows_total",
+                "Rows patched via the dirty-name journal instead of a "
+                "full identity sweep, by consumer",
+                ("consumer",),
+            )
 
     # -- refresh -----------------------------------------------------------
 
@@ -188,7 +200,17 @@ class FitTracker:
             nv = self._cluster.node_version
             pv = self._cluster.pod_version
             if nv != self._node_ver:
-                self._rebuild_nodes_locked()
+                dirty = None
+                if self._names and self._node_ver >= 0:
+                    fn = getattr(self._cluster, "dirty_nodes_since", None)
+                    if fn is not None:
+                        dirty = fn(self._node_ver)
+                if dirty is not None and not dirty[1]:
+                    # journal-covered, membership unchanged: identity-
+                    # check only the dirty names instead of every node
+                    self._patch_nodes_locked(dirty[0])
+                else:
+                    self._rebuild_nodes_locked()
                 self._node_ver = nv
             if not self._has_alloc.any():
                 # nothing bounded: requested sums can't matter, so skip
@@ -222,6 +244,69 @@ class FitTracker:
             self._req_dirty = False
             self._pod_ver = pv
 
+    def _patch_nodes_locked(self, touched) -> None:
+        """O(dirty) twin of ``_rebuild_nodes_locked``: membership is
+        unchanged, so only the journal's dirty names can have a new
+        allocatable object."""
+        if not touched:
+            return
+        index = self._index
+        get_node = self._cluster.get_node
+        changed = 0
+        for name in touched:
+            i = index.get(name)
+            if i is None:
+                continue  # another shard's write (global journal)
+            node = get_node(name)
+            if node is None:
+                continue
+            if self._apply_alloc_locked(name, i, node):
+                changed += 1
+        self._node_patches += 1
+        if self._telemetry is not None:
+            self._m_dirty_rows.labels(consumer="fit").inc(len(touched))
+        if changed:
+            self.alloc_version += 1
+            if self._telemetry is not None:
+                self._m_nodes.set(int(self._has_alloc.sum()))
+
+    def _apply_alloc_locked(self, name: str, i: int, node) -> bool:
+        """Identity-gated allocatable row update for one node; returns
+        True when the row actually changed."""
+        amap = getattr(node, "allocatable", None) or None
+        prev = self._alloc_maps.get(name)
+        if amap is prev:
+            return False  # annotation fold kept the same allocatable object
+        if amap is None:
+            self._alloc_maps.pop(name, None)
+            self._scalar_alloc.pop(name, None)
+            self._has_alloc[i] = False
+            return True
+        self._alloc_maps[name] = amap
+        row = self._alloc[i]
+        row[:] = 0
+        # kubelet always reports "pods"; a fixture that omits it
+        # means "don't model pod count" — fail open on that dim only
+        row[_DIM_PODS] = UNBOUNDED
+        scalars: dict[str, int] = {}
+        for key, quantity in amap.items():
+            if key == CPU:
+                row[_DIM_CPU] = to_milli(quantity)
+            elif key == MEMORY:
+                row[_DIM_MEM] = to_value(quantity)
+            elif key == EPHEMERAL_STORAGE:
+                row[_DIM_EPH] = to_value(quantity)
+            elif key == PODS:
+                row[_DIM_PODS] = to_value(quantity)
+            else:
+                scalars[key] = to_value(quantity)
+        if scalars:
+            self._scalar_alloc[name] = scalars
+        else:
+            self._scalar_alloc.pop(name, None)
+        self._has_alloc[i] = True
+        return True
+
     def _rebuild_nodes_locked(self) -> None:
         nodes = self._cluster.list_nodes()
         names = [n.name for n in nodes]
@@ -248,42 +333,16 @@ class FitTracker:
             self._alloc_maps = {}
             self._index_ver += 1
             self._aligned.clear()
+            self.alloc_version += 1  # membership moved the capacity rows
             if not self._req_dirty:
                 for name, i in stale:
                     self._recount_node_locked(name, i)
+        changed = 0
         for i, node in enumerate(nodes):
-            amap = getattr(node, "allocatable", None) or None
-            prev = self._alloc_maps.get(node.name)
-            if amap is prev:
-                continue  # annotation fold kept the same allocatable object
-            if amap is None:
-                self._alloc_maps.pop(node.name, None)
-                self._scalar_alloc.pop(node.name, None)
-                self._has_alloc[i] = False
-                continue
-            self._alloc_maps[node.name] = amap
-            row = self._alloc[i]
-            row[:] = 0
-            # kubelet always reports "pods"; a fixture that omits it
-            # means "don't model pod count" — fail open on that dim only
-            row[_DIM_PODS] = UNBOUNDED
-            scalars: dict[str, int] = {}
-            for key, quantity in amap.items():
-                if key == CPU:
-                    row[_DIM_CPU] = to_milli(quantity)
-                elif key == MEMORY:
-                    row[_DIM_MEM] = to_value(quantity)
-                elif key == EPHEMERAL_STORAGE:
-                    row[_DIM_EPH] = to_value(quantity)
-                elif key == PODS:
-                    row[_DIM_PODS] = to_value(quantity)
-                else:
-                    scalars[key] = to_value(quantity)
-            if scalars:
-                self._scalar_alloc[node.name] = scalars
-            else:
-                self._scalar_alloc.pop(node.name, None)
-            self._has_alloc[i] = True
+            if self._apply_alloc_locked(node.name, i, node):
+                changed += 1
+        if changed:
+            self.alloc_version += 1
         if self._telemetry is not None:
             self._m_nodes.set(int(self._has_alloc.sum()))
 
@@ -485,5 +544,7 @@ class FitTracker:
                 "bounded_nodes": int(self._has_alloc.sum()),
                 "full_recounts": self._full_recounts,
                 "incremental_recounts": self._incremental_recounts,
+                "node_patches": self._node_patches,
+                "alloc_version": self.alloc_version,
                 "mask_builds": self.mask_builds,
             }

@@ -99,6 +99,8 @@ class DripColumns:
         # tracker's aligned-row gather, so the list object is only
         # replaced when membership/order actually changes
         self.names: list[str] = []
+        self._names_set: set[str] = set()
+        self._pos: dict[str, int] | None = None  # name -> row (lazy)
         self._node_ver = -1  # cluster.node_version the ingest reflects
 
         # dynamic columns (aligned with self.names)
@@ -108,10 +110,25 @@ class DripColumns:
         self.schedulable: np.ndarray | None = None  # bool [N]
         self.fail_entry: np.ndarray | None = None  # int32 [N]
         self.weighted: np.ndarray | None = None  # int64 [N]
+        # dirty-journal bookkeeping: rows touched since the last dynamic
+        # column build (None = coverage lost, next build is full), and a
+        # monotonically increasing column epoch + bounded scatter log so
+        # the device column cache can scatter exactly the patched rows
+        # instead of re-uploading the shard (in-place patches keep array
+        # identity; the epoch is the version the identity key can't be)
+        self._pending_rows: set[int] | None = set()
+        self.col_epoch = 0
+        self._scatter_log: list[tuple[int, np.ndarray]] = []  # (to_epoch, rows)
+        self._SCATTER_LOG_CAP = 64
 
-        # fit columns (aligned with self.names; free is OUR copy)
+        # fit columns (aligned with self.names; free is OUR copy).
+        # Keyed on the tracker's alloc_version, not node_version: an
+        # annotation patch bumps the node fence but cannot change
+        # allocatable capacity, so the O(n) free_matrix copy is skipped
+        # unless capacity rows actually moved.
         self._fit_pod_ver = -1
-        self._fit_node_ver = -1
+        self._fit_alloc_ver = -1
+        self._fit_names = None  # names list identity the fit rows align to
         self.bounded: np.ndarray | None = None  # bool [N]
         self.free: np.ndarray | None = None  # int64 [N, 4]
 
@@ -125,8 +142,9 @@ class DripColumns:
         self.stats = {
             "hits": 0, "rebuilds": 0, "folds": 0, "drops": 0,
             "topk_builds": 0, "topk_updates": 0,
+            "dirty_patches": 0, "dirty_rows": 0, "full_sweeps": 0,
         }
-        self._m_hits = self._m_rebuilds = None
+        self._m_hits = self._m_rebuilds = self._m_dirty_rows = None
         if telemetry is not None:
             reg = telemetry.registry
             self._m_hits = reg.counter(
@@ -138,26 +156,39 @@ class DripColumns:
                 "Drip column rebuilds by column family",
                 ("column",),
             )
+            self._m_dirty_rows = reg.counter(
+                "crane_dirty_rows_total",
+                "Rows patched via the dirty-name journal instead of a "
+                "full identity sweep, by consumer",
+                ("consumer",),
+            )
 
     # -- cache maintenance -------------------------------------------------
 
     def ensure(self, now: float) -> None:
-        """Bring every column up to date for scheduling time ``now``."""
+        """Bring every column up to date for scheduling time ``now``.
+
+        Named-write fast path: when the cluster's dirty-name journal
+        covers the interval since the last ingest, only the dirty
+        names' store rows re-parse and only their column rows recompute
+        (scattered in place, logged for the device-side scatter) — a
+        1-node annotation patch is O(1) work however large the shard.
+        Journal overruns, bulk relists, clock-bucket rolls, and
+        membership changes the journal can't localize fall back to
+        exactly one identity sweep (counted in ``full_sweeps``)."""
         rebuilt = False
         cluster = self.cluster
         nv = cluster.node_version
         if nv != self._node_ver:
-            nodes = cluster.list_nodes()
-            names = [n.name for n in nodes]
-            # identity-gated: unchanged annotation maps are skipped, so
-            # an annotator sweep costs O(changed rows), not O(nodes)
-            self._store.bulk_ingest((n.name, n.annotations) for n in nodes)
-            if len(self._store) != len(names):
-                self._store.prune_absent(names)
-            if names != self.names:
-                self.names = names
-                self._gather = None
-                self._fit_node_ver = -1  # fit rows must realign
+            dirty = None
+            if self.names and self._node_ver >= 0:
+                fn = getattr(cluster, "dirty_nodes_since", None)
+                if fn is not None:
+                    dirty = fn(self._node_ver)
+            if dirty is not None and not self._apply_dirty(dirty, cluster):
+                dirty = None
+            if dirty is None:
+                self._full_ingest(cluster)
             self._node_ver = nv
         bucket = int(now / self._bucket_s) if self._bucket_s > 0 else 0
         sv = self._store.version
@@ -166,10 +197,22 @@ class DripColumns:
             or sv != self._store_ver
             or bucket != self._bucket
         ):
+            pending = self._pending_rows
+            incremental = (
+                self.weighted is not None
+                and bucket == self._bucket
+                and pending is not None
+                and self._gather is not None
+                and self._gather[0] == self._store.layout_version
+            )
             with maybe_span(
                 self._telemetry, "drip_column_rebuild", column="dynamic"
             ):
-                self._rebuild_dynamic(now)
+                if incremental:
+                    self._patch_dynamic(pending, now)
+                else:
+                    self._rebuild_dynamic(now)
+            self._pending_rows = set()
             self._store_ver = sv
             self._bucket = bucket
             rebuilt = True
@@ -178,30 +221,163 @@ class DripColumns:
                 self._m_rebuilds.labels(column="dynamic").inc()
         if self._tracker is not None:
             pv = cluster.pod_version
-            if (
+            stale = (
                 self.free is None
                 or pv != self._fit_pod_ver
                 or nv != self._fit_node_ver
-            ):
+            )
+            if stale:
                 with maybe_span(
                     self._telemetry, "drip_column_rebuild", column="fit"
                 ):
                     self._tracker.refresh()
-                    self.bounded, self.free = self._tracker.free_matrix(
-                        self.names
-                    )
-                self._fit_pod_ver = pv
-                self._fit_node_ver = nv
-                rebuilt = True
-                self.stats["rebuilds"] += 1
-                if self._m_rebuilds is not None:
-                    self._m_rebuilds.labels(column="fit").inc()
+                    av = getattr(self._tracker, "alloc_version", None)
+                    if (
+                        self.free is not None
+                        and av is not None
+                        and av == self._fit_alloc_ver
+                        and pv == self._fit_pod_ver
+                        and self._fit_names is self.names
+                    ):
+                        # capacity rows and bound-pod state are both
+                        # unchanged (an annotation patch moved the node
+                        # fence): the aligned copies are still exact
+                        self._fit_node_ver = nv
+                    else:
+                        self.bounded, self.free = self._tracker.free_matrix(
+                            self.names
+                        )
+                        self._fit_pod_ver = pv
+                        self._fit_node_ver = nv
+                        self._fit_alloc_ver = av if av is not None else -1
+                        self._fit_names = self.names
+                        rebuilt = True
+                        self.stats["rebuilds"] += 1
+                        if self._m_rebuilds is not None:
+                            self._m_rebuilds.labels(column="fit").inc()
         if not rebuilt:
             self.stats["hits"] += 1
             if self._m_hits is not None:
                 self._m_hits.inc()
 
-    def _rebuild_dynamic(self, now: float) -> None:
+    def _full_ingest(self, cluster) -> None:
+        """The identity sweep: list every node, identity-gate every
+        row. Exactly one of these per uncovered journal interval."""
+        nodes = cluster.list_nodes()
+        names = [n.name for n in nodes]
+        # identity-gated: unchanged annotation maps are skipped, so
+        # an annotator sweep costs O(changed rows), not O(nodes)
+        self._store.bulk_ingest((n.name, n.annotations) for n in nodes)
+        if len(self._store) != len(names):
+            self._store.prune_absent(names)
+        if names != self.names:
+            self.names = names
+            self._names_set = set(names)
+            self._pos = None
+            self._gather = None
+            self._fit_node_ver = -1  # fit rows must realign
+            self._fit_names = None
+        # charge the name->row map to the sweep (already O(n)), not to
+        # the first O(dirty) patch that would otherwise lazily build it
+        self._pos_map()
+        self._pending_rows = None  # row set unknown: next build is full
+        self.stats["full_sweeps"] += 1
+
+    def _pos_map(self) -> dict[str, int]:
+        pos = self._pos
+        if pos is None:
+            pos = self._pos = {n: i for i, n in enumerate(self.names)}
+        return pos
+
+    def _apply_dirty(self, dirty, cluster) -> bool:
+        """Consume a covered journal interval: re-ingest only the dirty
+        names' rows (and under a membership change — node churn or a
+        ring reshard — add/drop exactly the moved names). Returns False
+        when the delta can't be applied locally and the caller must run
+        the identity sweep."""
+        touched, membership = dirty
+        if not touched:
+            return True
+        get_node = cluster.get_node
+        names_set = self._names_set
+        if not membership:
+            items = []
+            for nm in touched:
+                if nm not in names_set:
+                    continue  # another shard's write (global journal)
+                node = get_node(nm)
+                if node is None:
+                    return False  # membership drifted without the flag
+                items.append((nm, node.annotations))
+            if items:
+                self._note_dirty_rows(items)
+            return True
+        # membership delta: classify each touched name against the
+        # cluster's CURRENT membership (a ShardView answers has_node
+        # by ring observation, so reshard moves land here)
+        has = getattr(cluster, "has_node", None)
+        if has is None:
+            return False
+        adds: list[str] = []
+        removes: list[str] = []
+        patch: list[str] = []
+        for nm in touched:
+            present = has(nm)
+            if present and nm not in names_set:
+                adds.append(nm)
+            elif not present and nm in names_set:
+                removes.append(nm)
+            elif present:
+                patch.append(nm)
+        items = []
+        for nm in adds + patch:
+            node = get_node(nm)
+            if node is None:
+                return False
+            items.append((nm, node.annotations))
+        if not adds and not removes:
+            if items:
+                self._note_dirty_rows(items)
+            return True
+        for nm in removes:
+            self._store.remove_node(nm)
+        if items:
+            self._store.bulk_ingest(items, skip_unchanged=False)
+            self.stats["dirty_rows"] += len(items)
+            if self._m_dirty_rows is not None:
+                self._m_dirty_rows.labels(consumer="drip").inc(len(items))
+        # splice the names list in place of a full relist: removals
+        # drop their rows, additions append in sorted order (the same
+        # discipline ShardView.list_nodes uses, so the identity sweep
+        # only realigns when layouts genuinely diverged)
+        rm = set(removes)
+        names = [n for n in self.names if n not in rm]
+        names.extend(sorted(adds))
+        self.names = names
+        self._names_set = set(names)
+        self._pos = None
+        self._pos_map()  # splice is already O(n): prewarm the row map
+        self._gather = None
+        self._pending_rows = None  # row count changed: full column pass
+        self.weighted = None
+        self._fit_node_ver = -1
+        self._fit_names = None
+        return True
+
+    def _note_dirty_rows(self, items) -> None:
+        """Ingest dirty rows and queue their column positions for the
+        incremental dynamic patch."""
+        self._store.bulk_ingest(items)
+        self.stats["dirty_rows"] += len(items)
+        if self._m_dirty_rows is not None:
+            self._m_dirty_rows.labels(consumer="drip").inc(len(items))
+        pending = self._pending_rows
+        if pending is not None:
+            pos = self._pos_map()
+            for nm, _ in items:
+                pending.add(pos[nm])
+
+    def _ensure_gather(self):
         store = self._store
         gather = self._gather
         lv = store.layout_version
@@ -213,7 +389,11 @@ class DripColumns:
                 count=len(self.names),
             )
             gather = self._gather = (lv, ids)
-        ids = gather[1]
+        return gather[1]
+
+    def _rebuild_dynamic(self, now: float) -> None:
+        store = self._store
+        ids = self._ensure_gather()
         self.schedulable, self.fail_entry, score = drip_filter_score_columns(
             self._tensors,
             store.values[ids],
@@ -223,6 +403,73 @@ class DripColumns:
             now,
         )
         self.weighted = score.astype(np.int64) * self._dyn_weight
+        # fresh arrays: identity changed, the device cache re-uploads
+        # regardless, so the scatter chain restarts here
+        self.col_epoch += 1
+        self._scatter_log.clear()
+
+    def _patch_dynamic(self, rows, now: float) -> None:
+        """O(dirty) twin of ``_rebuild_dynamic``: recompute the column
+        verdicts for ``rows`` only and scatter them into the EXISTING
+        arrays (identity preserved — the col_epoch + scatter log carry
+        the change to identity-keyed consumers). Clean rows keep their
+        verdicts from the build that produced them; both evaluations
+        share the clock bucket, which is the staleness the bucket
+        contract already grants."""
+        if not rows:
+            self.col_epoch += 1
+            self._scatter_log.append(
+                (self.col_epoch, np.empty((0,), dtype=np.int64))
+            )
+            self._trim_scatter_log()
+            return
+        store = self._store
+        ids_all = self._ensure_gather()
+        rows_arr = np.fromiter(rows, dtype=np.int64, count=len(rows))
+        rows_arr.sort()
+        ids = ids_all[rows_arr]
+        sched, fail, score = drip_filter_score_columns(
+            self._tensors,
+            store.values[ids],
+            store.ts[ids],
+            store.hot_value[ids],
+            store.hot_ts[ids],
+            now,
+        )
+        self.schedulable[rows_arr] = sched
+        self.fail_entry[rows_arr] = fail
+        self.weighted[rows_arr] = score.astype(np.int64) * self._dyn_weight
+        self.col_epoch += 1
+        self._scatter_log.append((self.col_epoch, rows_arr))
+        self._trim_scatter_log()
+        # in-place writes are invisible to the identity-keyed trees:
+        # re-read exactly the patched rows instead of dropping the
+        # trees (a drop costs the next probe an O(n) rebuild per vec)
+        if self._trees:
+            self._patch_trees(rows_arr.tolist())
+        self.stats["dirty_patches"] += 1
+
+    def _trim_scatter_log(self) -> None:
+        log = self._scatter_log
+        if len(log) > self._SCATTER_LOG_CAP:
+            del log[0]
+
+    def dirty_rows_between(self, from_epoch: int, to_epoch: int):
+        """Union of column rows patched in ``(from_epoch, to_epoch]``,
+        or None when the scatter log no longer covers the interval (the
+        device cache then re-uploads). Epochs are consecutive — one log
+        entry per patch — so coverage is a simple chain check."""
+        if from_epoch == to_epoch:
+            return np.empty((0,), dtype=np.int64)
+        log = self._scatter_log
+        if not log or log[0][0] > from_epoch + 1:
+            return None
+        chunks = [r for e, r in log if from_epoch < e <= to_epoch]
+        if len(chunks) != to_epoch - from_epoch:
+            return None  # a full rebuild broke the chain
+        if len(chunks) == 1:
+            return chunks[0]
+        return np.unique(np.concatenate(chunks))
 
     def note_bind(
         self, best_i: int, vec: np.ndarray, pre_pod: int, was_bound: bool
@@ -268,12 +515,32 @@ class DripColumns:
         self.stats["drops"] += 1
         self._trees.clear()
 
+    def _patch_trees(self, rows) -> None:
+        """O(dirty log n) per cached tree after an in-place dynamic
+        patch. The fold path (``_update_trees``) only re-masks fit
+        verdicts, but a dynamic patch moves schedulable/weighted too,
+        so EVERY tree — fit dimension or not — re-reads the patched
+        rows."""
+        for i in rows:
+            sched_i = bool(self.schedulable[i])
+            bnd_i = (
+                bool(self.bounded[i]) if self.bounded is not None else False
+            )
+            w_i = int(self.weighted[i])
+            free_i = self.free[i] if self.free is not None else None
+            for tree, tvec in self._trees.values():
+                feas = sched_i
+                if feas and tvec is not None and bnd_i and free_i is not None:
+                    feas = not bool(((tvec > 0) & (free_i < tvec)).any())
+                tree.update(i, w_i, feas)
+                self.stats["topk_updates"] += 1
+
     def _update_trees(self, best_i: int) -> None:
         """O(log n) per cached tree: re-mask only the folded row."""
         sched_i = bool(self.schedulable[best_i])
         bnd_i = bool(self.bounded[best_i]) if self.bounded is not None else False
         w_i = int(self.weighted[best_i])
-        free_i = self.free[best_i]
+        free_i = self.free[best_i] if self.free is not None else None
         for tree, tvec in self._trees.values():
             if tvec is None:
                 continue  # no fit dimension in this tree's mask

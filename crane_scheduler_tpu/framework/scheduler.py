@@ -1144,6 +1144,11 @@ class Scheduler:
                 drip.schedulable, drip.weighted,
                 drip.bounded, drip.free, vecs,
                 want_ties=self._tie_rng is not None,
+                # dirty refreshes patch the dynamic columns in place;
+                # the epoch keys device freshness and the delta turns a
+                # stale device copy into an O(dirty) row scatter
+                col_version=drip.col_epoch,
+                col_delta=drip.dirty_rows_between,
             )
         dt = kern.last_kernel_seconds
         b = self._batch
@@ -1536,6 +1541,8 @@ class BatchScheduler:
             # on an in-flight background refresh (overlap_refresh mode)
             "columnar_ingest": 0,  # refreshes served straight from the
             # kube mirror's decoded LIST columns (no Node objects)
+            "dirty_ingest": 0,  # columnar ingests narrowed to the
+            # dirty-name journal (O(dirty) rows touched, no prune)
         }
         if self._telemetry is not None:
             # fold refresh_stats into the registry: the dict stays the
@@ -1569,6 +1576,10 @@ class BatchScheduler:
                 "Store refreshes served straight from decoded LIST "
                 "columns (no Node-object round-trip)",
             )
+            counters["dirty_ingest"] = reg.counter(
+                "crane_refresh_dirty_ingest_total",
+                "Columnar ingests narrowed to the dirty-name journal",
+            )
             self.refresh_stats = _MirroredStats(stats_init, counters)
         else:
             self.refresh_stats = stats_init
@@ -1581,6 +1592,10 @@ class BatchScheduler:
         # last decoded-columns version ingested (refresh()'s columnar
         # fast path): matching version == nothing changed == skip
         self._columns_consumed = None
+        # cluster node fence at that ingest — keys the dirty-name
+        # journal lookup that narrows the NEXT columnar ingest to the
+        # rows actually written since (O(dirty), not O(cluster))
+        self._ingest_node_ver: int | None = None
         # device-resident snapshot cache: (store version, padded N) it was
         # built from; an unchanged store re-dispatches with zero uploads
         self._prepared = None
@@ -1619,19 +1634,37 @@ class BatchScheduler:
             return
         t0 = time.perf_counter()
         with maybe_span(self._telemetry, "ingest"):
+            # fence BEFORE the column snapshot: a write landing between
+            # the two reads re-processes next refresh instead of being
+            # skipped
+            node_ver = getattr(self.cluster, "node_version", None)
             cols_fn = getattr(self.cluster, "node_annotation_columns", None)
             cols = cols_fn() if cols_fn is not None else None
             if cols is not None:
                 version, names, keys, values, offsets = cols
                 if version != self._columns_consumed:
+                    only = None
+                    dirty_fn = getattr(
+                        self.cluster, "dirty_nodes_since", None)
+                    if dirty_fn is not None and self._ingest_node_ver is not None:
+                        d = dirty_fn(self._ingest_node_ver)
+                        if d is not None and not d[1]:
+                            # journal covers the gap and membership is
+                            # untouched: patch only the dirty rows
+                            only = d[0]
                     self.store.ingest_annotation_columns(
-                        names, keys, values, offsets
+                        names, keys, values, offsets, only_names=only
                     )
-                    self.store.prune_absent(names)
+                    if only is None:
+                        self.store.prune_absent(names)
+                    else:
+                        self.refresh_stats["dirty_ingest"] += 1
                     self._columns_consumed = version
+                    self._ingest_node_ver = node_ver
                     self.refresh_stats["columnar_ingest"] += 1
             else:
                 self._columns_consumed = None
+                self._ingest_node_ver = node_ver  # full sweep covers it
                 nodes = self.cluster.list_nodes()
                 self.store.bulk_ingest(
                     (n.name, n.annotations) for n in nodes

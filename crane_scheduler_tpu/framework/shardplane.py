@@ -44,7 +44,7 @@ from __future__ import annotations
 
 import threading
 
-from ..cluster.shards import ShardSpec
+from ..cluster.shards import RingRebalancer, ShardSpec
 
 __all__ = ["BindArbiter", "ShardView", "ShardedPlacementPlane"]
 
@@ -94,7 +94,11 @@ class ShardView:
         self.spec = spec
         self._arbiter = arbiter
         self._nodes_cache: tuple[int, list] | None = None
-        self._names_cache: tuple[int, frozenset] | None = None
+        self._member: set[str] | None = None  # observed names (live set)
+        self._member_key = None  # (node_set_version, ring version) at rehash
+        self._pos: dict[str, int] | None = None  # name -> row (lazy)
+        self.rehashes = 0  # full crc refilters (regression gate)
+        self.incremental_refreshes = 0  # journal-driven cache patches
         self.conflicts: dict[str, int] = {}
         self._conflict_cb = conflict_cb
         self._bind_cb = bind_cb
@@ -121,28 +125,121 @@ class ShardView:
 
     # -- shard-filtered reads ----------------------------------------------
 
+    def dirty_nodes_since(self, version: int):
+        """This shard's dirty-name journal tail (see
+        ``ClusterState.dirty_nodes_since``); ``version`` is a value of
+        THIS view's node fence."""
+        return self._inner.dirty_nodes_since(version, self.spec.index)
+
+    def has_node(self, name: str) -> bool:
+        """Observed by this shard AND present in the mirror — the
+        membership test the dirty-journal consumers classify against
+        (ring ownership is read live, so a reshard moves the answer)."""
+        return self.spec.observes(name) and self._inner.has_node(name)
+
     def list_nodes(self):
+        """The shard's nodes, cached on the shard node fence.
+
+        A fence move covered by the dirty-name journal patches the
+        CACHED list in place — replace dirty rows, drop names the ring
+        no longer routes here, append (sorted) names it now does — so a
+        named write costs O(dirty) and a reshard costs O(moved), not a
+        relist plus an O(cluster) crc refilter. The full rehash runs
+        only when the journal can't localize the change (bulk relist,
+        overrun) and is counted in ``rehashes``. Callers get the live
+        list object, same as ``ClusterState.list_nodes`` returning its
+        own fresh materialization each call."""
         ver = self.node_version
         cached = self._nodes_cache
         if cached is not None and cached[0] == ver:
             return cached[1]
+        if cached is not None and self._member is not None:
+            dirty = self.dirty_nodes_since(cached[0])
+            if dirty is not None and self._patch_cache(cached[1], dirty):
+                self._nodes_cache = (ver, cached[1])
+                self._member_key = self._live_member_key()
+                self.incremental_refreshes += 1
+                return cached[1]
         inner_nodes = self._inner.list_nodes()
-        # membership is a pure function of the node NAME: the crc32
-        # refilter (O(cluster) hashing) reruns only when the node set
-        # itself changes; annotation patches and binds bump the node
-        # fence but reuse the cached name set, so re-materializing the
-        # shard after a named write costs one set-membership sweep
-        set_ver = self._inner.node_set_version
-        names = self._names_cache
-        if names is None or names[0] != set_ver:
+        # membership is a pure function of the node NAME: on a journal
+        # miss the O(cluster) crc rehash reruns only when the node set
+        # or the ring actually moved; an annotation-only bulk sweep
+        # reuses the member set and pays one set-membership pass
+        key = self._live_member_key()
+        member = self._member
+        if member is None or key != self._member_key:
             observes = self.spec.observes
-            names = (set_ver, frozenset(
-                n.name for n in inner_nodes if observes(n.name)))
-            self._names_cache = names
-        member = names[1]
+            member = {n.name for n in inner_nodes if observes(n.name)}
+            self._member = member
+            self._member_key = key
+            self.rehashes += 1
         nodes = [n for n in inner_nodes if n.name in member]
         self._nodes_cache = (ver, nodes)
+        self._pos = None
         return nodes
+
+    def _live_member_key(self):
+        lay = self.spec.layout
+        return (self._inner.node_set_version,
+                lay.version if lay is not None else 0)
+
+    def _pos_map(self, nodes) -> dict[str, int]:
+        pos = self._pos
+        if pos is None:
+            pos = self._pos = {n.name: i for i, n in enumerate(nodes)}
+        return pos
+
+    def _patch_cache(self, nodes, dirty) -> bool:
+        """Apply a covered journal interval to the cached node list in
+        place; returns False when the delta is inconsistent and the
+        caller must refilter."""
+        touched, membership = dirty
+        if not touched:
+            return True
+        member = self._member
+        get_node = self._inner.get_node
+        if not membership:
+            pos = self._pos_map(nodes)
+            for nm in touched:
+                if nm not in member:
+                    continue  # co-owner churn outside this shard's slice
+                i = pos.get(nm)
+                node = get_node(nm)
+                if i is None or node is None:
+                    return False  # membership drifted without the flag
+                nodes[i] = node
+            return True
+        observes = self.spec.observes
+        adds: list = []
+        remove_rows: list[int] = []
+        pos = self._pos_map(nodes)
+        for nm in touched:
+            node = get_node(nm)
+            present = node is not None and observes(nm)
+            if present and nm not in member:
+                adds.append(node)
+            elif not present and nm in member:
+                i = pos.get(nm)
+                if i is None:
+                    return False
+                remove_rows.append(i)
+                member.discard(nm)
+            elif present:
+                i = pos.get(nm)
+                if i is None:
+                    return False
+                nodes[i] = node
+        for i in sorted(remove_rows, reverse=True):
+            del nodes[i]
+        # sorted appends: the same splice discipline DripColumns uses,
+        # so view order and column order stay in lockstep across moves
+        adds.sort(key=lambda n: n.name)
+        for node in adds:
+            member.add(node.name)
+            nodes.append(node)
+        if adds or remove_rows:
+            self._pos = None
+        return True
 
     # -- claim-guarded writes ----------------------------------------------
 
@@ -207,18 +304,22 @@ class ShardedPlacementPlane:
     """
 
     def __init__(self, cluster, count: int, overlap: float = 0.0,
-                 telemetry=None, mesh=None):
+                 telemetry=None, mesh=None, layout=None):
         if count < 1:
             raise ValueError(f"scheduler count must be >= 1, got {count}")
-        cluster.configure_shards(count, overlap)
+        cluster.configure_shards(count, overlap, layout=layout)
         self.cluster = cluster
         self.count = count
         self.overlap = overlap
+        self.layout = layout
         self.mesh = mesh
         self.arbiter = BindArbiter()
         self._telemetry = telemetry
         self._m_conflicts = None
         self._m_binds = None
+        self._m_overruns = None
+        self._overruns_seen = 0
+        self._m_resharded = None
         if telemetry is not None:
             reg = telemetry.registry
             self._m_conflicts = reg.counter(
@@ -240,10 +341,22 @@ class ShardedPlacementPlane:
                 "Nodes observed per shard",
                 ("shard",),
             )
+            self._m_overruns = reg.counter(
+                "crane_dirty_journal_overruns_total",
+                "Dirty-name journal evictions forcing an identity sweep",
+            )
+            self._g_journal_depth = reg.gauge(
+                "crane_dirty_journal_depth",
+                "Entries currently buffered in the global dirty-name journal",
+            )
+            self._m_resharded = reg.counter(
+                "crane_reshard_moved_names_total",
+                "Node names migrated between shards by ring repartitions",
+            )
         self.views = [
             ShardView(
                 cluster,
-                ShardSpec(i, count, overlap),
+                ShardSpec(i, count, overlap, layout=layout),
                 self.arbiter,
                 conflict_cb=self._conflict_noter(),
                 bind_cb=self._bind_noter(i),
@@ -284,6 +397,47 @@ class ShardedPlacementPlane:
             self._g_nodes.labels(shard=str(view.spec.index)).set(
                 len(view.list_nodes())
             )
+        stats = getattr(self.cluster, "dirty_journal_stats", None)
+        if stats is not None:
+            s = stats()
+            self._g_journal_depth.set(s["depth"])
+            # counters are monotonic; the mirror reports a running
+            # total, so publish only the delta since the last refresh
+            new = s["overruns"] - self._overruns_seen
+            if new > 0:
+                self._m_overruns.inc(new)
+                self._overruns_seen = s["overruns"]
+
+    def reshard(self, target) -> list[str]:
+        """Adopt ``target`` (a detached ring from ``with_moves``/
+        ``split``/``merge``/a rebalancer plan) as the live keyspace.
+        Migration cost is O(moved): only names whose owner set changed
+        are journaled as membership-dirty on their old and new shards,
+        and every view/column patches just those rows on its next
+        refresh. Returns the moved names."""
+        if self.layout is None:
+            raise ValueError("plane was built without a ring layout")
+        # the live ring object is shared by the mirror and every view's
+        # spec; ClusterState.reshard atomically swaps its state in
+        moved = self.cluster.reshard(target)
+        if self._m_resharded is not None and moved:
+            self._m_resharded.inc(len(moved))
+        return moved
+
+    def rebalance(self, skew: float = 0.25, max_moves: int = 8):
+        """One rebalancer step against the observed per-shard node
+        counts; adopts and returns the moved names, or ``None`` when
+        the plane is already within the skew envelope."""
+        if self.layout is None:
+            raise ValueError("plane was built without a ring layout")
+        load = {
+            view.spec.index: len(view.list_nodes()) for view in self.views
+        }
+        plan = RingRebalancer(skew=skew, max_moves=max_moves).plan(
+            self.layout, load)
+        if plan is None:
+            return None
+        return self.reshard(plan)
 
     def conflict_stats(self) -> dict[str, int]:
         """Aggregate per-outcome conflict counts across all views."""

@@ -518,7 +518,8 @@ class NodeLoadStore:
         self.hot_ts[rows_arr[hot_mask]] = ts[hot_mask]
 
     @_locked
-    def ingest_annotation_columns(self, names, keys, values, offsets) -> None:
+    def ingest_annotation_columns(self, names, keys, values, offsets,
+                                  only_names=None) -> None:
         """Columnar twin of ``bulk_ingest``: per-node annotation maps
         arrive as flat aligned key/value columns — row ``i`` owns
         ``keys[offsets[i]:offsets[i+1]]``, the LIST decoder's output
@@ -528,7 +529,10 @@ class NodeLoadStore:
         node, exactly like ``ingest_node_annotations``. There is no
         identity skip (there are no map objects to compare): callers
         gate on the cluster version instead, as
-        ``BatchScheduler.refresh`` does."""
+        ``BatchScheduler.refresh`` does. ``only_names`` narrows the
+        write to a dirty subset (the cluster's dirty-name journal):
+        rows for other names are ignored, making a full-width column
+        payload an O(dirty) patch."""
         index = self._index
         metric_get = self.tensors.metric_index.get
         raws: list = []
@@ -541,6 +545,8 @@ class NodeLoadStore:
         off = offsets.tolist() if hasattr(offsets, "tolist") else list(offsets)
         last = self._last_anno
         for j, name in enumerate(names):
+            if only_names is not None and name not in only_names:
+                continue
             i = index.get(name)
             if i is None:
                 if self._n == self._cap:

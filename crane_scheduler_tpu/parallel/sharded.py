@@ -802,6 +802,7 @@ class DeviceColumnCache:
         self._device = device
         self._slots: dict[str, tuple] = {}
         self.uploads = 0
+        self.scatters = 0  # delta-row device patches (avoided uploads)
         # mesh-repartition fence: cached device arrays are placed for
         # ONE partitioning (device set + shard spec). set_partition()
         # drops everything when that changes — a resized mesh must
@@ -823,15 +824,59 @@ class DeviceColumnCache:
             self.repartitions += 1
         return changed
 
-    def put(self, name: str, arr, version=0, prepare=None, device=None):
+    def held_version(self, name: str, arr):
+        """The version the cached slot for ``name`` holds, or None when
+        the slot is absent or keyed to a different array object — the
+        input for ``DripColumns.dirty_rows_between`` when building a
+        ``delta_rows`` scatter."""
+        slot = self._slots.get(name)
+        if slot is None:
+            return None
+        key = slot[0]
+        if key[0] != id(arr) or key[1] != arr.shape:
+            return None
+        return key[2]
+
+    def put(self, name: str, arr, version=0, prepare=None, device=None,
+            delta_rows=None, row_prepare=None):
         """Device array for ``arr``, uploading only when the
         ``(identity, shape, version)`` key changed since the last call.
         ``device`` overrides the cache-wide placement for this column
-        (e.g. a ``NamedSharding`` for mesh-sharded columns)."""
+        (e.g. a ``NamedSharding`` for mesh-sharded columns).
+
+        ``delta_rows`` (int array) declares that the held slot differs
+        from ``arr`` ONLY at those rows (same array object, patched in
+        place between the held version and ``version`` — see
+        ``DripColumns.dirty_rows_between``): the device copy is patched
+        with one scatter instead of a full re-upload, so a 1-node
+        annotation write at 1M nodes moves a handful of rows over PCIe
+        rather than the whole column. ``row_prepare`` is the elementwise
+        (dtype) half of ``prepare`` applied to the scattered rows;
+        shape-changing prepares (pad-to-bucket) keep working because
+        padding sits past every row index. Mesh-sharded placements
+        (``device=``) skip the scatter and re-upload."""
         key = (id(arr), arr.shape, version)
         slot = self._slots.get(name)
         if slot is not None and slot[0] == key:
             return slot[1]
+        if (
+            delta_rows is not None
+            and device is None
+            and slot is not None
+            and slot[0][0] == id(arr)
+            and slot[0][1] == arr.shape
+        ):
+            if len(delta_rows) == 0:
+                dev = slot[1]
+            else:
+                vals = arr[delta_rows]
+                if row_prepare is not None:
+                    vals = row_prepare(vals)
+                dev = slot[1].at[jnp.asarray(delta_rows)].set(
+                    jnp.asarray(vals))
+            self._slots[name] = (key, dev, arr)
+            self.scatters += 1
+            return dev
         host = arr if prepare is None else prepare(arr)
         dev = jax.device_put(host, device if device is not None else self._device)
         self._slots[name] = (key, dev, arr)

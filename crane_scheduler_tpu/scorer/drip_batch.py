@@ -284,11 +284,21 @@ class DripBatchKernel:
         free: np.ndarray | None,
         vecs: np.ndarray,
         want_ties: bool = True,
+        col_version: int = 0,
+        col_delta=None,
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Run one window; returns ``(chosen, feasible, ties)`` int64[K]
         (chosen = -1 where no feasible node; ties is a constant 1 when
         ``want_ties`` is False). Pure w.r.t. the host columns; the
-        device fold carry advances and is kept for reuse."""
+        device fold carry advances and is kept for reuse.
+
+        ``col_version`` stamps the dynamic columns' build epoch
+        (``DripColumns.col_epoch``): O(dirty) refreshes patch the host
+        arrays IN PLACE, so identity alone no longer keys freshness —
+        callers on the dirty path MUST pass it. ``col_delta(held,
+        current)`` (``DripColumns.dirty_rows_between``) then turns a
+        version miss into a device-side row scatter instead of a full
+        column re-upload; returning None falls back to the upload."""
         n = int(schedulable.shape[0])
         k = int(vecs.shape[0])
         npad = _bucket_nodes(n)
@@ -307,16 +317,28 @@ class DripBatchKernel:
             self.mark_desynced()
             self.repartitions += 1
         t0 = time.perf_counter()
+
+        def delta_for(col, arr):
+            if col_delta is None or sharded:
+                return None  # mesh tiles re-place; scatter is 1-device only
+            held = self._cols.held_version(col, arr)
+            if held is None or held == col_version:
+                return None
+            return col_delta(held, col_version)
+
         with enable_x64():
             sched_d = self._cols.put(
-                "schedulable", schedulable,
+                "schedulable", schedulable, version=col_version,
                 prepare=lambda a: _pad(a, npad, False),
                 device=col_dev,
+                delta_rows=delta_for("schedulable", schedulable),
             )
             w_d = self._cols.put(
-                "weighted", weighted,
+                "weighted", weighted, version=col_version,
                 prepare=lambda a: _pad(a.astype(np.int64), npad, _I64_MIN),
                 device=col_dev,
+                delta_rows=delta_for("weighted", weighted),
+                row_prepare=lambda v: v.astype(np.int64),
             )
             if no_fit:
                 # tracker-less plugin set: fit never fails

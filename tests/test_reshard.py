@@ -1,0 +1,513 @@
+"""O(dirty) shard plane: dirty-name journal semantics, the
+consistent-hash ring (split/merge/moves, spec round-trip, rebalancer),
+``ClusterState.reshard`` migration accounting, the ShardView
+incremental membership cache, and the fuzz parity gate — dirty-patched
+drip columns must be bit-identical to a from-scratch rebuild, with the
+scalar loop as the placement oracle."""
+
+import random
+
+import numpy as np
+import pytest
+
+from crane_scheduler_tpu.cluster import ClusterState, Node
+from crane_scheduler_tpu.cluster.shards import (
+    HashRing,
+    RingRebalancer,
+    ShardSpec,
+    name_point,
+)
+from crane_scheduler_tpu.cluster.state import _DirtyJournal
+from crane_scheduler_tpu.framework.shardplane import (
+    ShardedPlacementPlane,
+    ShardView,
+)
+
+from test_drip_columnar import (
+    METRICS,
+    NOW,
+    _anno,
+    build_cluster,
+    build_scheduler,
+    fuzz_node_specs,
+    make_pod,
+)
+
+
+# -- journal unit ------------------------------------------------------------
+
+
+def test_dirty_journal_covered_interval_replays_names():
+    j = _DirtyJournal(cap=8)
+    j.note(1, "a")
+    j.note(2, "b", membership=True)
+    j.note(3, "a")
+    names, member = j.since(0)
+    assert names == {"a", "b"} and member
+    names, member = j.since(2)
+    assert names == {"a"} and not member
+    assert j.since(3) == (set(), False)
+
+
+def test_dirty_journal_bulk_mark_resets_floor():
+    j = _DirtyJournal(cap=8)
+    j.note(1, "a")
+    j.mark_bulk(5)
+    assert j.since(4) is None  # bulk write not name-attributable
+    assert j.since(5) == (set(), False)
+    j.note(6, "c")
+    assert j.since(5) == ({"c"}, False)
+    assert j.bulk_marks == 1
+
+
+def test_dirty_journal_overrun_advances_floor_and_counts():
+    j = _DirtyJournal(cap=4)
+    for v in range(1, 10):
+        j.note(v, f"n{v}")
+    assert j.overruns == 5
+    assert j.since(0) is None  # evicted interval
+    assert j.since(5) == ({"n6", "n7", "n8", "n9"}, False)
+
+
+def test_cluster_journal_attributes_writes_per_shard():
+    cs = ClusterState()
+    ring = HashRing(2, vnodes=32)
+    cs.configure_shards(2, layout=ring)
+    names = [f"node-{i}" for i in range(40)]
+    for n in names:
+        cs.add_node(Node(name=n, annotations={"a": "0"}))
+    target = names[7]
+    shard = ring.owner(target)
+    v = cs.shard_versions(shard)[2]
+    v_other = cs.shard_versions(1 - shard)[2]
+    cs.patch_node_annotation(target, "a", "1")
+    assert cs.dirty_nodes_since(v, shard) == ({target}, False)
+    assert cs.dirty_nodes_since(v_other, 1 - shard) == (set(), False)
+    # global journal sees it too, with the global fence
+    gv = cs.node_version
+    cs.patch_node_annotation(target, "a", "2")
+    assert cs.dirty_nodes_since(gv) == ({target}, False)
+
+
+def test_cluster_journal_membership_flag_and_bulk_sweep():
+    cs = ClusterState()
+    cs.add_node(Node(name="n0", annotations={}))
+    v = cs.node_version
+    cs.add_node(Node(name="n1", annotations={}))
+    cs.delete_node("n0")
+    names, member = cs.dirty_nodes_since(v)
+    assert names == {"n0", "n1"} and member
+    v2 = cs.node_version
+    cs.patch_node_annotations_columns(["n1"], {"k": ["x"]})
+    assert cs.dirty_nodes_since(v2) is None  # bulk: one identity sweep
+    assert cs.dirty_journal_stats()["bulk_marks"] >= 1
+
+
+# -- ring --------------------------------------------------------------------
+
+
+def test_ring_owner_deterministic_and_spec_roundtrip():
+    ring = HashRing(4, vnodes=32, overlap=0.25)
+    names = [f"host-{i}" for i in range(500)]
+    again = HashRing.from_spec(ring.spec_dict())
+    for n in names:
+        assert ring.owner(n) == again.owner(n)
+        owners = ring.owners(n)
+        assert owners == again.owners(n)
+        assert owners[0] == ring.owner(n)
+        assert all(0 <= s < 4 for s in owners)
+
+
+def test_ring_moved_arcs_cover_every_owner_change():
+    ring = HashRing(3, vnodes=16)
+    points, owners = ring.tokens()
+    moves = [(i, (s + 1) % 3) for i, s in enumerate(owners) if i % 5 == 0]
+    target = ring.with_moves(moves)
+    assert target.version == ring.version + 1
+    arcs = target.moved_arcs(ring)
+
+    def in_arcs(p):
+        for lo, hi in arcs:
+            if lo < hi:
+                if lo < p <= hi:
+                    return True
+            elif p > lo or p <= hi:  # wrap
+                return True
+        return False
+
+    for i in range(3000):
+        n = f"node-{i}"
+        if ring.owners(n) != target.owners(n):
+            assert in_arcs(name_point(n)), n
+
+
+def test_ring_split_and_merge_move_only_the_named_shard():
+    ring = HashRing(3, vnodes=16)
+    names = [f"w-{i}" for i in range(900)]
+    split = ring.split(0, 2)
+    for n in names:
+        a, b = ring.owner(n), split.owner(n)
+        if a != b:
+            assert a == 0 and b == 2
+    merged = ring.merge(1, 0)
+    assert not any(
+        s == 1 for s in merged.tokens()[1]
+    )
+    for n in names:
+        if ring.owner(n) == 1:
+            assert merged.owner(n) == 0
+
+
+def test_ring_adopt_swaps_state_atomically_for_live_readers():
+    ring = HashRing(2, vnodes=8)
+    spec = ShardSpec(0, 2, layout=ring)
+    moved = ring.with_moves([(0, 1)])
+    before = {f"x-{i}": spec.observes(f"x-{i}") for i in range(200)}
+    ring.adopt(moved)
+    after = {f"x-{i}": spec.observes(f"x-{i}") for i in range(200)}
+    assert ring.version == moved.version
+    assert any(before[k] != after[k] for k in before)
+    with pytest.raises(ValueError):
+        ring.adopt(HashRing(3, vnodes=8))
+
+
+def test_rebalancer_converges_without_stranding():
+    ring = HashRing(3, vnodes=16)
+    names = [f"node-{i}" for i in range(600)]
+    load = {s: 0 for s in range(3)}
+    for n in names:
+        load[ring.owner(n)] += 1
+    plan = RingRebalancer(skew=0.05, max_moves=8).plan(ring, load)
+    assert plan is not None
+    post = {s: 0 for s in range(3)}
+    for n in names:
+        post[plan.owner(n)] += 1
+    assert max(post.values()) < max(load.values())
+    assert all(s in set(plan.tokens()[1]) for s in range(3))
+    # balanced input -> no plan
+    assert RingRebalancer(skew=0.5).plan(ring, {0: 10, 1: 10, 2: 10}) is None
+
+
+# -- reshard through the mirror ---------------------------------------------
+
+
+def test_reshard_moves_exactly_the_owner_changed_names():
+    cs = ClusterState()
+    ring = HashRing(2, vnodes=32)
+    cs.configure_shards(2, layout=ring)
+    names = [f"node-{i}" for i in range(300)]
+    for n in names:
+        cs.add_node(Node(name=n, annotations={"a": "0"}))
+    pre = {n: ring.owners(n) for n in names}
+    points, owners = ring.tokens()
+    idx = next(i for i, s in enumerate(owners) if s == 0)
+    target = ring.with_moves([(idx, 1)])
+    want_moved = {n for n in names if pre[n] != target.owners(n)}
+
+    v0 = cs.shard_versions(0)[2]
+    v1 = cs.shard_versions(1)[2]
+    moved = cs.reshard(target)
+    assert set(moved) == want_moved and want_moved
+    # both shards see the moved names as membership-dirty
+    d0 = cs.dirty_nodes_since(v0, 0)
+    d1 = cs.dirty_nodes_since(v1, 1)
+    assert d0 == (want_moved, True) and d1 == (want_moved, True)
+    assert ring.version == target.version  # live ring adopted
+
+
+def test_reshard_without_ring_layout_raises():
+    cs = ClusterState()
+    cs.configure_shards(2)  # static modulo keyspace
+    with pytest.raises(ValueError):
+        cs.reshard(HashRing(2))
+
+
+# -- shard view incremental cache -------------------------------------------
+
+
+def _ring_plane(n_nodes=120, shards=2, vnodes=32):
+    cs = ClusterState()
+    ring = HashRing(shards, vnodes=vnodes)
+    plane = ShardedPlacementPlane(cs, shards, layout=ring)
+    for i in range(n_nodes):
+        cs.add_node(Node(name=f"node-{i:03d}", annotations={"a": str(i)}))
+    return cs, ring, plane
+
+
+def _view_parity(view: ShardView):
+    got = sorted(n.name for n in view.list_nodes())
+    want = sorted(
+        n.name for n in view._inner.list_nodes()
+        if view.spec.observes(n.name)
+    )
+    assert got == want
+
+
+def test_shard_view_patches_cache_without_rehash():
+    cs, ring, plane = _ring_plane()
+    v0, v1 = plane.views
+    base0 = list(v0.list_nodes())
+    v1.list_nodes()
+    assert v0.rehashes == 1
+
+    target = base0[3].name
+    cs.patch_node_annotation(target, "a", "patched")
+    nodes = v0.list_nodes()
+    assert v0.rehashes == 1 and v0.incremental_refreshes == 1
+    assert next(
+        n for n in nodes if n.name == target
+    ).annotations["a"] == "patched"
+
+    cs.add_node(Node(name="zz-added", annotations={"a": "new"}))
+    cs.delete_node(target)
+    _view_parity(v0)
+    _view_parity(v1)
+    assert v0.rehashes == 1 and v1.rehashes == 1
+
+
+def test_shard_view_reshard_is_patched_not_rehashed():
+    cs, ring, plane = _ring_plane()
+    v0, v1 = plane.views
+    v0.list_nodes(), v1.list_nodes()
+    points, owners = ring.tokens()
+    idx = next(i for i, s in enumerate(owners) if s == 0)
+    moved = plane.reshard(ring.with_moves([(idx, 1)]))
+    assert moved
+    _view_parity(v0)
+    _view_parity(v1)
+    assert v0.rehashes == 1 and v1.rehashes == 1
+    assert v0.incremental_refreshes >= 1
+
+
+def test_shard_view_bulk_sweep_skips_rehash_but_refilters():
+    cs, ring, plane = _ring_plane(n_nodes=60)
+    (v0,) = plane.views[:1]
+    v0.list_nodes()
+    names = [f"node-{i:03d}" for i in range(60)]
+    cs.patch_node_annotations_columns(names, {"k": ["v"] * 60})
+    nodes = v0.list_nodes()
+    # journal miss (bulk) but the member set is reusable: no rehash
+    assert v0.rehashes == 1
+    assert all(v0.spec.observes(n.name) for n in nodes)
+
+
+def test_shard_view_fuzz_membership_parity(seed=3):
+    rng = random.Random(seed)
+    cs, ring, plane = _ring_plane(n_nodes=80)
+    views = plane.views
+    live = [f"node-{i:03d}" for i in range(80)]
+    fresh = 80
+    for step in range(120):
+        roll = rng.random()
+        if roll < 0.45 and live:
+            cs.patch_node_annotation(
+                rng.choice(live), "a", f"s{step}")
+        elif roll < 0.6:
+            nm = f"fuzz-{fresh:03d}"
+            fresh += 1
+            cs.add_node(Node(name=nm, annotations={"a": "x"}))
+            live.append(nm)
+        elif roll < 0.7 and len(live) > 10:
+            cs.delete_node(live.pop(rng.randrange(len(live))))
+        elif roll < 0.8:
+            cs.patch_node_annotations_columns(
+                list(live), {"b": ["y"] * len(live)})
+        elif roll < 0.9:
+            points, owners = ring.tokens()
+            idx = rng.randrange(len(points))
+            plane.reshard(ring.with_moves(
+                [(idx, rng.randrange(2))]))
+        else:
+            for v in views:
+                _view_parity(v)
+        if rng.random() < 0.5:
+            _view_parity(rng.choice(views))
+    for v in views:
+        _view_parity(v)
+        assert v.incremental_refreshes > 0
+
+
+# -- drip column bit-identity under dirty patching ---------------------------
+
+
+def _drip_for(sched):
+    rec = sched._recognition()
+    assert rec is not None
+    drip = sched._ensure_drip(rec)
+    drip.ensure(NOW)
+    return drip
+
+
+def _assert_columns_bit_identical(a, b):
+    assert a.names == b.names
+    np.testing.assert_array_equal(a.schedulable, b.schedulable)
+    np.testing.assert_array_equal(a.fail_entry, b.fail_entry)
+    np.testing.assert_array_equal(a.weighted, b.weighted)
+
+
+@pytest.mark.parametrize("seed", [0, 4, 11])
+def test_fuzz_dirty_patched_columns_bit_identical_to_rebuild(seed):
+    """Interleaved named writes / bulk sweeps / membership churn /
+    reshard moves: the O(dirty)-patched columns equal a from-scratch
+    build over the same mirror, bit for bit, and placements stay equal
+    to the scalar oracle."""
+    rng = random.Random(seed)
+    node_specs = fuzz_node_specs(rng, 40)
+    cluster = build_cluster(node_specs)
+    ring = HashRing(2, vnodes=16)
+    cluster.configure_shards(2, layout=ring)
+    sched = build_scheduler(cluster, columnar=True)
+    drip = _drip_for(sched)
+    live = [name for name, _a, _al in node_specs]
+    fresh = 0
+
+    for step in range(60):
+        roll = rng.random()
+        if roll < 0.5 and live:
+            nm = rng.choice(live)
+            m = rng.choice(METRICS)
+            cluster.patch_node_annotation(
+                nm, m, _anno(rng.uniform(0, 1), 30.0))
+        elif roll < 0.62:
+            nm = f"grown-{fresh:03d}"
+            fresh += 1
+            cluster.add_node(Node(
+                name=nm,
+                annotations={m: _anno(0.3, 30.0) for m in METRICS},
+            ))
+            live.append(nm)
+        elif roll < 0.72 and len(live) > 8:
+            cluster.delete_node(live.pop(rng.randrange(len(live))))
+        elif roll < 0.82 and live:
+            cluster.patch_node_annotations_columns(
+                list(live),
+                {METRICS[0]: [
+                    _anno(rng.uniform(0, 1), 30.0)] * len(live)},
+            )
+        else:
+            points, owners = ring.tokens()
+            cluster.reshard(ring.with_moves(
+                [(rng.randrange(len(points)), rng.randrange(2))]))
+        drip.ensure(NOW)
+
+        if step % 15 == 7:
+            fresh_sched = build_scheduler(cluster, columnar=True)
+            _assert_columns_bit_identical(drip, _drip_for(fresh_sched))
+
+    assert drip.stats["dirty_patches"] > 0
+
+    fresh_sched = build_scheduler(cluster, columnar=True)
+    _assert_columns_bit_identical(drip, _drip_for(fresh_sched))
+
+    # scalar oracle on the survivors
+    pods = [(f"p{i:03d}", 100, 1 << 20, False) for i in range(12)]
+    got = [sched.schedule_one(make_pod(*p)) for p in pods]
+    oracle = build_scheduler(cluster, columnar=False)
+    want = [oracle.schedule_one(make_pod(*p)) for p in pods]
+    assert [
+        (r.node, r.feasible, r.reason) for r in got
+    ] == [(r.node, r.feasible, r.reason) for r in want]
+
+
+def test_overrun_falls_back_to_identity_sweep_with_same_columns():
+    rng = random.Random(2)
+    node_specs = fuzz_node_specs(rng, 30)
+    cluster = ClusterState(dirty_journal_cap=4)
+    for name, anno, allocatable in node_specs:
+        kwargs = {"allocatable": allocatable} if allocatable else {}
+        cluster.add_node(Node(name=name, annotations=dict(anno), **kwargs))
+    sched = build_scheduler(cluster, columnar=True)
+    drip = _drip_for(sched)
+    # burst past the cap between ensures: journal can't cover the gap
+    for i in range(12):
+        cluster.patch_node_annotation(
+            node_specs[i][0], METRICS[0], _anno(0.4, 20.0))
+    drip.ensure(NOW)
+    assert cluster.dirty_journal_stats()["overruns"] > 0
+    fresh = build_scheduler(cluster, columnar=True)
+    _assert_columns_bit_identical(drip, _drip_for(fresh))
+
+
+def test_dirty_patch_single_write_touches_one_row():
+    specs = [
+        (f"node-{i:02d}", {m: _anno(0.30, 30.0) for m in METRICS}, None)
+        for i in range(50)
+    ]
+    cluster = build_cluster(specs)
+    sched = build_scheduler(cluster, columnar=True)
+    drip = _drip_for(sched)
+    sweeps = drip.stats["full_sweeps"]
+    cluster.patch_node_annotation("node-07", METRICS[0], _anno(0.9, 10.0))
+    drip.ensure(NOW)
+    assert drip.stats["dirty_patches"] >= 1
+    assert drip.stats["dirty_rows"] == 1
+    assert drip.stats["full_sweeps"] == sweeps  # no identity sweep
+    fresh = build_scheduler(cluster, columnar=True)
+    _assert_columns_bit_identical(drip, _drip_for(fresh))
+
+
+def test_device_cache_scatter_equals_full_upload():
+    from crane_scheduler_tpu.scorer.drip_batch import DripBatchKernel
+
+    specs = [
+        (f"node-{i:02d}", {m: _anno(0.30, 30.0) for m in METRICS}, None)
+        for i in range(20)
+    ]
+    cluster = build_cluster(specs)
+    sched = build_scheduler(cluster, columnar=True, fit=False)
+    drip = _drip_for(sched)
+    kern = DripBatchKernel()
+    vecs = np.zeros((2, 4), dtype=np.int64)
+    base = kern.dispatch(
+        drip.schedulable, drip.weighted, None, None, vecs,
+        col_version=drip.col_epoch, col_delta=drip.dirty_rows_between,
+    )
+    cluster.patch_node_annotation("node-03", METRICS[0], _anno(0.9, 5.0))
+    drip.ensure(NOW)
+    patched = kern.dispatch(
+        drip.schedulable, drip.weighted, None, None, vecs,
+        col_version=drip.col_epoch, col_delta=drip.dirty_rows_between,
+    )
+    assert kern._cols.scatters >= 1  # the delta path actually ran
+    fresh_kern = DripBatchKernel()
+    want = fresh_kern.dispatch(
+        drip.schedulable, drip.weighted, None, None, vecs,
+    )
+    for got_col, want_col in zip(patched, want):
+        np.testing.assert_array_equal(np.asarray(got_col),
+                                      np.asarray(want_col))
+    del base
+
+
+# -- store only_names --------------------------------------------------------
+
+
+def test_store_columnar_ingest_only_names_patches_subset():
+    from crane_scheduler_tpu.loadstore import NodeLoadStore
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY, compile_policy
+
+    tensors = compile_policy(DEFAULT_POLICY)
+    metric = tensors.metric_names[0]
+    col = tensors.metric_index[metric]
+
+    def row(store, name):
+        snap = store.snapshot(bucket=4)
+        return snap.values[snap.node_names.index(name), col]
+
+    names = ["a", "b", "c"]
+    keys = [metric, metric, metric]
+    offsets = [0, 1, 2, 3]
+    vals = [_anno(0.1, 10.0), _anno(0.2, 10.0), _anno(0.3, 10.0)]
+    store = NodeLoadStore(tensors)
+    store.ingest_annotation_columns(names, keys, vals, offsets)
+    before_b = row(store, "b")
+    vals2 = [_anno(0.9, 1.0), _anno(0.8, 1.0), _anno(0.7, 1.0)]
+    store.ingest_annotation_columns(
+        names, keys, vals2, offsets, only_names={"c"})
+    assert row(store, "b") == before_b  # untouched row
+    # ...and equals a store that only ever ingested c's named patch
+    full = NodeLoadStore(tensors)
+    full.ingest_annotation_columns(names, keys, vals, offsets)
+    full.ingest_annotation_columns(
+        ["c"], [metric], [_anno(0.7, 1.0)], [0, 1])
+    assert row(store, "c") == row(full, "c")

@@ -1,0 +1,368 @@
+"""Reshard smoke gate (``make reshard-smoke``): a TRUE multi-process
+soak of ``--shards``/``--shard-index`` — two separate scheduler
+PROCESSES (not threads) serve one wire-stub apiserver under a shared
+consistent-hash ring file, with a SIGKILL + journal failover AND one
+ring move landing mid-storm. Fails CI unless
+
+  * both worker processes come up, adopt the ring file, and bind pods
+    over the wire (pod-hash ownership: no two processes ever own the
+    same pod),
+  * worker 0 survives a mid-storm SIGKILL: the restarted process
+    replays + reconciles its intent journal (PR 12) BEFORE binding and
+    finishes its shard's queue,
+  * a higher-versioned ring written mid-storm is adopted LIVE by the
+    running workers (a ``reshard`` event with moved nodes is printed;
+    late pods published after the move force every worker through a
+    ring poll before teardown, so adoption cannot race a fast storm),
+  * every pod is bound exactly once — the stub's per-pod
+    ``bind_posts == 1`` oracle and ``duplicate_binds == 0`` hold across
+    the kill AND the ring move,
+  * the dirty-journal/reshard metric families
+    (``crane_dirty_journal_overruns_total``, ``crane_dirty_journal_depth``,
+    ``crane_reshard_moved_names_total``, ``crane_dirty_rows_total``)
+    render through the strict exposition parser.
+
+Exit 0 = every check passed; any violation prints the failure and
+exits nonzero.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+_STUB = os.path.join(_REPO, "tests", "kube_stub.py")
+
+N_NODES = 32
+N_PODS = 60
+EXTRA_PODS = 12  # published AFTER the ring move; see phase 3b
+SHARDS = 2
+RUN_CAP = 120.0  # per-worker --run-seconds safety cap
+
+
+def _load_stub():
+    spec = importlib.util.spec_from_file_location("kube_stub", _STUB)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_ring(path: str, ring) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(ring.spec_dict(), f)
+    os.replace(tmp, path)  # atomic: pollers never see a partial spec
+
+
+def _spawn(url: str, index: int, ring_file: str, jdir: str):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_REPO)
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "crane_scheduler_tpu.cli.scheduler_main",
+            "--config", os.path.join(
+                _REPO, "deploy", "dynamic", "scheduler-config.yaml"),
+            "--master", url,
+            "--serve", "--run-seconds", str(RUN_CAP),
+            "--window", "8",
+            "--shards", str(SHARDS), "--shard-index", str(index),
+            "--shard-ring", ring_file,
+            "--journal-dir", jdir,
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        env=env, text=True, cwd=_REPO,
+    )
+
+
+def _bound(server) -> int:
+    return sum(
+        1 for p in server.state.pods.values()
+        if p["spec"].get("nodeName")
+    )
+
+
+def _wait(predicate, timeout: float, interval: float = 0.1) -> bool:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def main() -> int:
+    from crane_scheduler_tpu.cluster.shards import HashRing
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+    from crane_scheduler_tpu.utils import format_local_time
+
+    failures = 0
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        nonlocal failures
+        mark = "ok" if ok else "FAIL"
+        print(f"[reshard-smoke] {name}: "
+              f"{mark}{' — ' + detail if detail else ''}")
+        if not ok:
+            failures += 1
+
+    kube_stub = _load_stub()
+    server = kube_stub.KubeStubServer().start()
+    root = tempfile.mkdtemp(prefix="crane-reshard-smoke-")
+    ring_file = os.path.join(root, "ring.json")
+    procs: list = []
+    outs: list[tuple[str, str]] = []
+
+    def collect(p, grace=20.0) -> tuple[str, str]:
+        try:
+            out, err = p.communicate(timeout=grace)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+        outs.append((out or "", err or ""))
+        return outs[-1]
+
+    try:
+        now = time.time()
+        metrics = tuple(sp.name for sp in DEFAULT_POLICY.spec.sync_period)
+        for i in range(N_NODES):
+            anno = {
+                m: f"{0.20 + 0.01 * (i % 7):.5f},"
+                   f"{format_local_time(now - 20.0)}"
+                for m in metrics
+            }
+            server.state.add_node(f"node-{i:03d}", f"10.0.0.{i}", anno)
+        for i in range(N_PODS):
+            server.state.add_pod(
+                "default", f"p{i:03d}",
+                spec={"containers": [{
+                    "name": "c",
+                    "resources": {"requests": {
+                        "cpu": "50m", "memory": "16Mi",
+                    }},
+                }]},
+            )
+
+        ring = HashRing(SHARDS, vnodes=32)
+        _write_ring(ring_file, ring)
+        jdirs = [os.path.join(root, f"intents-{i}") for i in range(SHARDS)]
+        procs = [
+            _spawn(server.url, i, ring_file, jdirs[i])
+            for i in range(SHARDS)
+        ]
+
+        # -- phase 1: both processes bind over the wire ----------------
+        check(
+            "storm started (first binds landed)",
+            _wait(lambda: _bound(server) >= N_PODS // 6, timeout=90.0),
+            f"bound={_bound(server)}/{N_PODS}",
+        )
+
+        # -- phase 2: SIGKILL worker 0 mid-storm, restart on its journal
+        procs[0].send_signal(signal.SIGKILL)
+        collect(procs[0])  # drain its pipes; SIGKILL = no final stats
+        check("worker 0 SIGKILLed mid-storm", True,
+              f"bound_at_kill={_bound(server)}")
+        procs[0] = _spawn(server.url, 0, ring_file, jdirs[0])
+
+        # -- phase 3: one ring move lands mid-storm --------------------
+        _wait(lambda: _bound(server) >= N_PODS // 3, timeout=60.0)
+        points, owners = ring.tokens()
+        idx = next(i for i, s in enumerate(owners) if s == 0)
+        moved_ring = ring.with_moves([(idx, 1)])
+        _write_ring(ring_file, moved_ring)
+        check("mid-storm ring move published",
+              moved_ring.version > ring.version,
+              f"version {ring.version} -> {moved_ring.version}")
+
+        # -- phase 3b: late pods land AFTER the move. A fast storm can
+        # drain every original pod before the kill even fires; binding
+        # work published after the ring write forces EVERY worker —
+        # including the phase-2 respawn, which must finish startup to
+        # claim its share — through at least one serve-loop iteration
+        # (and thus one ring-file poll) past the new mtime, so the
+        # adoption and clean-exit checks below cannot race the storm.
+        from crane_scheduler_tpu.cluster.shards import shard_of
+
+        late = [f"late-{i:03d}" for i in range(EXTRA_PODS)]
+        i = EXTRA_PODS
+        while {
+            shard_of(f"default/{n}", SHARDS) for n in late
+        } != set(range(SHARDS)):
+            late.append(f"late-{i:03d}")
+            i += 1
+        for name in late:
+            server.state.add_pod(
+                "default", name,
+                spec={"containers": [{
+                    "name": "c",
+                    "resources": {"requests": {
+                        "cpu": "50m", "memory": "16Mi",
+                    }},
+                }]},
+            )
+        total = N_PODS + len(late)
+
+        # -- phase 4: every pod bound despite kill + move --------------
+        check(
+            "every pod bound across kill and ring move",
+            _wait(lambda: _bound(server) == total, timeout=120.0),
+            f"bound={_bound(server)}/{total}",
+        )
+
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            collect(p)
+
+        # -- oracles over the wire stub --------------------------------
+        posts = dict(server.state.bind_posts)
+        check("per-pod bind_posts == 1 oracle",
+              len(posts) == total and all(v == 1 for v in posts.values()),
+              f"pods={len(posts)} max={max(posts.values(), default=0)}")
+        check("zero duplicate binding POSTs",
+              server.state.duplicate_binds() == 0,
+              f"dup={server.state.duplicate_binds()}")
+
+        # -- live ring adoption: the SURVIVING worker must have printed
+        # a reshard event; the restarted worker 0 adopts it too when its
+        # restart preceded the move
+        events = []
+        for out, _err in outs:
+            for line in out.splitlines():
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    continue
+                if doc.get("event") == "reshard":
+                    events.append(doc)
+        check("running workers adopted the ring move live",
+              any(e.get("moved_nodes", 0) > 0
+                  and e.get("ring_version") == moved_ring.version
+                  for e in events),
+              f"events={events}")
+
+        finals = []
+        for out, _err in outs:
+            lines = [ln for ln in out.strip().splitlines() if ln]
+            for ln in reversed(lines):
+                try:
+                    doc = json.loads(ln)
+                except ValueError:
+                    continue
+                if doc.get("mode") == "serve":
+                    finals.append(doc)
+                    break
+        check("every surviving worker exited cleanly with stats",
+              len(finals) == SHARDS
+              and all("scheduled" in d for d in finals),
+              f"finals={len(finals)}/{SHARDS}: "
+              f"scheduled={[d.get('scheduled') for d in finals]}")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+
+    # -- metric families: in-process plane pass through the strict
+    # exposition parser (the subprocess workers run telemetry-less)
+    from crane_scheduler_tpu.cluster.state import ClusterState, Node
+    from crane_scheduler_tpu.cluster.shards import HashRing as _Ring
+    from crane_scheduler_tpu.fit import FitTracker, ResourceFitPlugin
+    from crane_scheduler_tpu.framework.scheduler import Scheduler
+    from crane_scheduler_tpu.framework.shardplane import (
+        ShardedPlacementPlane,
+    )
+    from crane_scheduler_tpu.plugins import DynamicPlugin
+    from crane_scheduler_tpu.telemetry import Telemetry
+    from crane_scheduler_tpu.telemetry.expfmt import (
+        ExpositionError,
+        parse_exposition,
+    )
+
+    tel = Telemetry()
+    ring2 = _Ring(2, vnodes=16)
+    # tiny journal cap: the add_node burst below overruns it, so the
+    # overruns counter provably moves
+    cs = ClusterState(dirty_journal_cap=4)
+    plane = ShardedPlacementPlane(cs, 2, telemetry=tel, layout=ring2)
+
+    def factory(view):
+        sched = Scheduler(view, clock=time.time, columnar=True,
+                          telemetry=tel)
+        sched.register(ResourceFitPlugin(FitTracker(view, telemetry=tel)),
+                       weight=1)
+        sched.register(DynamicPlugin(DEFAULT_POLICY, clock=time.time),
+                       weight=3)
+        return sched
+
+    plane.add_scheduler(factory)
+    now = time.time()
+    metrics = tuple(sp.name for sp in DEFAULT_POLICY.spec.sync_period)
+    for i in range(24):
+        cs.add_node(Node(
+            name=f"node-{i:03d}",
+            annotations={
+                m: f"0.25000,{format_local_time(now - 10.0)}"
+                for m in metrics
+            },
+        ))
+    for v in plane.views:
+        v.list_nodes()
+
+    from crane_scheduler_tpu.cluster.state import (
+        Container,
+        Pod,
+        ResourceRequirements,
+    )
+
+    def mk_pod(name):
+        return Pod(name=name, containers=(Container(
+            "c", ResourceRequirements(requests={
+                "cpu": 50.0, "memory": float(1 << 20)})),))
+
+    for s in plane.schedulers:
+        s.schedule_one(mk_pod(f"warm-{s.cluster.spec.index}"))
+    # named write -> O(dirty) consumers move crane_dirty_rows_total
+    cs.patch_node_annotation(
+        "node-000", metrics[0], f"0.30000,{format_local_time(now)}")
+    for s in plane.schedulers:
+        s.schedule_one(mk_pod(f"dirty-{s.cluster.spec.index}"))
+    points2, owners2 = ring2.tokens()
+    idx2 = next(i for i, s in enumerate(owners2) if s == 0)
+    plane.reshard(ring2.with_moves([(idx2, 1)]))
+    plane.refresh_node_gauges()
+
+    try:
+        families = parse_exposition(tel.registry.render())
+        check("registry strict parse", True, f"{len(families)} families")
+    except ExpositionError as e:
+        families = {}
+        check("registry strict parse", False, str(e))
+    for required in (
+        "crane_dirty_journal_overruns_total",
+        "crane_dirty_journal_depth",
+        "crane_reshard_moved_names_total",
+        "crane_dirty_rows_total",
+    ):
+        check(f"family {required}", required in families)
+    journal_stats = cs.dirty_journal_stats()
+    check("journal overrun fallback counted",
+          journal_stats["overruns"] > 0, f"{journal_stats}")
+
+    print(f"[reshard-smoke] {'PASS' if not failures else 'FAIL'}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
