@@ -10,6 +10,7 @@ IMAGE_SCHEDULER := $(REGISTRY)/crane-scheduler-tpu:$(GIT_VERSION)
 
 .PHONY: all native test test-fast bench sim e2e metrics-smoke \
 	desched-smoke chaos-smoke recovery-smoke trace-smoke drip-smoke \
+	gang-smoke \
 	shard-smoke reshard-smoke overload-smoke replica-smoke fleet-smoke \
 	dashboards \
 	clean images image-annotator image-scheduler push-images
@@ -50,6 +51,14 @@ desched-smoke:
 # crane_drip_kernel_seconds families must strict-parse
 drip-smoke:
 	$(PYTHON) tools/drip_smoke.py
+
+# a mixed-template gang storm through schedule_gang_queue against the
+# wire stub: every gang must ride the batched window kernel (zero
+# fallbacks), window placements must equal the host window solver,
+# per-pod bind_posts == 1 with zero duplicate POSTs, and the
+# crane_gang_* families must strict-parse — see doc/gang-path.md
+gang-smoke:
+	$(PYTHON) tools/gang_smoke.py
 
 # two drip schedulers racing over one contended queue against the wire
 # stub on a forced 8-way host-device placement mesh: per-pod

@@ -4367,12 +4367,312 @@ def config21(dtype, rtt, n_nodes=1_000_000):
         f"storm gate: ring keyspace {ring_vs_static}x < 0.9x static"
 
 
+def config22(dtype, rtt, n_nodes=50_000, wire_nodes=5_000):
+    """Round-17 tentpole gate: the device-resident multi-gang engine —
+    version-cached gang columns, batched water-filling windows with
+    in-program capacity folds, heterogeneous multi-template queues.
+
+    Legs (twin in-process 50k-node clusters seeded identically via the
+    config21 shared-annotation-variant idiom, unless noted):
+
+      sequential — ``schedule_gang(template, count, bind=True)`` loop
+                   over 24 heterogeneous gangs: the pre-engine path
+                   pays a full ``_prepare`` (filter+score columns, fit
+                   capacity) per gang — the in-run baseline;
+      window     — ``schedule_gang_queue`` over the SAME 24 gangs,
+                   window=8: version-cached GangColumns build once,
+                   then each window is ONE jitted lax.scan
+                   (water-filling per gang against the in-program
+                   capacity fold carry, one D2H), host fold replay
+                   keeps device==host. A warm-up window of infeasible
+                   gangs (no binds, no state change) absorbs the
+                   one-time column build + jit compile, config15-style;
+                   steady accounting subtracts any residual compile;
+      oracle     — in-run parity: every window gang replayed through
+                   ``gang_window_host`` over the engine's own columns
+                   (capacity un-folded by hand), and the first 2 gangs
+                   through the O(P*N)-Python ``gang_assign_oracle`` —
+                   counts must match the storm's placements node for
+                   node (the sequential leg inherits the same oracle
+                   parity through the bit-identical-placements assert);
+      dirty      — ONE named annotation patch after the storm: the next
+                   ``ensure()`` must refresh the gang columns O(dirty)
+                   (journal replay, one row re-scored), vs the same
+                   patch with journal coverage dropped
+                   (``forget_dirty_names`` = relist) paying the
+                   identity sweep — the in-run full-prepare baseline;
+      wire       — the same gang storm through a 5k-node stub-apiserver
+                   mirror: every placed pod binds exactly once (stub
+                   ``bind_posts``/``duplicate_binds`` oracle).
+
+    Gates: window leg >= 20x faster per gang than the sequential leg
+    (steady), placements bit-identical across sequential/window/host/
+    oracle legs, dirty gang-column refresh < 5 ms at 50k nodes, zero
+    duplicate binding POSTs and bind_posts == placed on the wire."""
+    import numpy as np
+
+    from crane_scheduler_tpu.cluster import (
+        ClusterState,
+        Container,
+        Node,
+        Pod,
+        ResourceRequirements,
+    )
+    from crane_scheduler_tpu.cluster.kube import KubeClusterClient
+    from crane_scheduler_tpu.constants import MAX_NODE_SCORE
+    from crane_scheduler_tpu.fit import (
+        copy_counts_rows,
+        pod_fit_request,
+        request_vec,
+    )
+    from crane_scheduler_tpu.framework.scheduler import BatchScheduler
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+    from crane_scheduler_tpu.scorer.gang_batch import gang_window_host
+    from crane_scheduler_tpu.scorer.topk import gang_assign_oracle
+    from crane_scheduler_tpu.utils import format_local_time, parse_local_time
+
+    now = parse_local_time("2026-07-30T00:00:00Z") + 30.0
+    metric_names = [sp.name for sp in DEFAULT_POLICY.spec.sync_period]
+    alloc = {"cpu": "16", "memory": "64Gi",
+             "ephemeral-storage": "100Gi", "pods": "110"}
+    ts = format_local_time(now - 20.0)
+    variants = [
+        {m: f"{0.20 + 0.01 * ((j + k) % 11):.5f},{ts}"
+         for k, m in enumerate(metric_names)}
+        for j in range(8)
+    ]
+
+    def build_cluster(n):
+        cluster = ClusterState()
+        cluster.replace_nodes(
+            Node(name=f"node-{i:05d}", annotations=variants[i % 8],
+                 allocatable=alloc)
+            for i in range(n)
+        )
+        return cluster
+
+    # 24 heterogeneous gangs: 6 request/size shapes cycled 4x
+    shapes = ((500, 12), (1000, 8), (250, 16), (1500, 6), (750, 10),
+              (2000, 4)) * 4
+
+    def make_gangs(tag):
+        return [
+            (Pod(
+                name=f"g22-{tag}-{j:03d}", namespace="default",
+                containers=(Container("c", ResourceRequirements(
+                    requests={"cpu": f"{cpu}m", "memory": "256Mi"},
+                )),),
+            ), count)
+            for j, (cpu, count) in enumerate(shapes)
+        ]
+
+    total_pods = sum(c for _, c in shapes)
+    window = 8
+
+    # -- sequential leg ------------------------------------------------------
+    batch_a = BatchScheduler(build_cluster(n_nodes), DEFAULT_POLICY,
+                             clock=lambda: now)
+    gangs_a = make_gangs("seq")
+    seq_out = []
+    t0 = time.perf_counter()
+    for t, c in gangs_a:
+        r = batch_a.schedule_gang(t, c, bind=True)
+        assert not r.unassigned, f"sequential gang {t.name} unplaced"
+        seq_out.append(dict(r.assignments))
+    seq_wall = time.perf_counter() - t0
+    seq_per_gang = seq_wall * 1e3 / len(shapes)
+    log(f"config22[seq]: {len(shapes)} gangs x {n_nodes} nodes in "
+        f"{seq_wall * 1e3:.0f} ms ({seq_per_gang:.1f} ms/gang)")
+
+    # -- window leg ----------------------------------------------------------
+    cluster_b = build_cluster(n_nodes)
+    batch_b = BatchScheduler(cluster_b, DEFAULT_POLICY, clock=lambda: now)
+    # warm-up: a full window of infeasible gangs (every request exceeds
+    # any node) in the storm's own shape bucket — builds the gang
+    # columns and pays the jit compile with zero binds and zero
+    # cluster-state change, so placement parity with the sequential
+    # leg still holds
+    warm = [
+        (Pod(
+            name=f"g22-warm-{i}", namespace="default",
+            containers=(Container("c", ResourceRequirements(
+                requests={"cpu": f"{100_000 + i * 1000}m",
+                          "memory": "256Mi"},
+            )),),
+        ), 1)
+        for i in range(window)
+    ]
+    warm_out = batch_b.schedule_gang_queue(warm, window=window)
+    assert all(not o.assignments for o in warm_out), \
+        "warm-up gang unexpectedly placed (would break parity)"
+    pre = batch_b.gang_stats()
+    gangs_b = make_gangs("seq")  # same names as the sequential leg
+    t0 = time.perf_counter()
+    win_out = batch_b.schedule_gang_queue(gangs_b, window=window)
+    win_wall = time.perf_counter() - t0
+    stats = batch_b.gang_stats()
+    assert stats["fallbacks"] == 0, "window leg fell back to sequential"
+    assert all(o.source == "window" for o in win_out)
+    ks = stats["kernel_seconds"][len(pre["kernel_seconds"]):]
+    steady_s = win_wall
+    if len(ks) > 1:
+        warm_mean = sum(ks[1:]) / len(ks[1:])
+        steady_s = win_wall - max(0.0, ks[0] - warm_mean)
+    win_per_gang = steady_s * 1e3 / len(shapes)
+    placed = sum(len(o.assignments) for o in win_out)
+    assert placed == total_pods, f"window leg placed {placed}/{total_pods}"
+    assert [dict(o.assignments) for o in win_out] == seq_out, \
+        "window placements diverged from the sequential schedule_gang loop"
+    speedup = seq_per_gang / win_per_gang
+    windows = stats["windows"] - pre["windows"]
+    log(f"config22[window]: {len(shapes)} gangs in {windows} windows, "
+        f"{win_per_gang:.2f} ms/gang steady ({win_wall * 1e3 / len(shapes):.2f} "
+        f"incl. residual compile), speedup {speedup:.1f}x")
+
+    # -- oracle leg (window columns, capacity un-folded by hand) -------------
+    eng = batch_b._gang_engine
+    cols = eng["cols"]
+    cols.ensure(now)
+    pos = {name: i for i, name in enumerate(cols.names)}
+    free0 = cols.free.copy()
+    vecs = [request_vec(pod_fit_request(t)) for t, _c in gangs_b]
+    for (t, _c), o, vec in zip(gangs_b, win_out, vecs):
+        for node in o.assignments.values():
+            free0[pos[node]] += vec
+    host_res, _ = gang_window_host(
+        cols.score, cols.schedulable, cols.bounded, free0,
+        [(c, vec, None) for (_t, c), vec in zip(gangs_b, vecs)],
+        batch_b.tensors.hv_count, dynamic_weight=3,
+        max_offset=MAX_NODE_SCORE * 2,
+    )
+    free_c = free0.astype(np.int64).copy()
+    oracle_gangs = 0
+    for j, ((t, c), o, vec) in enumerate(zip(gangs_b, win_out, vecs)):
+        got = np.zeros(len(cols.names), np.int64)
+        for node in o.assignments.values():
+            got[pos[node]] += 1
+        assert np.array_equal(got, np.asarray(host_res[j].counts)), \
+            f"gang {j} diverged from gang_window_host"
+        if j < 2:  # the Python oracle is O(P*N) per gang
+            cap = copy_counts_rows(free_c, cols.bounded, vec)
+            orc = gang_assign_oracle(
+                cols.score, cols.schedulable, c,
+                batch_b.tensors.hv_count, capacity=cap,
+                dynamic_weight=3, max_offset=MAX_NODE_SCORE * 2,
+            )
+            assert np.array_equal(got, np.asarray(orc.counts)), \
+                f"gang {j} diverged from gang_assign_oracle"
+            oracle_gangs += 1
+        free_c -= got[:, None] * np.asarray(vec, np.int64)[None, :]
+    log(f"config22[oracle]: {len(shapes)} gangs host-replayed, "
+        f"{oracle_gangs} oracle-checked — bit-identical")
+
+    # -- dirty leg: O(dirty) gang-column refresh vs identity sweep -----------
+    def patch_one(name):
+        node = cluster_b.get_node(name)
+        k = next(iter(node.annotations))
+        v = node.annotations[k]
+        cluster_b.patch_node_annotation(name, k, v.replace("0.2", "0.3", 1))
+
+    pre_patches = dict(cols.stats)
+    patch_one("node-00017")
+    t0 = time.perf_counter()
+    cols.ensure(now)
+    dirty_ms = (time.perf_counter() - t0) * 1e3
+    assert cols.stats["dirty_patches"] > pre_patches["dirty_patches"], \
+        "named patch did not take the O(dirty) journal path"
+    # the same patch shape with journal coverage dropped AFTER the
+    # write (what a relist does): the entry falls below the journal
+    # floor, so the consumer pays the pre-journal identity sweep
+    patch_one("node-00018")
+    cluster_b.forget_dirty_names()
+    t0 = time.perf_counter()
+    cols.ensure(now)
+    sweep_ms = (time.perf_counter() - t0) * 1e3
+    log(f"config22[dirty]: O(dirty) refresh {dirty_ms:.2f} ms vs "
+        f"identity sweep {sweep_ms:.1f} ms "
+        f"({sweep_ms / max(dirty_ms, 1e-9):.0f}x)")
+
+    # -- wire leg ------------------------------------------------------------
+    kube_stub = _load_kube_stub()
+    server = kube_stub.KubeStubSubprocess()
+    try:
+        server.seed(wire_nodes, "node-", metrics=metric_names,
+                    allocatable=alloc)
+        client = KubeClusterClient(server.url, list_page_limit=2000)
+        client.start()
+        assert len(client.list_nodes()) == wire_nodes
+        batch_w = BatchScheduler(client, DEFAULT_POLICY, clock=lambda: now)
+        t0 = time.perf_counter()
+        wire_out = batch_w.schedule_gang_queue(make_gangs("wire"),
+                                               window=window)
+        wire_wall = time.perf_counter() - t0
+        wire_placed = sum(len(o.assignments) for o in wire_out)
+        assert wire_placed == total_pods, \
+            f"wire leg placed {wire_placed}/{total_pods}"
+        assert batch_w.gang_stats()["fallbacks"] == 0
+        wstats = server.stats()
+        assert wstats["duplicate_binds"] == 0, "double-POSTed gang bind!"
+        assert wstats["bind_posts"] == wire_placed, \
+            f"bind POSTs {wstats['bind_posts']} != {wire_placed} placed"
+        client.stop()
+        log(f"config22[wire]: {wire_placed} pods over {wire_nodes}-node "
+            f"stub in {wire_wall * 1e3:.0f} ms — bind_posts=="
+            f"{wstats['bind_posts']}, zero duplicates")
+    finally:
+        server.stop()
+
+    emit({"config": 22,
+          "desc": "device-resident multi-gang engine: sequential "
+                  "schedule_gang loop vs batched schedule_gang_queue "
+                  f"windows over twin {n_nodes}-node clusters, 24 "
+                  "heterogeneous gangs (6 template shapes), in-run "
+                  "host/oracle parity, O(dirty) gang-column refresh, "
+                  "wire bind oracle",
+          "n_nodes": n_nodes,
+          "gangs": len(shapes),
+          "pods": total_pods,
+          "per_gang_ms_sequential": round(seq_per_gang, 1),
+          "per_gang_ms_window": round(win_per_gang, 2),
+          "per_gang_ms_window_incl_compile": round(
+              win_wall * 1e3 / len(shapes), 2),
+          "speedup_per_gang": round(speedup, 1),
+          "dispatch_windows": windows,
+          "kernel_ms_warm": round(
+              sum(ks[1:]) * 1e3 / len(ks[1:]), 2) if len(ks) > 1 else None,
+          "dirty_refresh_ms": round(dirty_ms, 2),
+          "identity_sweep_ms": round(sweep_ms, 1),
+          "dirty_speedup": round(sweep_ms / max(dirty_ms, 1e-9), 1),
+          "gang_stats": {k: stats[k] for k in
+                         ("windows", "gangs", "pods", "fallbacks")},
+          "columns": dict(cols.stats),
+          "wire": {"nodes": wire_nodes, "pods": wire_placed,
+                   "wall_ms": round(wire_wall * 1e3, 1),
+                   "bind_posts": wstats["bind_posts"],
+                   "duplicate_binds": wstats["duplicate_binds"]},
+          "placement_parity": "ok",
+          "note": "gates: window leg >=20x faster per gang than the "
+                  "in-run sequential schedule_gang baseline (steady; "
+                  "one-time column build + jit compile absorbed by an "
+                  "infeasible warm-up window, residual compile "
+                  "accounted), placements bit-identical across "
+                  "sequential/window/gang_window_host legs and "
+                  "gang_assign_oracle on the first 2 gangs, named-patch "
+                  "gang-column refresh <5 ms at 50k nodes (journal "
+                  "O(dirty) vs identity sweep), zero duplicate binding "
+                  "POSTs and bind_posts == placed on the wire"})
+    assert speedup >= 20.0, \
+        f"gang dispatch gate: {speedup:.1f}x < 20x vs sequential"
+    assert dirty_ms < 5.0, \
+        f"O(dirty) gate: gang columns refreshed in {dirty_ms:.2f} ms"
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--device", choices=["cpu", "default"], default="default")
     parser.add_argument(
         "--configs",
-        default="1,2,3,4,5,6,7,7b,8,9,10,11,12,13,14,15,16,17,18,19,20,21",
+        default="1,2,3,4,5,6,7,7b,8,9,10,11,12,13,14,15,16,17,18,19,20,21,22",
     )
     parser.add_argument("--f64", action="store_true")
     args = parser.parse_args(argv)
@@ -4437,6 +4737,8 @@ def main(argv=None) -> int:
         config20(dtype, rtt)
     if 21 in todo:
         config21(dtype, rtt)
+    if 22 in todo:
+        config22(dtype, rtt)
     if _METER is not None:
         _METER.stop()
     return 0

@@ -2674,6 +2674,15 @@ class KubeClusterClient:
             return
         self._mirror.add_pod(pod)
 
+    def add_pods(self, pods) -> None:
+        """Bulk twin of ``add_pod`` (``ClusterState.add_pods`` parity —
+        the grouped gang bind creates each node group's copies through
+        this). A pod whose creation POST fails is simply absent, so the
+        subsequent binding POST for it fails too and the bind path
+        reports it dropped."""
+        for pod in pods:
+            self.add_pod(pod)
+
     def _post_batch(self, items: list[tuple[str, str, dict]]) -> list[bool]:
         """THE non-idempotent POST batch: ``items`` are (key, path,
         body). Large plain-http batches ride the native engine; 429s —

@@ -12,6 +12,7 @@ the ``ClusterState`` mirror, exposed two ways:
 from .tracker import (
     UNBOUNDED,
     FitTracker,
+    copy_counts_rows,
     pod_fit_request,
     request_vec,
     row_fail_reason,
@@ -21,6 +22,7 @@ from .plugin import PLUGIN_NAME, ResourceFitPlugin
 __all__ = [
     "UNBOUNDED",
     "FitTracker",
+    "copy_counts_rows",
     "pod_fit_request",
     "request_vec",
     "row_fail_reason",

@@ -89,6 +89,25 @@ def request_vec(r: Resource) -> np.ndarray:
 _request_vec = request_vec
 
 
+def copy_counts_rows(
+    free: np.ndarray, bounded: np.ndarray, vec: np.ndarray
+) -> np.ndarray:
+    """``free_copy_counts`` math over an EXPLICIT free matrix: how many
+    request rows ``vec`` fit in each row of ``free`` (int64[N,4]),
+    unbounded rows (``~bounded``) coming back UNBOUNDED. This is the
+    capacity derivation the gang window's host fold twin and the device
+    kernel both mirror — it must stay bit-identical to the tracker's
+    own ``free_copy_counts`` over the same rows (minus the scalar-
+    resources walk, which columnar callers route to the fallback)."""
+    counts = np.full((free.shape[0],), UNBOUNDED, dtype=np.int64)
+    clipped = np.clip(free, 0, None)
+    for d in range(_N_DIMS):
+        if vec[d] > 0:
+            np.minimum(counts, clipped[:, d] // vec[d], out=counts)
+    counts[~np.asarray(bounded, bool)] = UNBOUNDED
+    return counts
+
+
 def row_fail_reason(free_row, vec) -> str:
     """First failing dimension of a bounded free row against ``vec``,
     in NodeResourcesFit's check order and wording (pods slot first,

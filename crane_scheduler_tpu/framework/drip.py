@@ -54,7 +54,7 @@ from ..scorer.columns import (
 from ..scorer.topk import SegMaxTree
 from ..telemetry import maybe_span
 
-__all__ = ["DripColumns"]
+__all__ = ["DripColumns", "GangColumns"]
 
 _I64_MIN = np.int64(np.iinfo(np.int64).min)
 
@@ -72,16 +72,33 @@ class DripColumns:
     only ever written by ``ensure`` on the scheduling thread).
     """
 
+    # metric family names — subclasses (GangColumns) rename the whole
+    # family set while sharing every cache/journal mechanism
+    _HITS_METRIC = (
+        "crane_drip_column_hits_total",
+        "schedule_one calls served entirely from cached columns",
+    )
+    _REBUILDS_METRIC = (
+        "crane_drip_column_rebuilds_total",
+        "Drip column rebuilds by column family",
+    )
+    _DIRTY_CONSUMER = "drip"
+
     def __init__(
         self,
         cluster,
-        dyn,
-        dyn_weight: int,
-        order,
+        dyn=None,
+        dyn_weight: int = 1,
+        order=("dyn",),
         fit_tracker=None,
         telemetry=None,
         bucket_seconds: float = 0.25,
+        policy=None,
     ):
+        """``policy`` is the plugin-less alternative to ``dyn``: callers
+        that hold a ``DynamicSchedulerPolicy`` but no plugin instance
+        (the gang engine — BatchScheduler has no plugin registry) pass
+        it directly; exactly one of the two must be given."""
         self.cluster = cluster
         self._dyn = dyn
         self._dyn_weight = int(dyn_weight)
@@ -91,7 +108,9 @@ class DripColumns:
         self._order = tuple(order)
         self._tracker = fit_tracker
         self._telemetry = telemetry
-        self._tensors = compile_policy(dyn.policy)
+        if policy is None:
+            policy = dyn.policy
+        self._tensors = compile_policy(policy)
         self._store = NodeLoadStore(self._tensors)
         self._bucket_s = float(bucket_seconds)
 
@@ -109,6 +128,7 @@ class DripColumns:
         self._gather: tuple | None = None  # (layout_version, ids)
         self.schedulable: np.ndarray | None = None  # bool [N]
         self.fail_entry: np.ndarray | None = None  # int32 [N]
+        self.score: np.ndarray | None = None  # int64 [N] raw (0..100)
         self.weighted: np.ndarray | None = None  # int64 [N]
         # dirty-journal bookkeeping: rows touched since the last dynamic
         # column build (None = coverage lost, next build is full), and a
@@ -147,14 +167,9 @@ class DripColumns:
         self._m_hits = self._m_rebuilds = self._m_dirty_rows = None
         if telemetry is not None:
             reg = telemetry.registry
-            self._m_hits = reg.counter(
-                "crane_drip_column_hits_total",
-                "schedule_one calls served entirely from cached columns",
-            )
+            self._m_hits = reg.counter(*self._HITS_METRIC)
             self._m_rebuilds = reg.counter(
-                "crane_drip_column_rebuilds_total",
-                "Drip column rebuilds by column family",
-                ("column",),
+                *self._REBUILDS_METRIC, ("column",)
             )
             self._m_dirty_rows = reg.counter(
                 "crane_dirty_rows_total",
@@ -345,7 +360,7 @@ class DripColumns:
             self._store.bulk_ingest(items, skip_unchanged=False)
             self.stats["dirty_rows"] += len(items)
             if self._m_dirty_rows is not None:
-                self._m_dirty_rows.labels(consumer="drip").inc(len(items))
+                self._m_dirty_rows.labels(consumer=self._DIRTY_CONSUMER).inc(len(items))
         # splice the names list in place of a full relist: removals
         # drop their rows, additions append in sorted order (the same
         # discipline ShardView.list_nodes uses, so the identity sweep
@@ -370,7 +385,7 @@ class DripColumns:
         self._store.bulk_ingest(items)
         self.stats["dirty_rows"] += len(items)
         if self._m_dirty_rows is not None:
-            self._m_dirty_rows.labels(consumer="drip").inc(len(items))
+            self._m_dirty_rows.labels(consumer=self._DIRTY_CONSUMER).inc(len(items))
         pending = self._pending_rows
         if pending is not None:
             pos = self._pos_map()
@@ -402,7 +417,8 @@ class DripColumns:
             store.hot_ts[ids],
             now,
         )
-        self.weighted = score.astype(np.int64) * self._dyn_weight
+        self.score = score.astype(np.int64)
+        self.weighted = self.score * self._dyn_weight
         # fresh arrays: identity changed, the device cache re-uploads
         # regardless, so the scatter chain restarts here
         self.col_epoch += 1
@@ -438,7 +454,9 @@ class DripColumns:
         )
         self.schedulable[rows_arr] = sched
         self.fail_entry[rows_arr] = fail
-        self.weighted[rows_arr] = score.astype(np.int64) * self._dyn_weight
+        sc = score.astype(np.int64)
+        self.score[rows_arr] = sc
+        self.weighted[rows_arr] = sc * self._dyn_weight
         self.col_epoch += 1
         self._scatter_log.append((self.col_epoch, rows_arr))
         self._trim_scatter_log()
@@ -685,3 +703,98 @@ class DripColumns:
             if reason:
                 counts[reason] = counts.get(reason, 0) + 1
         return counts
+
+
+class GangColumns(DripColumns):
+    """The gang engine's column cache: every DripColumns mechanism —
+    version fences, dirty-name journal patches, fit fold discipline,
+    col_epoch scatter log — under the gang path's own metric families,
+    plus a per-node ACCELERATOR-TYPE column for heterogeneous queues.
+
+    The accel column interns each node's ``labels[accel_label]`` value
+    to a small integer id (``accel_types`` is the id -> label table; id
+    0 is the untyped/unlabeled default). It is keyed on
+    ``cluster.node_version`` like the dynamic ingest and patched
+    O(dirty) through the same journal — a label change on one node
+    re-reads one row. Per-accelerator throughput weight matrices (the
+    Gavel-style heterogeneity scoring) resolve against this column into
+    per-class score offsets; ``accel_epoch`` versions the column for
+    the engine's offset-row cache."""
+
+    _HITS_METRIC = (
+        "crane_gang_column_hits_total",
+        "Gang dispatch windows served entirely from cached columns",
+    )
+    _REBUILDS_METRIC = (
+        "crane_gang_column_rebuilds_total",
+        "Gang column rebuilds by column family",
+    )
+    _DIRTY_CONSUMER = "gang"
+
+    def __init__(self, *args, accel_label: str | None = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._accel_label = accel_label
+        self.accel_types: list[str] = [""]  # id 0 = untyped
+        self._accel_index: dict[str, int] = {"": 0}
+        self.accel: np.ndarray | None = None  # int32 [N] type ids
+        self._accel_names = None  # names list identity the column aligns to
+        self._accel_node_ver = -1
+        self.accel_epoch = 0
+
+    def _accel_type_id(self, node) -> int:
+        labels = getattr(node, "labels", None) if node is not None else None
+        label = (labels or {}).get(self._accel_label or "", "")
+        idx = self._accel_index.get(label)
+        if idx is None:
+            idx = len(self.accel_types)
+            self.accel_types.append(label)
+            self._accel_index[label] = idx
+        return idx
+
+    def ensure_accel(self) -> np.ndarray:
+        """Bring the accelerator-type column up to date (call after
+        ``ensure`` so ``names`` reflects current membership). Journal-
+        covered label writes patch O(dirty) rows; membership changes or
+        journal overruns rebuild the column in one sweep."""
+        cluster = self.cluster
+        nv = cluster.node_version
+        aligned = (
+            self.accel is not None and self._accel_names is self.names
+        )
+        if aligned and nv == self._accel_node_ver:
+            return self.accel
+        if aligned and self._accel_node_ver >= 0:
+            fn = getattr(cluster, "dirty_nodes_since", None)
+            d = fn(self._accel_node_ver) if fn is not None else None
+            if d is not None and not d[1]:  # covered, membership intact
+                pos = self._pos_map()
+                changed = False
+                for nm in d[0]:
+                    i = pos.get(nm)
+                    if i is None:
+                        continue
+                    t = self._accel_type_id(cluster.get_node(nm))
+                    if t != self.accel[i]:
+                        self.accel[i] = t
+                        changed = True
+                if changed:
+                    self.accel_epoch += 1
+                self._accel_node_ver = nv
+                return self.accel
+        n = len(self.names)
+        if self._accel_label is None:
+            accel = np.zeros((n,), dtype=np.int32)  # all untyped
+        else:
+            get_node = cluster.get_node
+            accel = np.fromiter(
+                (self._accel_type_id(get_node(nm)) for nm in self.names),
+                dtype=np.int32,
+                count=n,
+            )
+        self.accel = accel
+        self._accel_names = self.names
+        self._accel_node_ver = nv
+        self.accel_epoch += 1
+        if self._m_rebuilds is not None:
+            self._m_rebuilds.labels(column="accel").inc()
+        return accel

@@ -1405,6 +1405,21 @@ class BatchResult:
 
 
 @dataclass
+class GangOutcome:
+    """One gang's outcome from ``schedule_gang_queue`` — deliberately
+    lighter than ``BatchResult``: no per-node score/schedulable dicts
+    (building two O(N) dicts per gang was a measurable per-gang cost at
+    50k nodes; the queue's whole point is per-gang work independent of
+    cluster size)."""
+
+    assignments: dict  # pod_key -> node name
+    unassigned: list  # pod keys with no capacity
+    waterline: int | None  # solver level (None on the fallback path)
+    now: float
+    source: str = "window"  # "window" | "fallback"
+
+
+@dataclass
 class BurstResult:
     """Columnar burst outcome: placements as one int32 column over a node
     table — no per-pod Python objects. ``assignments``/``unassigned``
@@ -1618,6 +1633,29 @@ class BatchScheduler:
             fit_tracker = FitTracker(cluster, telemetry=self._telemetry)
         self._fit = fit_tracker
         self._fit_names: tuple | None = None  # (names_ref, n, list) reuse
+        # device-resident multi-gang engine (scorer.gang_batch +
+        # framework.drip.GangColumns), built lazily per weight/label
+        # config by _ensure_gang; _gang holds the dispatch-window
+        # distributions gang_stats() exposes
+        self._gang_engine = None
+        self._gang = {
+            "windows": 0, "gangs": 0, "pods": 0, "fallbacks": 0,
+            "window_sizes": [], "kernel_seconds": [],
+        }
+        self._m_gang_pods = self._m_gang_kernel = None
+        if self._telemetry is not None:
+            reg = self._telemetry.registry
+            self._m_gang_pods = reg.histogram(
+                "crane_gang_dispatch_pods",
+                "Pods per gang dispatch window",
+                buckets=(8, 16, 32, 64, 128, 256, 512, 1024, 2048),
+            )
+            self._m_gang_kernel = reg.histogram(
+                "crane_gang_kernel_seconds",
+                "Gang window solve wall seconds per dispatch",
+                buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01,
+                         0.025, 0.05, 0.1, 0.25, 1.0),
+            )
 
     def refresh(self) -> None:
         """Bulk re-ingest node annotations (the store is a cache). A
@@ -2629,7 +2667,10 @@ class BatchScheduler:
         path (``_bind_assignments``) is equivalence-tested against."""
         from ..framework.types import CycleState, NodeInfo
 
-        nodes_by_name = {node.name: node for node in self.cluster.list_nodes()}
+        # keyed mirror lookups: a gang bind must cost O(pods in gang),
+        # not O(cluster) — materializing a 50k-entry dict per call was
+        # the dominant bind cost (tests/test_bind_lookup.py pins this)
+        get_node = self.cluster.get_node
         bound: dict[str, str] = {}
         rejected: list[str] = []
         rejecting: set[str] = set()
@@ -2639,11 +2680,12 @@ class BatchScheduler:
             if pod is None:
                 dropped.append(pod_key)
                 continue
-            if topology is not None and node_name in nodes_by_name:
+            node = get_node(node_name) if topology is not None else None
+            if node is not None:
                 state = CycleState()
                 topology.pre_filter(state, pod)
                 node_info = NodeInfo(
-                    node=nodes_by_name[node_name],
+                    node=node,
                     pods=self.cluster.list_pods(node_name),
                 )
                 if not topology.filter(state, pod, node_info).ok():
@@ -2698,7 +2740,9 @@ class BatchScheduler:
             zones_to_json,
         )
 
-        nodes_by_name = {node.name: node for node in self.cluster.list_nodes()}
+        # keyed lookups (one per node GROUP), never a full-list dict:
+        # same O(gang) bound as the sequential twin above
+        get_node = self.cluster.get_node
         bound: dict[str, str] = {}
         rejected: list[str] = []
         rejecting: set[str] = set()
@@ -2709,7 +2753,7 @@ class BatchScheduler:
             by_node.setdefault(node_name, []).append(pod_key)
 
         for node_name, keys in by_node.items():
-            node = nodes_by_name.get(node_name)
+            node = get_node(node_name)
             resolved = [(key, *pods_for(key)) for key in keys]
             ctx = None
             if topology is not None and node is not None:
@@ -2913,6 +2957,402 @@ class BatchScheduler:
                 prior[idx[node_name]] += 1
         unplaced.extend(rejected)  # passes exhausted
         return bound_all, unplaced
+
+    # -- heterogeneous multi-template gang queues --------------------------
+
+    def _ensure_gang(self, dynamic_weight, topology_weight, accel_label):
+        """The lazily-built gang engine: version-cached gang columns
+        (``framework.drip.GangColumns``) + the K-gang window kernel
+        (``scorer.gang_batch.GangBatchKernel``), keyed on the weight
+        pair and accelerator label so a caller cycling configs rebuilds
+        instead of mixing column epochs across kernels."""
+        from ..constants import MAX_NODE_SCORE
+        from ..scorer.gang_batch import GangBatchKernel
+        from .drip import GangColumns
+
+        key = (int(dynamic_weight), int(topology_weight), accel_label)
+        eng = self._gang_engine
+        if eng is not None and eng["key"] == key:
+            return eng
+        cols = GangColumns(
+            self.cluster,
+            dyn_weight=int(dynamic_weight),
+            order=("dyn", "fit") if self._fit is not None else ("dyn",),
+            fit_tracker=self._fit,
+            telemetry=self._telemetry,
+            policy=self.policy,
+            accel_label=accel_label,
+        )
+        kern = GangBatchKernel(
+            self.tensors.hv_count,
+            dynamic_weight=int(dynamic_weight),
+            max_offset=MAX_NODE_SCORE * int(topology_weight),
+        )
+        eng = {
+            "key": key,
+            "cols": cols,
+            "kern": kern,
+            "argsort": None,  # (id(score), col_epoch, by_score)
+            "offs_cache": {},  # sorted tput items -> (accel_epoch, row)
+            "zeros_offs": None,  # shared all-zero offset row, length n
+        }
+        self._gang_engine = eng
+        return eng
+
+    def _gang_offsets(self, eng, template, throughput, topology_weight):
+        """Per-node combined-score offset row for ``template``'s
+        per-accelerator-type throughput weights (Gavel-style
+        heterogeneity-aware scoring: a template that runs faster on one
+        accelerator family bids its nodes up by the weight). Returns
+        None when the queue carries no weights for this template — the
+        homogeneous default, bit-identical to the zero-offset path.
+
+        Rows are cached per weight map keyed on the accel column epoch,
+        so repeated gangs of one template reuse ONE identity-stable
+        array and the device column cache never re-uploads it."""
+        import numpy as np
+
+        from ..constants import MAX_NODE_SCORE
+
+        if not throughput:
+            return None
+        tput = throughput.get(template.name)
+        if tput is None:
+            tput = throughput.get(f"{template.namespace}/{template.name}")
+        if not tput:
+            return None
+        cols = eng["cols"]
+        accel = cols.ensure_accel()
+        key = tuple(sorted(tput.items()))
+        hit = eng["offs_cache"].get(key)
+        if hit is not None and hit[0] == cols.accel_epoch:
+            return hit[1]
+        row = np.zeros((len(cols.names),), dtype=np.int32)
+        for label, w in tput.items():
+            if not w:
+                continue
+            tid = cols._accel_index.get(label)
+            if tid is not None:
+                row[accel == tid] = int(w)
+        np.clip(row, 0, MAX_NODE_SCORE * int(topology_weight), out=row)
+        cache = eng["offs_cache"]
+        while len(cache) >= 16:
+            cache.pop(next(iter(cache)))
+        cache[key] = (cols.accel_epoch, row)
+        return row
+
+    def schedule_gang_queue(
+        self,
+        requests,
+        topology=None,
+        bind: bool = True,
+        window: int = 8,
+        dynamic_weight: int = 3,
+        topology_weight: int = 2,
+        throughput=None,
+        accel_label: str | None = None,
+        tie_policy=None,
+        tie_rng=None,
+    ) -> list[GangOutcome]:
+        """Schedule a QUEUE of heterogeneous gangs — ``requests`` is an
+        ordered iterable of ``(template, count)`` pairs — through the
+        batched window kernel: up to ``window`` gangs solve in one
+        jitted program against the version-cached gang columns, with an
+        in-program capacity fold so later gangs see earlier gangs'
+        consumption, and ONE device-to-host transfer per window. No
+        ``refresh()``/``_prepare`` per gang: a named annotation patch
+        between gangs re-reads only the journal's dirty rows.
+
+        Placements are bit-identical to a sequential
+        ``schedule_gang(bind=...)`` loop over the same requests
+        (tests/test_gang_batch.py pins this against the loop AND
+        ``gang_assign_oracle``).
+
+        - ``throughput``: optional ``{template name (or "ns/name"):
+          {accel label value: weight}}`` per-accelerator-type score
+          offsets; nodes are classed by ``labels[accel_label]``.
+          Templates without an entry get zero offsets (homogeneous
+          default).
+        - ``tie_policy``: None (node-order prefix split, today's
+          semantics), ``"fragmentation"`` (waterline ties go to nodes
+          stranding the least copy-capacity), or ``"seeded"``
+          (``tie_rng`` permutation; RNG consumption is one draw per
+          gang regardless of windowing). Non-default policies solve on
+          host (``gang_window_host``); the device kernel covers the
+          default.
+        - gangs needing NUMA vectors (``topology`` given) or carrying
+          scalar/extended resources fall back to ``schedule_gang`` one
+          by one (the window flushes first, so ordering — and therefore
+          capacity evolution — is preserved).
+        """
+        from ..fit import pod_fit_request
+
+        eng = self._ensure_gang(dynamic_weight, topology_weight, accel_label)
+        outcomes: list[GangOutcome] = []
+        buf: list[tuple] = []  # (template, count)
+
+        def flush():
+            if not buf:
+                return
+            self._flush_gang_window(
+                eng, buf, outcomes, bind, dynamic_weight, topology_weight,
+                throughput, tie_policy, tie_rng,
+            )
+            buf.clear()
+
+        for template, count in requests:
+            needs_fallback = (
+                topology is not None
+                or bool(pod_fit_request(template).scalar_resources)
+            )
+            if needs_fallback:
+                flush()  # preserve queue order / capacity evolution
+                r = self.schedule_gang(
+                    template,
+                    int(count),
+                    topology=topology,
+                    bind=bind,
+                    dynamic_weight=dynamic_weight,
+                    topology_weight=topology_weight,
+                )
+                outcomes.append(
+                    GangOutcome(
+                        assignments=dict(r.assignments),
+                        unassigned=list(r.unassigned),
+                        waterline=None,
+                        now=r.now,
+                        source="fallback",
+                    )
+                )
+                self._gang["fallbacks"] += 1
+                # the fallback bound pods behind the columns' back:
+                # force a fit rebuild + carry re-upload next window
+                eng["cols"].drop_fit()
+                eng["kern"].mark_desynced()
+                continue
+            buf.append((template, int(count)))
+            if len(buf) >= int(window):
+                flush()
+        flush()
+        return outcomes
+
+    def _flush_gang_window(
+        self, eng, buf, outcomes, bind, dynamic_weight, topology_weight,
+        throughput, tie_policy, tie_rng,
+    ) -> None:
+        """Solve + (optionally) bind one buffered window of gangs; one
+        ``GangOutcome`` per buffered request is appended in order."""
+        import time as _time
+
+        import numpy as np
+
+        from ..constants import MAX_NODE_SCORE
+        from ..fit import pod_fit_request, request_vec
+        from ..scorer.gang_batch import gang_window_host
+
+        cols = eng["cols"]
+        kern = eng["kern"]
+        total = sum(c for _, c in buf)
+        now = self._clock()
+        with maybe_span(
+            self._telemetry, "gang_dispatch", gangs=len(buf), pods=total
+        ):
+            cols.ensure(now)
+            names = cols.names
+            n = len(names)
+            if n == 0:
+                for t, c in buf:
+                    keys = [
+                        f"{t.namespace}/{t.name}-{i}" for i in range(c)
+                    ]
+                    outcomes.append(
+                        GangOutcome({}, keys, -1, now, "window")
+                    )
+                return
+            score = cols.score
+            sched = cols.schedulable
+            bounded = cols.bounded
+            free = cols.free
+
+            # dedupe request classes across the window: the kernel takes
+            # a [C, 4] class matrix + per-gang class ids. Offset rows
+            # derive HERE — after ensure() — so they align with the
+            # current membership by construction
+            class_of: dict = {}
+            vecs: list = []
+            offs_rows: list = []
+            gang_vecs: list = []
+            class_id = np.empty((len(buf),), np.int32)
+            pods = np.empty((len(buf),), np.int64)
+            for j, (t, c) in enumerate(buf):
+                offs = self._gang_offsets(
+                    eng, t, throughput, topology_weight
+                )
+                vec = request_vec(pod_fit_request(t))
+                ck = (vec.tobytes(), id(offs))
+                cid = class_of.get(ck)
+                if cid is None:
+                    cid = len(vecs)
+                    class_of[ck] = cid
+                    vecs.append(vec)
+                    offs_rows.append(offs)
+                class_id[j] = cid
+                pods[j] = c
+                gang_vecs.append(vec)
+
+            # capture the fold fence BEFORE any bind moves pod_version
+            cluster_pre = self.cluster.pod_version
+            use_device = bind and tie_policy is None
+            t0 = _time.perf_counter()
+            if use_device:
+                dispatch_offs = None
+                if any(o is not None for o in offs_rows):
+                    zeros = eng["zeros_offs"]
+                    if zeros is None or zeros.shape[0] != n:
+                        zeros = eng["zeros_offs"] = np.zeros(
+                            (n,), np.int32
+                        )
+                    dispatch_offs = [
+                        zeros if o is None else o for o in offs_rows
+                    ]
+                counts_m, _unassigned_v, wl_v = kern.dispatch(
+                    score,
+                    sched,
+                    bounded,
+                    free,
+                    np.stack(vecs).astype(np.int64),
+                    dispatch_offs,
+                    class_id,
+                    pods,
+                    col_version=cols.col_epoch,
+                    col_delta=cols.dirty_rows_between,
+                )
+            else:
+                # host window: tie policies reorder the waterline take,
+                # which the in-program prefix split can't express; and
+                # bind=False must NOT fold (sequential bind=False calls
+                # see no capacity evolution either)
+                host_res, _free_after = gang_window_host(
+                    score,
+                    sched,
+                    bounded,
+                    free,
+                    [
+                        (int(pods[j]), gang_vecs[j],
+                         offs_rows[int(class_id[j])])
+                        for j in range(len(buf))
+                    ],
+                    self.tensors.hv_count,
+                    dynamic_weight=int(dynamic_weight),
+                    max_offset=MAX_NODE_SCORE * int(topology_weight),
+                    tie_policy=tie_policy,
+                    tie_rng=tie_rng,
+                    fold=bind,
+                )
+                counts_m = np.stack(
+                    [np.asarray(r.counts, np.int64) for r in host_res]
+                )
+                wl_v = np.array([r.waterline for r in host_res])
+            solve_seconds = _time.perf_counter() - t0
+
+            # score-descending expansion order, cached per column epoch
+            # (the O(n log n) argsort is shared by every gang and every
+            # window until a patch moves a score)
+            by = eng["argsort"]
+            if (
+                by is None
+                or by[0] != id(score)
+                or by[1] != cols.col_epoch
+            ):
+                by = (
+                    id(score),
+                    cols.col_epoch,
+                    np.argsort(-score, kind="stable"),
+                )
+                eng["argsort"] = by
+            by_score = by[2]
+
+            n_bound = 0
+            fold_plan: list = []
+            for j, (t, c) in enumerate(buf):
+                counts_j = np.asarray(counts_m[j])
+                order = np.repeat(by_score, counts_j[by_score])
+                keys = [f"{t.namespace}/{t.name}-{i}" for i in range(c)]
+                assignments = {
+                    key: names[int(i)] for key, i in zip(keys, order)
+                }
+                unassigned_keys = list(keys[len(order):])
+                if bind:
+                    bound, _rej, _rejing, dropped = self._bind_gang(
+                        t, assignments, None, now
+                    )
+                    unassigned_keys.extend(dropped)
+                    n_bound += len(bound)
+                    assignments = bound
+                    fold_plan.append((counts_j, gang_vecs[j]))
+                outcomes.append(
+                    GangOutcome(
+                        assignments=assignments,
+                        unassigned=unassigned_keys,
+                        waterline=int(wl_v[j]),
+                        now=now,
+                        source="window",
+                    )
+                )
+
+            if bind:
+                # fold-fence: replay the kernel's folds into the host
+                # free column only when OUR binds are the only pod
+                # writes and every counted pod actually bound —
+                # anything else (interleaved writer, dropped bind)
+                # invalidates the carry
+                total_counted = int(counts_m.sum())
+                ok = (
+                    free is not None
+                    and cols._fit_pod_ver == cluster_pre
+                    and self.cluster.pod_version == cluster_pre + n_bound
+                    and n_bound == total_counted
+                )
+                if ok:
+                    for counts_j, vec in fold_plan:
+                        for i in np.flatnonzero(counts_j):
+                            cols.fold_row(int(i), int(counts_j[i]) * vec)
+                    cols.commit_folds(cluster_pre + n_bound)
+                    kern.mark_synced(cols.free)
+                else:
+                    cols.drop_fit()
+                    kern.mark_desynced()
+
+        g = self._gang
+        g["windows"] += 1
+        g["gangs"] += len(buf)
+        g["pods"] += total
+        g["window_sizes"].append(len(buf))
+        g["kernel_seconds"].append(solve_seconds)
+        if len(g["window_sizes"]) > 256:
+            del g["window_sizes"][:-256]
+            del g["kernel_seconds"][:-256]
+        if self._m_gang_pods is not None:
+            self._m_gang_pods.observe(total)
+            self._m_gang_kernel.observe(solve_seconds)
+
+    def gang_stats(self) -> dict:
+        """Dispatch-window observability twin of ``drip_stats``."""
+        g = self._gang
+        out = {
+            "windows": g["windows"],
+            "gangs": g["gangs"],
+            "pods": g["pods"],
+            "fallbacks": g["fallbacks"],
+            "window_sizes": list(g["window_sizes"]),
+            "kernel_seconds": list(g["kernel_seconds"]),
+        }
+        eng = self._gang_engine
+        if eng is not None:
+            out["columns"] = dict(eng["cols"].stats)
+            out["kernel_dispatches"] = eng["kern"].dispatches
+            out["free_uploads"] = eng["kern"].free_uploads
+        return out
 
     # -- heterogeneous (mixed) batches -------------------------------------
 

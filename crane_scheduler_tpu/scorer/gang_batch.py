@@ -1,0 +1,395 @@
+"""Device-resident multi-gang batch kernel: water-filling for K gangs.
+
+``BatchScheduler.schedule_gang`` solves one gang per call — a full
+``refresh()`` + O(N) ``_prepare`` + one solver invocation each time.
+This module is the gang twin of ``scorer.drip_batch``: one jitted
+program takes the version-cached gang columns (raw Dynamic score,
+schedulable mask, fit free matrix — ``framework.drip.GangColumns``)
+plus a *window* of K heterogeneous gangs (per-gang pod count, request
+row, per-class score offsets) and runs
+
+    for each gang k (sequentially, ``lax.scan``):
+        cap      = copies of vec_k fitting in the free carry
+        counts   = water-filling split (waterline search + prefix take)
+        free    -= counts · vec_k                  # the fold
+        emit (counts, unassigned, waterline)
+
+so later gangs in the window see earlier gangs' capacity consumption
+exactly like a sequential ``schedule_gang(bind=True)`` loop, and the
+host gets all K verdicts in ONE device-to-host transfer (a packed
+``[K, Npad+2]`` int32 array). The solver math is ``gang_assign_host``'s
+bit for bit — same int32 clipping, same level table, same node-order
+prefix split — with the dense waterline scan replaced by a fixed-trip
+binary search over the monotone ``totals(L) >= P`` predicate (totals is
+non-increasing in L, so the max satisfying level is the same level the
+dense argmax finds; the oracle/host parity suite pins this).
+
+Columns are cached device-side by ``(identity, col_epoch)`` through
+``parallel.sharded.DeviceColumnCache``: an O(dirty) dynamic patch
+scatters only the journal's dirty rows, and the free fold carry stays
+resident across windows under the drip path's ``mark_synced`` host
+fold-replay discipline (exact int64 subtraction on both sides, so
+device == host bit-for-bit).
+
+``gang_window_host`` is the numpy twin of the whole window — the parity
+reference for the kernel AND the execution engine for tie policies the
+in-program prefix split can't express (fragmentation-aware and seeded
+splits reorder the waterline take on host via ``waterline_take``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import enable_x64
+
+from ..constants import MAX_NODE_SCORE
+from ..fit.tracker import UNBOUNDED, copy_counts_rows
+from .drip_batch import _MIN_K_BUCKET, _bucket, _bucket_nodes, _pad
+from .topk import GangResult, gang_assign_host, hot_penalty_steps
+
+__all__ = ["GangBatchKernel", "gang_window_host"]
+
+_I32_MAX = 2**31 - 1
+
+
+def _tie_order_for(tie_policy, tie_rng, capacity, n):
+    """Per-gang ``tie_order`` closure for ``gang_assign_host``.
+
+    - ``"fragmentation"``: waterline tokens go to the nodes that would
+      strand the least copy-capacity if drained (ascending stranded
+      count, node index breaking exact ties) — see
+      ``topology.batched.stranded_copies``.
+    - ``"seeded"``: a seeded random permutation; one ``rng.random(n)``
+      draw per gang regardless of window size, so RNG consumption is
+      identical however the queue is windowed.
+    """
+    if tie_policy is None:
+        return None
+    idx = np.arange(n)
+    if tie_policy == "fragmentation":
+        from ..topology.batched import stranded_copies
+
+        cap = capacity
+        if cap is None:
+            cap = np.full((n,), _I32_MAX, dtype=np.int64)
+
+        def order(exact, upper, l_star, _cap=cap):
+            return np.lexsort((idx, stranded_copies(_cap, upper, exact)))
+
+        return order
+    if tie_policy == "seeded":
+        if tie_rng is None:
+            raise ValueError("tie_policy='seeded' needs tie_rng")
+
+        def order(exact, upper, l_star):
+            return np.lexsort((idx, tie_rng.random(n)))
+
+        return order
+    raise ValueError(f"unknown tie_policy: {tie_policy!r}")
+
+
+def gang_window_host(
+    scores,
+    schedulable,
+    bounded,
+    free,
+    gangs,
+    hv_counts: Sequence[int],
+    dynamic_weight: int = 1,
+    max_offset: int = 0,
+    tie_policy=None,
+    tie_rng=None,
+    fold: bool = True,
+) -> tuple[list[GangResult], np.ndarray | None]:
+    """Numpy twin of one kernel window: solve each gang in ``gangs``
+    (an iterable of ``(num_pods, request_vec, offsets-or-None)``)
+    against an evolving free-matrix copy, exactly the scan's carry
+    semantics. Returns ``(results, free_after)`` — the caller's arrays
+    are never written. ``fold=False`` solves every gang against the
+    SAME initial capacity (the ``bind=False`` preview semantics: with
+    nothing bound, sequential ``schedule_gang`` calls see no capacity
+    evolution either)."""
+    free_c = None if free is None else np.array(free, dtype=np.int64)
+    n = len(np.asarray(scores))
+    results: list[GangResult] = []
+    for num_pods, vec, offs in gangs:
+        cap = None
+        if free_c is not None and bounded is not None:
+            cap = copy_counts_rows(free_c, bounded, np.asarray(vec, np.int64))
+        r = gang_assign_host(
+            scores,
+            schedulable,
+            int(num_pods),
+            hv_counts,
+            capacity=cap,
+            offsets=offs,
+            dynamic_weight=dynamic_weight,
+            max_offset=max_offset,
+            tie_order=_tie_order_for(tie_policy, tie_rng, cap, n),
+        )
+        if fold and free_c is not None:
+            free_c -= (
+                np.asarray(r.counts, np.int64)[:, None]
+                * np.asarray(vec, np.int64)[None, :]
+            )
+        results.append(r)
+    return results, free_c
+
+
+class GangBatchKernel:
+    """Host wrapper: bucketing, device column placement, fold-carry reuse.
+
+    One instance per gang engine (single scheduling loop, like
+    ``DripBatchKernel``). Static over (hotValue table, dynamic weight,
+    max offset); jitted per (node bucket, window bucket, class bucket)
+    shape. The gang columns are cached device-side keyed on
+    ``GangColumns.col_epoch`` with journal-driven row scatters; the
+    ``free`` carry advances in-program and is reusable only while the
+    host replays the identical folds (``mark_synced``)."""
+
+    def __init__(
+        self,
+        hv_counts: Sequence[int],
+        dynamic_weight: int = 1,
+        max_offset: int = 0,
+        device=None,
+    ):
+        from ..parallel.sharded import DeviceColumnCache
+
+        if dynamic_weight < 1:
+            raise ValueError("dynamic_weight must be >= 1")
+        if max_offset < 0:
+            raise ValueError("max_offset must be >= 0")
+        self._g_host = hot_penalty_steps(hv_counts)  # [11] np.int64
+        self._weight = int(dynamic_weight)
+        self._max_offset = int(max_offset)
+        self._n_levels = MAX_NODE_SCORE * self._weight + self._max_offset + 2
+        # fixed-trip binary search covers [0, n_levels-1]
+        self._search_trips = int(self._n_levels).bit_length()
+        self._cols = DeviceColumnCache(device)
+        self._free_dev = None  # device fold carry [npad, 4]
+        self._free_src = None  # host free array the carry mirrors
+        self._free_synced = False
+        self.dispatches = 0
+        self.free_uploads = 0
+        self.last_kernel_seconds = 0.0
+        self._jit = jax.jit(self._window_impl)
+
+    def mark_synced(self, host_free) -> None:
+        """Host applied exactly the kernel's folds — carry is reusable."""
+        self._free_src = host_free
+        self._free_synced = True
+
+    def mark_desynced(self) -> None:
+        self._free_synced = False
+        self._free_dev = None
+        self._free_src = None
+
+    def _g_lookup(self, xq):
+        """g[xq] via an unrolled select chain (same rationale as
+        ``GangScheduler._g_lookup``: a tiny-table gather is
+        pathologically slow on TPU; 11 fused selects are free)."""
+        out = jnp.asarray(int(self._g_host[10]), jnp.int32)
+        out = jnp.broadcast_to(out, xq.shape)
+        for x in range(9, -1, -1):
+            out = jnp.where(xq <= x, jnp.int32(int(self._g_host[x])), out)
+        return out
+
+    def _a_table(self, s, off, k_cap, lv):
+        """A_n(L): tokens of node n valued >= level L — the prior-free
+        specialization of ``GangScheduler._a_table`` (the window resets
+        the hot staircase per gang, exactly like sequential
+        ``schedule_gang`` calls)."""
+        qnum = lv - off
+        w = self._weight
+        q = (qnum + (w - 1)) // w
+        xq = jnp.clip((s - q) // 10, 0, 10)
+        unlocked = jnp.where(
+            (q <= MAX_NODE_SCORE) & (s >= q), self._g_lookup(xq), 0
+        )
+        unlocked = jnp.where(qnum <= 0, k_cap, unlocked)
+        return jnp.minimum(k_cap, unlocked)
+
+    def _window_impl(
+        self, s, schedulable, bounded, free, vecs, offs, class_id,
+        num_pods, active, n_clip,
+    ):
+        n_levels = self._n_levels
+
+        def step(free, xs):
+            cid, p, act = xs
+            vec = vecs[cid]  # [4] int64
+            off = jnp.clip(offs[cid], 0, self._max_offset)  # [N] int32
+            # capacity from the fold carry: free_copy_counts math
+            # (clip >= 0, per-dim floor-div, min across requested dims,
+            # UNBOUNDED where nothing is requested or reported)
+            q = jnp.where(vec > 0, vec, 1)
+            per = jnp.where(
+                vec[None, :] > 0,
+                jnp.clip(free, 0, None) // q[None, :],
+                jnp.int64(UNBOUNDED),
+            )
+            cap = jnp.minimum(per.min(axis=1), jnp.int64(UNBOUNDED))
+            cap = jnp.where(bounded, cap, jnp.int64(UNBOUNDED))
+            # gang_assign_host's exact clips, int32 domain from here on
+            cap = jnp.clip(cap, 0, _I32_MAX).astype(jnp.int32)
+            k_cap = jnp.where(schedulable, cap, 0)
+            k_cap = jnp.minimum(k_cap, jnp.maximum(p, 0))
+            k_cap = jnp.minimum(k_cap, n_clip)
+
+            def totals(lv):
+                return self._a_table(s, off, k_cap, lv).sum(dtype=jnp.int32)
+
+            # totals(0) == sum(k_cap): level 0 is never above any offset
+            t0 = k_cap.sum(dtype=jnp.int32)
+
+            # binary search the monotone predicate totals(L) >= p for
+            # its max satisfying level (totals is non-increasing in L,
+            # so this is the dense grid's argmax — O(N log L) instead
+            # of the O(N·L) level table per scan step)
+            def probe(_, lohi):
+                lo, hi = lohi
+                mid = (lo + hi + 1) // 2
+                m = totals(mid) >= p
+                return jnp.where(m, mid, lo), jnp.where(m, hi, mid - 1)
+
+            lo, _hi = jax.lax.fori_loop(
+                0, self._search_trips, probe,
+                (jnp.int32(0), jnp.int32(n_levels - 1)),
+            )
+            l_star = jnp.where(t0 >= p, lo, jnp.int32(-1))
+
+            def full_capacity(_):
+                return k_cap, p - t0, jnp.asarray(-1, jnp.int32)
+
+            def waterline(l_star):
+                upper = jnp.where(
+                    l_star + 1 >= n_levels,
+                    0,
+                    self._a_table(s, off, k_cap, l_star + 1),
+                )
+                at_or_above = self._a_table(s, off, k_cap, l_star)
+                exact = at_or_above - upper
+                remainder = p - jnp.sum(upper, dtype=jnp.int32)
+                prefix = jnp.cumsum(exact, dtype=jnp.int32) - exact
+                take = jnp.clip(remainder - prefix, 0, exact)
+                return upper + take, jnp.asarray(0, jnp.int32), l_star
+
+            counts, unassigned, wl = jax.lax.cond(
+                l_star < 0, full_capacity, waterline, l_star
+            )
+            counts = jnp.where(act, counts, 0)
+            unassigned = jnp.where(act, unassigned, 0)
+            free = free - counts[:, None].astype(jnp.int64) * vec[None, :]
+            out = jnp.concatenate([counts, jnp.stack([unassigned, wl])])
+            return free, out
+
+        free, outs = jax.lax.scan(step, free, (class_id, num_pods, active))
+        return outs, free
+
+    def dispatch(
+        self,
+        score: np.ndarray,
+        schedulable: np.ndarray,
+        bounded: np.ndarray | None,
+        free: np.ndarray | None,
+        vecs: np.ndarray,
+        offsets,
+        class_id,
+        num_pods,
+        col_version: int = 0,
+        col_delta=None,
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Run one K-gang window; returns ``(counts, unassigned,
+        waterline)`` — int32 ``[K, N]`` / ``[K]`` / ``[K]`` — from one
+        D2H transfer. ``vecs`` is the ``[C, 4]`` class request matrix,
+        ``offsets`` a length-C list of per-class int32 offset rows (or
+        None for all-zero), ``class_id``/``num_pods`` length-K per-gang
+        arrays. Pure w.r.t. the host columns; the device fold carry
+        advances and is kept for reuse. ``col_version``/``col_delta``
+        follow ``DripBatchKernel.dispatch``'s epoch-scatter contract."""
+        n = int(score.shape[0])
+        k = int(len(class_id))
+        c = int(vecs.shape[0])
+        npad = _bucket_nodes(n)
+        kpad = _bucket(k, _MIN_K_BUCKET)
+        cpad = _bucket(c, 2)
+        t0 = time.perf_counter()
+
+        def delta_for(col, arr):
+            if col_delta is None:
+                return None
+            held = self._cols.held_version(col, arr)
+            if held is None or held == col_version:
+                return None
+            return col_delta(held, col_version)
+
+        with enable_x64():
+            s_d = self._cols.put(
+                "gang:score", score, version=col_version,
+                prepare=lambda a: _pad(a.astype(np.int32), npad, 0),
+                delta_rows=delta_for("gang:score", score),
+                row_prepare=lambda v: v.astype(np.int32),
+            )
+            sched_d = self._cols.put(
+                "gang:schedulable", schedulable, version=col_version,
+                prepare=lambda a: _pad(a, npad, False),
+                delta_rows=delta_for("gang:schedulable", schedulable),
+            )
+            if bounded is None or free is None:
+                bounded = np.zeros((n,), dtype=bool)
+                free = np.zeros((n, 4), dtype=np.int64)
+            bnd_d = self._cols.put(
+                "gang:bounded", bounded,
+                prepare=lambda a: _pad(a, npad, False),
+            )
+            free_d = self._free_dev
+            if (
+                not self._free_synced
+                or free_d is None
+                or self._free_src is not free
+                or free_d.shape[0] != npad
+            ):
+                free_d = jax.device_put(_pad(free, npad, 0))
+                self._free_src = free
+                self.free_uploads += 1
+            if offsets is None:
+                offs_d = jnp.zeros((cpad, npad), jnp.int32)
+            else:
+                rows = [
+                    self._cols.put(
+                        f"gang:offs:{i}", row,
+                        prepare=(
+                            lambda a: _pad(a.astype(np.int32), npad, 0)
+                        ),
+                    )
+                    for i, row in enumerate(offsets)
+                ]
+                rows.extend(
+                    jnp.zeros((npad,), jnp.int32)
+                    for _ in range(cpad - len(rows))
+                )
+                offs_d = jnp.stack(rows)
+            vecs_p = _pad(np.ascontiguousarray(vecs, dtype=np.int64), cpad, 0)
+            cid_p = _pad(np.asarray(class_id, dtype=np.int32), kpad, 0)
+            pods_p = np.minimum(
+                np.asarray(num_pods, dtype=np.int64), _I32_MAX
+            ).astype(np.int32)
+            pods_p = _pad(pods_p, kpad, 0)
+            active = np.zeros((kpad,), dtype=bool)
+            active[:k] = True
+            outs, free_out = self._jit(
+                s_d, sched_d, bnd_d, free_d, jnp.asarray(vecs_p), offs_d,
+                cid_p, pods_p, active, np.int32(_I32_MAX // max(n, 1)),
+            )
+            outs = np.asarray(outs)  # the single D2H transfer
+        self._free_dev = free_out
+        self._free_synced = True  # provisional; caller desyncs on reject
+        self.last_kernel_seconds = time.perf_counter() - t0
+        self.dispatches += 1
+        return outs[:k, :n], outs[:k, npad], outs[:k, npad + 1]

@@ -141,6 +141,26 @@ def candidate_levels(
     return cand.astype(np.int32)
 
 
+def waterline_take(exact, remainder, order=None) -> np.ndarray:
+    """Split ``remainder`` waterline tokens across the nodes holding
+    ``exact`` tokens at L*. Default (``order=None``) is the sequential
+    oracle's rule — node-index prefix order, exactly the ``cumsum``
+    split the solver jits. ``order`` (a permutation of node indices)
+    fills greedily in that order instead: the gang queue's
+    fragmentation-aware / seeded tie policies reorder ONLY this split —
+    the waterline level, the token multiset, and every count away from
+    L* are policy-independent by construction."""
+    exact = np.asarray(exact)
+    if order is None:
+        prefix = np.cumsum(exact) - exact
+        return np.clip(remainder - prefix, 0, exact)
+    take = np.zeros_like(exact)
+    ex = exact[order]
+    prefix = np.cumsum(ex) - ex
+    take[order] = np.clip(remainder - prefix, 0, ex)
+    return take
+
+
 @dataclass
 class GangResult:
     counts: Any  # [N] int32 — pods assigned per node
@@ -222,6 +242,7 @@ def gang_assign_host(
     dynamic_weight: int = 1,
     max_offset: int = 0,
     prior=None,
+    tie_order=None,
 ) -> GangResult:
     """Vectorized numpy twin of ``GangScheduler._assign_impl``.
 
@@ -233,6 +254,11 @@ def gang_assign_host(
     ``prior`` shifts each node's hot-penalty staircase past assignments
     an earlier pass already made (token t is valued at h(prior + t));
     ``capacity`` bounds this pass only.
+
+    ``tie_order`` is the gang queue's waterline-split policy hook:
+    ``tie_order(exact, upper, l_star) -> order | None`` may return a
+    node-index permutation for ``waterline_take``. None (default, and a
+    None return) keeps the oracle's node-index prefix split.
     """
     s = np.asarray(scores, np.int64)
     n = s.shape[0]
@@ -281,8 +307,8 @@ def gang_assign_host(
         remainder = num_pods
     else:
         remainder = num_pods - int(totals[l_star + 1])
-    prefix = np.cumsum(exact) - exact
-    take = np.clip(remainder - prefix, 0, exact)
+    order = None if tie_order is None else tie_order(exact, upper, l_star)
+    take = waterline_take(exact, remainder, order)
     counts = upper + take
     return GangResult(counts.astype(np.int32), 0, l_star)
 

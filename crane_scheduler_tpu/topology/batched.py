@@ -222,3 +222,17 @@ def copies_capacity(
             jnp.asarray(aware_pad),
         )
     )[:n]
+
+
+def stranded_copies(capacity, upper, exact) -> np.ndarray:
+    """[N] int64 — copy-capacity a node would strand if every token it
+    holds at or above the waterline binds: ``capacity - (upper + exact)``
+    clipped at zero. The gang queue's fragmentation-aware tie policy
+    fills waterline tokens on the nodes stranding the LEAST capacity
+    first (Tesserae-style bin protection: leave the large contiguous
+    copy blocks on other nodes intact for future gangs), which only
+    reorders the waterline split — the level and token multiset are
+    tie-policy-independent (see ``scorer.topk.waterline_take``)."""
+    cap = np.asarray(capacity, np.int64)
+    taken = np.asarray(upper, np.int64) + np.asarray(exact, np.int64)
+    return np.clip(cap - taken, 0, None)

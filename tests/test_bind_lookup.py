@@ -1,0 +1,137 @@
+"""Gang binds cost O(pods in gang), not O(cluster).
+
+``_bind_assignments`` / ``_bind_assignments_sequential`` used to
+materialize ``{node.name: node for node in cluster.list_nodes()}`` per
+gang bind — a 50k-entry dict built and thrown away every call, the
+dominant bind cost at fleet scale. Both paths now resolve nodes through
+the keyed ``cluster.get_node`` mirror lookup; these tests pin that the
+full node list is NEVER materialized on the bind path."""
+
+import numpy as np
+
+from crane_scheduler_tpu.cluster import ClusterState, Node
+from crane_scheduler_tpu.framework.scheduler import BatchScheduler
+from crane_scheduler_tpu.policy import DEFAULT_POLICY
+from crane_scheduler_tpu.sim import SimConfig, Simulator
+
+
+class _ListNodesForbidden(ClusterState):
+    """list_nodes() raises once armed — any full-list materialization
+    on the instrumented path fails the test loudly."""
+
+    def __init__(self):
+        super().__init__()
+        self.armed = False
+        self.list_calls = 0
+
+    def list_nodes(self):
+        self.list_calls += 1
+        if self.armed:
+            raise AssertionError(
+                "bind path materialized the full node list"
+            )
+        return super().list_nodes()
+
+
+def _gang_assignments(template, nodes, count):
+    keys = [f"{template.namespace}/{template.name}-{i}"
+            for i in range(count)]
+    return {key: nodes[i % len(nodes)] for i, key in enumerate(keys)}
+
+
+def test_bind_gang_50k_nodes_no_full_list():
+    cluster = _ListNodesForbidden()
+    for i in range(50_000):
+        cluster.add_node(Node(name=f"node-{i:05d}"))
+    batch = BatchScheduler(cluster, DEFAULT_POLICY)
+
+    sim = Simulator(SimConfig(n_nodes=1, seed=1))
+    template = sim.make_pod(cpu_milli=100)
+
+    cluster.armed = True
+    targets = [f"node-{i:05d}" for i in range(0, 160, 10)]
+    for path in (batch._bind_assignments,
+                 batch._bind_assignments_sequential):
+        assignments = _gang_assignments(template, targets, 16)
+
+        def pods_for(key, _t=template):
+            from dataclasses import replace
+
+            return (
+                replace(_t, name=key.split("/", 1)[1],
+                        annotations=dict(_t.annotations), node_name=""),
+                True,
+            )
+
+        bound, rejected, rejecting, dropped = path(
+            pods_for, assignments, None, 0.0
+        )
+        assert len(bound) == 16 and not rejected and not dropped
+    cluster.armed = False
+
+
+def test_bind_gang_with_topology_no_full_list():
+    """The topology arm resolves per-GROUP nodes via get_node too."""
+    from tests.test_framework_e2e import _nrt_fixture, make_sim
+
+    from crane_scheduler_tpu.topology import TopologyMatch
+
+    sim = make_sim(3, seed=2)
+    calls = {"n": 0}
+    orig = sim.cluster.list_nodes
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    lister = _nrt_fixture(sim, [[4000, 4000]] * 3)
+    topology = TopologyMatch(lister, cluster=sim.cluster)
+    batch = sim.build_batch_scheduler()
+    template = sim.make_pod(cpu_milli=1000, mem=1 << 28)
+    sim.cluster.delete_pod(template.key())
+    result = batch.schedule_gang(template, 4, topology=topology,
+                                 bind=False)
+    assert len(result.assignments) == 4
+
+    sim.cluster.list_nodes = counting
+    try:
+        bound, rejected, _rejecting, dropped = batch._bind_gang(
+            template, result.assignments, topology, sim.clock.now()
+        )
+    finally:
+        sim.cluster.list_nodes = orig
+    assert calls["n"] == 0, "bind path listed the whole cluster"
+    assert len(bound) + len(rejected) + len(dropped) == 4
+
+
+def test_sequential_twin_stays_equivalent_without_list():
+    """Randomized equivalence of the two bind paths under the keyed
+    lookup (topology=None arm; the NUMA arm is covered by
+    tests/test_bind_grouped.py)."""
+    rng = np.random.default_rng(3)
+    for _ in range(5):
+        count = int(rng.integers(1, 12))
+        outs = []
+        for path_name in ("_bind_assignments",
+                          "_bind_assignments_sequential"):
+            sim = Simulator(SimConfig(n_nodes=4, seed=17))
+            sim.sync_metrics()
+            batch = sim.build_batch_scheduler()
+            template = sim.make_pod(cpu_milli=100)
+            sim.cluster.delete_pod(template.key())
+            nodes = [n.name for n in sim.cluster.list_nodes()]
+            assignments = _gang_assignments(template, nodes, count)
+            path = getattr(batch, path_name)
+
+            def pods_for(key, _t=template):
+                from dataclasses import replace
+
+                return (
+                    replace(_t, name=key.split("/", 1)[1],
+                            annotations=dict(_t.annotations),
+                            node_name=""),
+                    True,
+                )
+
+            outs.append(path(pods_for, assignments, None, 0.0))
+        assert outs[0] == outs[1]

@@ -1,0 +1,434 @@
+"""Batched multi-gang engine (scorer.gang_batch +
+BatchScheduler.schedule_gang_queue): kernel vs host-window vs
+sequential-oracle fuzz, queue vs sequential ``schedule_gang`` loop
+parity on twin sims (bind both ways, named annotation patches between
+gangs), the NUMA/scalar-resource fallback, per-accelerator throughput
+offsets, tie policies (seeded RNG consumption invariance,
+fragmentation-aware splits), and the gang telemetry families."""
+
+import random
+from dataclasses import replace
+
+import numpy as np
+
+from crane_scheduler_tpu.fit.tracker import copy_counts_rows
+from crane_scheduler_tpu.scorer.gang_batch import (
+    GangBatchKernel,
+    gang_window_host,
+)
+from crane_scheduler_tpu.scorer.topk import gang_assign_oracle
+from crane_scheduler_tpu.sim import SimConfig, Simulator
+
+DEFAULT_HV = [5, 2]
+
+
+# -- kernel vs host window vs sequential oracle ------------------------------
+
+
+def _fuzz_window(rng):
+    n = rng.randrange(3, 40)
+    k = rng.randrange(1, 7)
+    w = rng.choice([1, 3])
+    mo = rng.choice([0, 200])
+    hv = rng.choice([DEFAULT_HV, []])
+    scores = np.array([rng.randrange(0, 101) for _ in range(n)], np.int64)
+    sched = np.array([rng.random() < 0.85 for _ in range(n)])
+    bounded = np.array([rng.random() < 0.7 for _ in range(n)])
+    free = np.array(
+        [[rng.randrange(-500, 8000), rng.randrange(0, 1 << 34),
+          rng.randrange(0, 1 << 20), rng.randrange(0, 30)]
+         for _ in range(n)],
+        np.int64,
+    )
+    n_classes = rng.randrange(1, 4)
+    vecs = np.array(
+        [[rng.choice([0, 250, 1000, 3000]), rng.choice([0, 1 << 28]),
+          0, 1]
+         for _ in range(n_classes)],
+        np.int64,
+    )
+    offs = None
+    if mo and rng.random() < 0.6:
+        offs = [
+            np.array([rng.randrange(0, mo + 1) for _ in range(n)], np.int32)
+            for _ in range(n_classes)
+        ]
+    class_id = np.array(
+        [rng.randrange(n_classes) for _ in range(k)], np.int32
+    )
+    pods = np.array([rng.randrange(0, 30) for _ in range(k)], np.int64)
+    return n, k, w, mo, hv, scores, sched, bounded, free, vecs, offs, \
+        class_id, pods
+
+
+def test_kernel_matches_host_window_and_oracle_fuzz():
+    rng = random.Random(2026)
+    for trial in range(25):
+        (n, k, w, mo, hv, scores, sched, bounded, free, vecs, offs,
+         class_id, pods) = _fuzz_window(rng)
+        kern = GangBatchKernel(hv, dynamic_weight=w, max_offset=mo)
+        counts_m, unassigned_v, wl_v = kern.dispatch(
+            scores, sched, bounded, free, vecs,
+            offs, class_id, pods,
+        )
+        gangs = [
+            (int(pods[j]), vecs[class_id[j]],
+             None if offs is None else offs[class_id[j]])
+            for j in range(k)
+        ]
+        host_res, _ = gang_window_host(
+            scores, sched, bounded, free, gangs, hv,
+            dynamic_weight=w, max_offset=mo,
+        )
+        # the oracle leg replays the fold by hand (it solves ONE gang)
+        free_c = free.astype(np.int64).copy()
+        for j in range(k):
+            ctx = (trial, j, n, k, w, mo, hv)
+            h = host_res[j]
+            assert np.array_equal(counts_m[j], h.counts), ctx
+            assert int(unassigned_v[j]) == int(h.unassigned), ctx
+            assert int(wl_v[j]) == int(h.waterline), ctx
+            num, vec, off = gangs[j]
+            cap = copy_counts_rows(free_c, bounded, vec)
+            o = gang_assign_oracle(
+                scores, sched, num, hv, capacity=cap, offsets=off,
+                dynamic_weight=w, max_offset=mo,
+            )
+            assert np.array_equal(counts_m[j], o.counts), ctx
+            assert int(unassigned_v[j]) == int(o.unassigned), ctx
+            free_c -= (
+                np.asarray(h.counts, np.int64)[:, None]
+                * np.asarray(vec, np.int64)[None, :]
+            )
+
+
+# -- queue vs sequential schedule_gang loop on twin sims ---------------------
+
+
+def build_sim(seed=11, n_nodes=8):
+    sim = Simulator(SimConfig(n_nodes=n_nodes, seed=seed))
+    sim.sync_metrics()
+    for i, node in enumerate(sim.cluster.list_nodes()):
+        sim.cluster.add_node(replace(
+            node,
+            allocatable={"cpu": str(4 + (i % 3) * 2), "memory": "64Gi",
+                         "pods": "100"},
+        ))
+    return sim, sim.build_batch_scheduler()
+
+
+def mk_requests(sim, shapes):
+    reqs = []
+    for cpu, cnt in shapes:
+        t = sim.make_pod(cpu_milli=cpu)
+        sim.cluster.delete_pod(t.key())
+        reqs.append((t, cnt))
+    return reqs
+
+
+SHAPES = ((500, 6), (1000, 4), (250, 9), (1500, 3), (500, 5), (2000, 2),
+          (750, 7))
+
+
+def _outcomes(outs):
+    return [(dict(o.assignments), sorted(o.unassigned)) for o in outs]
+
+
+def _patch_first_anno(batch, node_name):
+    node = batch.cluster.get_node(node_name)
+    k = next(iter(node.annotations))
+    batch.cluster.patch_node_annotation(node_name, k, node.annotations[k])
+
+
+def test_queue_matches_sequential_loop_bind():
+    sim_a, batch_a = build_sim()
+    sim_b, batch_b = build_sim()
+    reqs_a = mk_requests(sim_a, SHAPES)
+    reqs_b = mk_requests(sim_b, SHAPES)
+    seq = []
+    for t, c in reqs_a:
+        r = batch_a.schedule_gang(t, c, bind=True)
+        seq.append((dict(r.assignments), sorted(r.unassigned)))
+    win = _outcomes(batch_b.schedule_gang_queue(reqs_b, window=3))
+    assert seq == win
+    stats = batch_b.gang_stats()
+    assert stats["windows"] == 3 and stats["gangs"] == len(SHAPES)
+    assert stats["fallbacks"] == 0
+    # every pod the sequential loop placed actually bound in the queue
+    assert sum(len(a) for a, _ in win) == sum(len(a) for a, _ in seq)
+
+
+def test_queue_matches_sequential_loop_bind_false():
+    sim_a, batch_a = build_sim(seed=5)
+    sim_b, batch_b = build_sim(seed=5)
+    reqs_a = mk_requests(sim_a, SHAPES)
+    reqs_b = mk_requests(sim_b, SHAPES)
+    seq = []
+    for t, c in reqs_a:
+        r = batch_a.schedule_gang(t, c, bind=False)
+        seq.append((dict(r.assignments), sorted(r.unassigned)))
+    win = _outcomes(batch_b.schedule_gang_queue(reqs_b, bind=False,
+                                                window=4))
+    assert seq == win
+    # nothing bound on either side
+    assert batch_b.cluster.pod_version == batch_a.cluster.pod_version
+
+
+def test_queue_dirty_patch_between_gangs_matches_sequential():
+    """A named annotation patch between gangs: the sequential loop
+    re-ingests everything per call; the queue's gang columns refresh
+    O(dirty) through the journal — placements must stay identical."""
+    sim_a, batch_a = build_sim(seed=23)
+    sim_b, batch_b = build_sim(seed=23)
+    reqs_a = mk_requests(sim_a, SHAPES)
+    reqs_b = mk_requests(sim_b, SHAPES)
+    victim_a = sim_a.cluster.list_nodes()[0].name
+    victim_b = sim_b.cluster.list_nodes()[0].name
+    seq = []
+    for j, (t, c) in enumerate(reqs_a):
+        r = batch_a.schedule_gang(t, c, bind=True)
+        seq.append((dict(r.assignments), sorted(r.unassigned)))
+        if j == 2:
+            _patch_first_anno(batch_a, victim_a)
+    win = _outcomes(batch_b.schedule_gang_queue(reqs_b[:3], window=2))
+    _patch_first_anno(batch_b, victim_b)
+    win += _outcomes(batch_b.schedule_gang_queue(reqs_b[3:], window=2))
+    assert seq == win
+    cols = batch_b._gang_engine["cols"].stats
+    assert cols["dirty_patches"] >= 1  # the patch rode the journal
+
+
+def test_queue_fuzz_random_windows_and_patches():
+    rng = random.Random(7)
+    for trial in range(4):
+        seed = rng.randrange(10_000)
+        shapes = tuple(
+            (rng.choice([250, 500, 1000, 1500]), rng.randrange(1, 9))
+            for _ in range(rng.randrange(2, 9))
+        )
+        window = rng.randrange(1, 6)
+        sim_a, batch_a = build_sim(seed=seed, n_nodes=rng.randrange(3, 9))
+        sim_b, batch_b = build_sim(seed=seed, n_nodes=len(
+            sim_a.cluster.list_nodes()))
+        reqs_a = mk_requests(sim_a, shapes)
+        reqs_b = mk_requests(sim_b, shapes)
+        seq = []
+        for t, c in reqs_a:
+            r = batch_a.schedule_gang(t, c, bind=True)
+            seq.append((dict(r.assignments), sorted(r.unassigned)))
+        win = _outcomes(
+            batch_b.schedule_gang_queue(reqs_b, window=window)
+        )
+        assert seq == win, (trial, seed, shapes, window)
+
+
+# -- fallback routing --------------------------------------------------------
+
+
+def test_scalar_resources_template_falls_back():
+    from crane_scheduler_tpu.cluster import (
+        Container,
+        Pod,
+        ResourceRequirements,
+    )
+
+    sim, batch = build_sim(seed=3)
+    reqs = mk_requests(sim, ((500, 3),))
+    gpu = Pod(
+        name="gpu-gang",
+        namespace="default",
+        containers=(
+            Container("c0", ResourceRequirements(
+                requests={"cpu": "250m", "example.com/gpu": "1"}
+            )),
+        ),
+    )
+    reqs.append((gpu, 2))
+    reqs += mk_requests(sim, ((500, 2),))
+    outs = batch.schedule_gang_queue(reqs, window=8)
+    assert [o.source for o in outs] == ["window", "fallback", "window"]
+    assert batch.gang_stats()["fallbacks"] == 1
+
+
+def test_topology_routes_everything_to_fallback():
+    from tests.test_framework_e2e import _nrt_fixture, make_sim
+
+    from crane_scheduler_tpu.topology import TopologyMatch
+
+    sims = [make_sim(3, seed=9) for _ in range(2)]
+    outs = []
+    for sim in sims:
+        batch = sim.build_batch_scheduler()
+        lister = _nrt_fixture(sim, [[4000, 4000]] * 3)
+        topology = TopologyMatch(lister, cluster=sim.cluster)
+        t1 = sim.make_pod(cpu_milli=1000, mem=1 << 28)
+        sim.cluster.delete_pod(t1.key())
+        t2 = sim.make_pod(cpu_milli=500, mem=1 << 28)
+        sim.cluster.delete_pod(t2.key())
+        outs.append((sim, batch, topology, [(t1, 4), (t2, 3)]))
+
+    (sim_a, batch_a, topo_a, reqs_a), (sim_b, batch_b, topo_b, reqs_b) = outs
+    seq = []
+    for t, c in reqs_a:
+        r = batch_a.schedule_gang(t, c, topology=topo_a, bind=True)
+        seq.append((dict(r.assignments), sorted(r.unassigned)))
+    q = batch_b.schedule_gang_queue(reqs_b, topology=topo_b, window=4)
+    assert all(o.source == "fallback" for o in q)
+    assert all(o.waterline is None for o in q)
+    assert seq == _outcomes(q)
+
+
+# -- heterogeneous throughput offsets ----------------------------------------
+
+
+def _flat_sim(n_nodes=4, seed=2):
+    """Identical annotations on every node -> identical scores, so the
+    offset/tie machinery decides placement deterministically."""
+    sim = Simulator(SimConfig(n_nodes=n_nodes, seed=seed))
+    sim.sync_metrics()
+    nodes = sim.cluster.list_nodes()
+    anno = dict(nodes[0].annotations)
+    for node in nodes:
+        sim.cluster.add_node(replace(
+            node, annotations=dict(anno),
+            allocatable={"cpu": "8", "memory": "64Gi", "pods": "100"},
+        ))
+    return sim
+
+
+def test_throughput_offsets_steer_to_labeled_accelerator():
+    sim = _flat_sim()
+    nodes = sim.cluster.list_nodes()
+    fast = nodes[-1].name  # last in node order: default split skips it
+    sim.cluster.add_node(replace(
+        sim.cluster.get_node(fast), labels={"accel": "a100"}
+    ))
+    batch = sim.build_batch_scheduler()
+    t = sim.make_pod(cpu_milli=500)
+    sim.cluster.delete_pod(t.key())
+
+    base = batch.schedule_gang_queue([(t, 1)], window=2)
+    assert fast not in base[0].assignments.values()
+
+    out = batch.schedule_gang_queue(
+        [(t, 1)],
+        window=2,
+        throughput={t.name: {"a100": 100}},
+        accel_label="accel",
+    )
+    assert list(out[0].assignments.values()) == [fast]
+    # unlabeled templates in the same queue keep the homogeneous default
+    t2 = sim.make_pod(cpu_milli=500)
+    sim.cluster.delete_pod(t2.key())
+    out2 = batch.schedule_gang_queue(
+        [(t2, 1)],
+        window=2,
+        throughput={"other-template": {"a100": 100}},
+        accel_label="accel",
+    )
+    assert fast not in out2[0].assignments.values()
+
+
+def test_accel_column_patches_on_label_change():
+    sim = _flat_sim()
+    batch = sim.build_batch_scheduler()
+    t = sim.make_pod(cpu_milli=100)
+    sim.cluster.delete_pod(t.key())
+    tput = {t.name: {"h100": 50}}
+    batch.schedule_gang_queue([(t, 1)], throughput=tput,
+                              accel_label="accel")
+    eng = batch._gang_engine
+    epoch0 = eng["cols"].accel_epoch
+    victim = sim.cluster.list_nodes()[1].name
+    sim.cluster.add_node(replace(
+        sim.cluster.get_node(victim), labels={"accel": "h100"}
+    ))
+    out = batch.schedule_gang_queue([(t, 2)], throughput=tput,
+                                    accel_label="accel")
+    assert eng["cols"].accel_epoch > epoch0
+    assert victim in set(out[0].assignments.values())
+
+
+# -- tie policies ------------------------------------------------------------
+
+
+def test_seeded_ties_window_invariant_rng_consumption():
+    """tie_policy='seeded' draws ONE rng vector per gang, so windowing
+    never shifts the stream: window=1 and window=K give identical
+    placements AND leave the generator in the identical state."""
+    shapes = ((500, 3), (500, 4), (1000, 2), (500, 5))
+    results, states = [], []
+    for window in (1, 4):
+        sim = _flat_sim(n_nodes=5, seed=6)
+        batch = sim.build_batch_scheduler()
+        reqs = mk_requests(sim, shapes)
+        rng = np.random.default_rng(42)
+        outs = batch.schedule_gang_queue(
+            reqs, window=window, tie_policy="seeded", tie_rng=rng
+        )
+        results.append(_outcomes(outs))
+        states.append(rng.bit_generator.state)
+    assert results[0] == results[1]
+    assert states[0] == states[1]
+
+
+def test_fragmentation_ties_prefer_least_stranding():
+    """Equal scores, capacities [3, 1]: the default node-order split
+    takes node 0; the fragmentation policy protects the big bin and
+    takes node 1 (stranding 0 copies instead of 2)."""
+    scores = np.array([50, 50], np.int64)
+    sched = np.ones(2, bool)
+    bounded = np.ones(2, bool)
+    free = np.array([[3000, 0, 0, 0], [1000, 0, 0, 0]], np.int64)
+    gangs = [(1, np.array([1000, 0, 0, 0], np.int64), None)]
+    default, _ = gang_window_host(
+        scores, sched, bounded, free, gangs, DEFAULT_HV
+    )
+    frag, _ = gang_window_host(
+        scores, sched, bounded, free, gangs, DEFAULT_HV,
+        tie_policy="fragmentation",
+    )
+    assert list(default[0].counts) == [1, 0]
+    assert list(frag[0].counts) == [0, 1]
+    # the split only reorders the waterline take: totals identical
+    assert int(default[0].counts.sum()) == int(frag[0].counts.sum())
+
+
+def test_tie_policy_queue_window_invariant():
+    for policy in ("fragmentation", "seeded"):
+        results = []
+        for window in (1, 3):
+            sim = _flat_sim(n_nodes=4, seed=8)
+            batch = sim.build_batch_scheduler()
+            reqs = mk_requests(sim, ((500, 4), (500, 3), (1000, 2)))
+            kw = {"tie_policy": policy}
+            if policy == "seeded":
+                kw["tie_rng"] = np.random.default_rng(7)
+            outs = batch.schedule_gang_queue(reqs, window=window, **kw)
+            results.append(_outcomes(outs))
+        assert results[0] == results[1], policy
+
+
+# -- telemetry ---------------------------------------------------------------
+
+
+def test_gang_telemetry_families():
+    from crane_scheduler_tpu.telemetry import Telemetry
+    from crane_scheduler_tpu.telemetry.expfmt import parse_exposition
+
+    tel = Telemetry()
+    sim, _ = build_sim(seed=4)
+    from crane_scheduler_tpu.framework.scheduler import BatchScheduler
+
+    batch = BatchScheduler(sim.cluster, sim.policy, clock=sim.clock,
+                           telemetry=tel)
+    reqs = mk_requests(sim, ((500, 3), (1000, 2)))
+    _patch_first_anno(batch, sim.cluster.list_nodes()[0].name)
+    batch.schedule_gang_queue(reqs, window=2)
+    text = tel.registry.render()
+    families = parse_exposition(text)
+    assert "crane_gang_dispatch_pods" in families
+    assert "crane_gang_kernel_seconds" in families
+    assert "crane_gang_column_rebuilds_total" in families
+    spans, _ = tel.spans.drain_since(0)
+    assert "gang_dispatch" in [s["name"] for s in spans]

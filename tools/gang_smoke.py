@@ -1,0 +1,201 @@
+"""Gang-engine smoke gate (``make gang-smoke``): drive a mixed-template
+gang storm through ``BatchScheduler.schedule_gang_queue`` against a
+wire-stub apiserver and fail CI unless
+
+  * every gang solved through the batched window path (zero sequential
+    fallbacks, >= 2 dispatch windows),
+  * every placed pod bound EXACTLY once on the wire — the stub's
+    ``bind_posts == placed`` and ``duplicate_binds == 0`` oracle (a
+    binding POST is not idempotent; a duplicate is a real bug),
+  * the window placements are bit-identical to the host window solver
+    (``gang_window_host``) replayed over the same gang columns, and
+  * the gang families — ``crane_gang_dispatch_pods``,
+    ``crane_gang_kernel_seconds``,
+    ``crane_gang_column_rebuilds_total`` — survive the strict
+    exposition parser with observations.
+
+Exit 0 = every check passed; any violation prints the failure and
+exits nonzero.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+_STUB = os.path.join(_REPO, "tests", "kube_stub.py")
+
+
+def _load_stub():
+    spec = importlib.util.spec_from_file_location("kube_stub", _STUB)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    from crane_scheduler_tpu.cluster import (
+        Container,
+        Pod,
+        ResourceRequirements,
+    )
+    from crane_scheduler_tpu.cluster.kube import KubeClusterClient
+    from crane_scheduler_tpu.framework.scheduler import BatchScheduler
+    from crane_scheduler_tpu.policy import DEFAULT_POLICY
+    from crane_scheduler_tpu.telemetry import Telemetry
+    from crane_scheduler_tpu.telemetry.expfmt import (
+        ExpositionError,
+        parse_exposition,
+    )
+    from crane_scheduler_tpu.utils import parse_local_time
+
+    failures = 0
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        nonlocal failures
+        mark = "ok" if ok else "FAIL"
+        print(f"[gang-smoke] {name}: {mark}{' — ' + detail if detail else ''}")
+        if not ok:
+            failures += 1
+
+    kube_stub = _load_stub()
+    n_nodes = 60
+    metric_names = [sp.name for sp in DEFAULT_POLICY.spec.sync_period]
+    # the stub stamps its seeded annotations 2026-07-30T00:00:00Z
+    now = parse_local_time("2026-07-30T00:00:00Z") + 30.0
+    shapes = ((100, 8), (500, 5), (250, 12), (1000, 3), (100, 9),
+              (750, 4), (500, 7), (250, 6))
+
+    server = kube_stub.KubeStubSubprocess()
+    client = None
+    try:
+        server.seed(
+            n_nodes, "node-", metrics=metric_names,
+            allocatable={"cpu": "16", "memory": "64Gi", "pods": "110"},
+        )
+        tel = Telemetry()
+        client = KubeClusterClient(server.url, telemetry=tel)
+        client.start()
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            if len(client.list_nodes()) == n_nodes:
+                break
+            time.sleep(0.02)
+        check("mirror synced", len(client.list_nodes()) == n_nodes)
+
+        batch = BatchScheduler(
+            client, DEFAULT_POLICY, clock=lambda: now, telemetry=tel
+        )
+        reqs = [
+            (Pod(
+                name=f"gang-{g:02d}", namespace="default",
+                containers=(Container("c", ResourceRequirements(
+                    requests={"cpu": f"{cpu}m", "memory": "128Mi"},
+                )),),
+            ), count)
+            for g, (cpu, count) in enumerate(shapes)
+        ]
+        total_pods = sum(c for _, c in reqs)
+        outs = batch.schedule_gang_queue(reqs, window=3)
+
+        stats = batch.gang_stats()
+        check("every gang rode the window path",
+              all(o.source == "window" for o in outs)
+              and stats["fallbacks"] == 0,
+              f"fallbacks={stats['fallbacks']}")
+        check("windowed dispatch", stats["windows"] >= 2,
+              f"windows={stats['windows']}")
+        placed = sum(len(o.assignments) for o in outs)
+        check("all pods placed", placed == total_pods,
+              f"{placed}/{total_pods}")
+
+        # host-solver parity over the same columns: replay the queue
+        # through gang_window_host from a fresh column build and compare
+        # per-gang per-node placement counts
+        import numpy as np
+
+        from crane_scheduler_tpu.constants import MAX_NODE_SCORE
+        from crane_scheduler_tpu.fit import pod_fit_request, request_vec
+        from crane_scheduler_tpu.scorer.gang_batch import gang_window_host
+
+        eng = batch._gang_engine
+        cols = eng["cols"]
+        cols.drop_fit()
+        cols.ensure(now)
+        # rebuild capacity as it stood BEFORE the storm: add back what
+        # the storm's own pods consumed (they are all bound now)
+        free0 = None if cols.free is None else cols.free.copy()
+        pos = {name: i for i, name in enumerate(cols.names)}
+        if free0 is not None:
+            for (t, _c), o in zip(reqs, outs):
+                vec = request_vec(pod_fit_request(t))
+                for node in o.assignments.values():
+                    free0[pos[node]] += vec
+        host_res, _ = gang_window_host(
+            cols.score, cols.schedulable, cols.bounded, free0,
+            [(c, request_vec(pod_fit_request(t)), None)
+             for t, c in reqs],
+            batch.tensors.hv_count, dynamic_weight=3,
+            max_offset=MAX_NODE_SCORE * 2,
+        )
+        parity = True
+        for (t, _c), o, h in zip(reqs, outs, host_res):
+            got = np.zeros(len(cols.names), np.int64)
+            for node in o.assignments.values():
+                got[pos[node]] += 1
+            if not np.array_equal(got, np.asarray(h.counts)):
+                parity = False
+        check("host solver parity", parity)
+
+        st = server.stats()
+        check("bind_posts == placed", st.get("bind_posts", 0) == placed,
+              f"bind_posts={st.get('bind_posts')} placed={placed}")
+        check("zero duplicate binding POSTs",
+              st.get("duplicate_binds", 0) == 0,
+              f"duplicate_binds={st.get('duplicate_binds')}")
+
+        try:
+            families = parse_exposition(tel.registry.render())
+            check("registry strict parse", True,
+                  f"{len(families)} families")
+        except ExpositionError as e:
+            families = {}
+            check("registry strict parse", False, str(e))
+        for required in (
+            "crane_gang_dispatch_pods",
+            "crane_gang_kernel_seconds",
+            "crane_gang_column_rebuilds_total",
+        ):
+            check(f"family {required}", required in families)
+
+        def hist_count(name: str) -> float:
+            for sample in families.get(name, {}).get("samples", ()):
+                if sample[0].endswith("_count"):
+                    return sample[2]
+            return 0.0
+
+        check("dispatch_pods observations",
+              hist_count("crane_gang_dispatch_pods") >= 2,
+              f"count={hist_count('crane_gang_dispatch_pods')}")
+    finally:
+        if client is not None:
+            client.stop()
+        server.stop()
+
+    print(f"[gang-smoke] {'PASS' if not failures else 'FAIL'}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

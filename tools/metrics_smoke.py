@@ -330,6 +330,62 @@ def main() -> int:
         check("shed_total deadline_queue reason",
               shed_samples.get("deadline_queue", 0) >= 1,
               str(shed_samples))
+
+        # 9. gang families (ISSUE 19): a heterogeneous gang queue
+        # through the batched window engine must emit the dispatch /
+        # kernel histograms and the gang column counters, and a named
+        # annotation patch between queue calls must land as an O(dirty)
+        # column refresh — all still strict-parseable
+        from crane_scheduler_tpu.framework.scheduler import BatchScheduler
+        from crane_scheduler_tpu.sim.simulator import (
+            SimConfig as _GangSimConfig,
+            Simulator as _GangSimulator,
+        )
+
+        gang_tel = Telemetry()
+        gang_sim = _GangSimulator(_GangSimConfig(n_nodes=8, seed=3))
+        gang_sim.sync_metrics()
+        gang_batch = BatchScheduler(
+            gang_sim.cluster, DEFAULT_POLICY, clock=gang_sim.clock,
+            telemetry=gang_tel,
+        )
+        gang_reqs = []
+        for cpu, cnt in ((500, 3), (1000, 2), (250, 4)):
+            t = gang_sim.make_pod(cpu_milli=cpu)
+            gang_sim.cluster.delete_pod(t.key())
+            gang_reqs.append((t, cnt))
+        gang_outs = gang_batch.schedule_gang_queue(gang_reqs[:2], window=2)
+        first = gang_sim.cluster.list_nodes()[0]
+        anno_key = next(iter(first.annotations))
+        gang_sim.cluster.patch_node_annotation(
+            first.name, anno_key, first.annotations[anno_key]
+        )
+        gang_outs += gang_batch.schedule_gang_queue(gang_reqs[2:], window=2)
+        try:
+            gang_families = parse_exposition(gang_tel.registry.render())
+            check("gang registry strict parse", True,
+                  f"{len(gang_families)} families")
+        except ExpositionError as e:
+            gang_families = {}
+            check("gang registry strict parse", False, str(e))
+        for required in (
+            "crane_gang_dispatch_pods",
+            "crane_gang_kernel_seconds",
+            "crane_gang_column_rebuilds_total",
+        ):
+            check(f"family {required}", required in gang_families)
+        gang_stats = gang_batch.gang_stats()
+        check("gang windows dispatched",
+              gang_stats["windows"] >= 2 and gang_stats["fallbacks"] == 0,
+              str({k: gang_stats[k] for k in ("windows", "fallbacks")}))
+        check("gang pods placed",
+              sum(len(o.assignments) for o in gang_outs) == 9)
+        check("gang dirty patch consumed O(dirty)",
+              gang_stats.get("columns", {}).get("dirty_patches", 0) >= 1,
+              str(gang_stats.get("columns")))
+        gang_spans, _ = gang_tel.spans.drain_since(0)
+        check("gang_dispatch span recorded",
+              "gang_dispatch" in [s["name"] for s in gang_spans])
     finally:
         server.stop()
 
